@@ -1,0 +1,33 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+The reference tests "multi-node without a cluster" by oversubscribing
+``mpirun -n 32`` on one host (``scripts/test_cpu.sh``); the TPU analog is
+``xla_force_host_platform_device_count`` (SURVEY.md §4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's TPU plugin (sitecustomize) may force its platform even
+# over JAX_PLATFORMS; the config update before first backend use wins.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    """Each test gets a pristine runtime + constants table."""
+    yield
+    from torchmpi_tpu import constants, runtime_state
+
+    runtime_state._reset_for_tests()
+    constants._reset_for_tests()

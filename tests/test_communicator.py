@@ -1,0 +1,148 @@
+"""Communicator stack + topology tests.
+
+Mirrors ``test/hierarchical_communicators.lua``: synthetic multi-level
+topologies are injected via communicator *keys* built from rank arithmetic
+(``tostring(mpi.rank() % div)``, lua:30-36), then intra/inter ranks and
+cartesian-ness are asserted (lua:50-74).
+"""
+
+import jax
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.runtime.communicator import (
+    Communicator,
+    CommunicatorError,
+    split_by_keys,
+)
+
+
+def test_start_builds_global_communicator():
+    mpi.start()
+    assert mpi.started()
+    assert mpi.size() == len(jax.devices())
+    assert mpi.communicator_names() == ["global"]
+    assert mpi.num_nodes_in_communicator() == 1
+
+
+def test_start_twice_raises():
+    mpi.start()
+    with pytest.raises(RuntimeError):
+        mpi.start()
+
+
+def test_key_split_mod2():
+    """Keys rank%2 -> 2 intra groups of 4, cartesian."""
+    mpi.start()
+    level = mpi.push_communicator(lambda r: str(r % 2), name="mod2")
+    assert level == 1
+    comm = mpi.current_communicator()
+    assert comm.num_intra_groups == 2
+    assert comm.cartesian
+    # sorted by (key, rank): group '0' = ranks 0,2,4,6; group '1' = 1,3,5,7
+    assert [comm.intra_rank_of(r) for r in range(8)] == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert [comm.member(r).intra_group for r in range(8)] == [0, 1] * 4
+    # cartesian: every device joins an inter ring of same-intra-rank peers
+    assert all(comm.inter_rank_of(r) >= 0 for r in range(8))
+
+
+def test_key_split_ragged_is_tree():
+    """Unequal group sizes force tree (non-cartesian) topology
+    (resources.cpp:266-280)."""
+    mpi.start()
+    keys = ["a"] * 3 + ["b"] * 5
+    mpi.push_communicator(keys, name="ragged")
+    comm = mpi.current_communicator()
+    assert comm.num_intra_groups == 2
+    assert not comm.cartesian
+    assert comm.mesh is None
+    # tree: only group roots join the inter communicator
+    inter_members = [r for r in range(8) if comm.inter_rank_of(r) >= 0]
+    assert len(inter_members) == 2
+
+
+def test_tree_mode_forced():
+    """with_cartesian_communicator=False forces tree even for equal groups
+    (the reference's tree-vs-cartesian start flag, init.lua:61-65)."""
+    mpi.start(with_cartesian_communicator=False)
+    mpi.push_communicator(lambda r: str(r // 4), name="halves")
+    comm = mpi.current_communicator()
+    assert not comm.cartesian
+    assert len([r for r in range(8) if comm.inter_rank_of(r) >= 0]) == 2
+
+
+def test_span_semantics():
+    mpi.start()
+    l1 = mpi.push_communicator(lambda r: str(r // 4))
+    l2 = mpi.push_communicator(lambda r: str(r // 2))
+    assert mpi.stack().span == (l2, l2)
+    mpi.set_collective_span(l1, l2)
+    assert mpi.stack().span == (l1, l2)
+    mpi.set_communicator(0)
+    assert mpi.current_communicator().name == "global"
+    with pytest.raises(CommunicatorError):
+        mpi.set_collective_span(0, 5)
+
+
+def test_three_level_hierarchy():
+    """Mirror of the lua test's div in {2,4}: nested splits give consistent
+    intra sizes."""
+    mpi.start()
+    for div in (2, 4):
+        mpi.push_communicator(lambda r, d=div: str(r % d), name=f"mod{div}")
+        comm = mpi.current_communicator()
+        assert comm.num_intra_groups == div
+        assert comm.intra_size(0) == 8 // div
+        assert comm.cartesian
+
+
+def test_nested_split_refines_parent():
+    """Pushing splits the CURRENT communicator (torch_mpi.cpp:75-79): devices
+    in different parent intra groups never share a child group."""
+    mpi.start()
+    mpi.push_communicator(lambda r: str(r // 4), name="halves")  # {0-3},{4-7}
+    mpi.push_communicator(lambda r: str(r % 2), name="parity")
+    comm = mpi.current_communicator()
+    # refinement: 2 parent groups x 2 parities = 4 groups of 2
+    assert comm.num_intra_groups == 4
+    assert comm.intra_size(0) == 2
+    groups = {}
+    for r in range(8):
+        groups.setdefault(comm.member(r).intra_group, []).append(r)
+    # each child group stays within one half AND one parity
+    for members in groups.values():
+        assert len({m // 4 for m in members}) == 1
+        assert len({m % 2 for m in members}) == 1
+
+
+def test_oversized_key_rejected():
+    mpi.start()
+    with pytest.raises(CommunicatorError):
+        mpi.push_communicator(["x" * 2000] * 8)
+
+
+def test_communicator_mesh_shapes():
+    mpi.start()
+    mpi.push_communicator(lambda r: str(r % 2))
+    comm = mpi.current_communicator()
+    assert comm.mesh.devices.shape == (2, 4)
+    assert comm.mesh.axis_names == ("inter", "intra")
+    assert comm.flat_mesh().devices.shape == (8,)
+    assert len(comm.intra_meshes) == 2
+    assert len(comm.inter_meshes) == 4
+
+
+def test_describe_and_names():
+    mpi.start()
+    mpi.push_communicator(lambda r: str(r // 4), name="nodes")
+    s = mpi.current_communicator().describe()
+    assert "cartesian" in s and "size=8" in s
+    assert mpi.communicator_names() == ["global", "nodes"]
+
+
+def test_stop_resets():
+    mpi.start()
+    mpi.stop()
+    assert not mpi.started()
+    mpi.start()  # restartable
+    assert mpi.size() == 8

@@ -1,0 +1,58 @@
+"""Constants/flag system tests (reference lib/constants.cpp freeze checks)."""
+
+import pytest
+
+from torchmpi_tpu import constants
+from torchmpi_tpu.constants import FrozenConstantsError
+
+
+def test_defaults_match_reference():
+    # cutoffs and chunk sizes carry the reference's tuned defaults
+    # (constants.cpp:136-155)
+    assert constants.get("small_broadcast_size_cpu") == 1 << 13
+    assert constants.get("small_allreduce_size_cpu") == 1 << 16
+    assert constants.get("min_buffer_size_cpu") == 1 << 17
+    assert constants.get("max_buffer_size_cpu") == 1 << 20
+    assert constants.get("broadcast_size_tree_based_cpu") == 1 << 22
+    assert constants.get("num_buffers_per_collective_cpu") == 3
+    assert constants.get("max_num_buffers_per_collective") == 16
+    assert constants.get("collective_thread_pool_size") == 4
+
+
+def test_set_get_roundtrip():
+    constants.set("small_allreduce_size_tpu", 123)
+    assert constants.get("small_allreduce_size_tpu") == 123
+    assert constants.small_allreduce_size_tpu == 123
+
+
+def test_unknown_name_rejected():
+    with pytest.raises(KeyError):
+        constants.get("nonexistent")
+    with pytest.raises(KeyError):
+        constants.set("nonexistent", 1)
+
+
+def test_type_checked():
+    with pytest.raises(TypeError):
+        constants.set("small_allreduce_size_tpu", "big")
+
+
+def test_freeze_blocks_set():
+    constants.freeze_constants()
+    assert constants.constants_frozen()
+    with pytest.raises(FrozenConstantsError):
+        constants.set("use_hierarchical_collectives", False)
+
+
+def test_listener_mirroring():
+    seen = {}
+    constants.register_listener(lambda k, v: seen.__setitem__(k, v))
+    # registration replays current values
+    assert seen["collective_thread_pool_size"] == 4
+    constants.set("collective_thread_pool_size", 2)
+    assert seen["collective_thread_pool_size"] == 2
+
+
+def test_snapshot():
+    snap = constants.snapshot()
+    assert snap["num_buffers_per_collective_tpu"] == 3

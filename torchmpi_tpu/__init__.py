@@ -1,0 +1,92 @@
+"""torchmpi_tpu — a TPU-native distributed training framework.
+
+A brand-new framework with the capabilities of facebookresearch/TorchMPI,
+re-designed for TPU: hierarchical named communicators over JAX device meshes
+(ICI × DCN instead of MPI_COMM_WORLD splits and cudaIPC groups), a full
+sync/async collectives surface with XLA-builtin and custom ring backends plus
+a runtime selector, NN-level data-parallel helpers, an AllReduceSGD training
+engine, and a host-side sharded parameter server (Downpour / EASGD / DSGD).
+
+Public API shape follows the reference (``torchmpi/init.lua``):
+
+    import torchmpi_tpu as mpi
+    mpi.start()
+    y = mpi.allreduce_tensor(x)           # selector-routed
+    y = mpi.ring.allreduce_tensor(x)      # explicit custom-ring backend
+    h = mpi.async_.allreduce_tensor(x)    # async -> SyncHandle
+    mpi.wait(h)
+    mpi.stop()
+"""
+
+from . import constants
+from .collectives import (
+    allgather_tensor,
+    allreduce_scalar,
+    allreduce_tensor,
+    async_,
+    barrier,
+    broadcast_scalar,
+    broadcast_tensor,
+    collective_availability,
+    reduce_tensor,
+    ring,
+    selector as collective_selector,
+    sendreceive_tensor,
+    wait,
+    xla,
+)
+from .runtime.communicator import Communicator, split_by_keys
+from .runtime.handles import SyncHandle, sync_all
+from .runtime_state import (
+    communicator_names,
+    current_communicator,
+    num_nodes_in_communicator,
+    num_processes,
+    push_communicator,
+    rank,
+    set_collective_span,
+    set_communicator,
+    size,
+    stack,
+    start,
+    started,
+    stop,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "start",
+    "stop",
+    "started",
+    "rank",
+    "size",
+    "num_processes",
+    "barrier",
+    "push_communicator",
+    "set_communicator",
+    "set_collective_span",
+    "communicator_names",
+    "num_nodes_in_communicator",
+    "current_communicator",
+    "stack",
+    "Communicator",
+    "split_by_keys",
+    "SyncHandle",
+    "sync_all",
+    "wait",
+    "broadcast_tensor",
+    "reduce_tensor",
+    "allreduce_tensor",
+    "allgather_tensor",
+    "sendreceive_tensor",
+    "broadcast_scalar",
+    "allreduce_scalar",
+    "xla",
+    "ring",
+    "async_",
+    "collective_selector",
+    "collective_availability",
+    "constants",
+    "__version__",
+]

@@ -1,0 +1,158 @@
+"""Public collectives surface.
+
+Namespace layout mirrors the reference Lua API
+(``torchmpi/init.lua:145-365``): default (selector-routed) sync collectives at
+the top level, per-backend namespaces (``xla`` ≙ stock MPI/NCCL, ``ring`` ≙
+custom p2p), and ``async_`` variants returning :class:`SyncHandle`s. Scalar
+collectives cross *processes* (multi-controller JAX) and are identity in
+single-controller mode, where every rank lives in one process.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+
+from ..runtime.communicator import Communicator
+from ..runtime.handles import SyncHandle
+from . import eager, primitives
+from .selector import collective_availability, selector
+
+
+def _current_comm(comm: Optional[Communicator]) -> Communicator:
+    if comm is not None:
+        return comm
+    from .. import runtime_state
+
+    return runtime_state.current_communicator()
+
+
+def _dispatch(op, x, comm, mode, backend=None, **kw):
+    comm = _current_comm(comm)
+    if backend is None:
+        platform = comm.devices[0].platform
+        backend = selector.select(
+            op, platform, multinode=comm.num_nodes() > 1, mode=mode
+        )
+        if backend == "pallas":
+            backend = "ring"  # eager pallas path lands with ops/ring_kernels
+    if mode == "sync":
+        return eager.run(op, x, comm, backend=backend, **kw)
+    return eager.run_async(op, x, comm, backend=backend, **kw)
+
+
+# --- selector-routed (default) namespace -----------------------------------
+def broadcast_tensor(x, root=0, comm=None):
+    return _dispatch("broadcast", x, comm, "sync", root=root)
+
+
+def reduce_tensor(x, root=0, comm=None):
+    return _dispatch("reduce", x, comm, "sync", root=root)
+
+
+def allreduce_tensor(x, comm=None):
+    return _dispatch("allreduce", x, comm, "sync")
+
+
+def allgather_tensor(x, comm=None):
+    return _dispatch("allgather", x, comm, "sync")
+
+
+def sendreceive_tensor(x, src, dst, comm=None):
+    return _dispatch("sendreceive", x, comm, "sync", src=src, dst=dst)
+
+
+class _BackendNS:
+    """``mpi.p2p.*`` / ``mpi.nccl.*`` style per-backend namespaces."""
+
+    def __init__(self, backend: str, mode: str):
+        self._backend = backend
+        self._mode = mode
+
+    def broadcast_tensor(self, x, root=0, comm=None):
+        return _dispatch("broadcast", x, comm, self._mode, self._backend, root=root)
+
+    def reduce_tensor(self, x, root=0, comm=None):
+        return _dispatch("reduce", x, comm, self._mode, self._backend, root=root)
+
+    def allreduce_tensor(self, x, comm=None):
+        return _dispatch("allreduce", x, comm, self._mode, self._backend)
+
+    def allgather_tensor(self, x, comm=None):
+        return _dispatch("allgather", x, comm, self._mode, self._backend)
+
+    def sendreceive_tensor(self, x, src, dst, comm=None):
+        return _dispatch(
+            "sendreceive", x, comm, self._mode, self._backend, src=src, dst=dst
+        )
+
+
+class _AsyncNS(_BackendNS):
+    def __init__(self, backend=None):
+        super().__init__(backend, "async")
+        self.xla = _BackendNS("xla", "async")
+        self.ring = _BackendNS("ring", "async")
+
+
+xla = _BackendNS("xla", "sync")
+ring = _BackendNS("ring", "sync")
+async_ = _AsyncNS()
+
+
+# --- scalar collectives (init.lua:125-134) ---------------------------------
+def broadcast_scalar(value, root: int = 0):
+    """Broadcast a host scalar across *processes* (multi-controller)."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    arr = multihost_utils.broadcast_one_to_all(
+        np.asarray(value), is_source=jax.process_index() == root
+    )
+    return type(value)(arr)
+
+
+def allreduce_scalar(value):
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    # process_allgather then sum: every process contributes its scalar.
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    return type(value)(gathered.sum())
+
+
+def barrier(comm=None):
+    eager.barrier(_current_comm(comm))
+
+
+def wait(handle):
+    from ..runtime.handles import wait as _wait
+
+    return _wait(handle)
+
+
+__all__ = [
+    "broadcast_tensor",
+    "reduce_tensor",
+    "allreduce_tensor",
+    "allgather_tensor",
+    "sendreceive_tensor",
+    "broadcast_scalar",
+    "allreduce_scalar",
+    "barrier",
+    "wait",
+    "xla",
+    "ring",
+    "async_",
+    "selector",
+    "collective_availability",
+    "eager",
+    "primitives",
+]

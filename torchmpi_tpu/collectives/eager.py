@@ -1,0 +1,255 @@
+"""Eager collectives over a communicator's devices.
+
+The reference exposes *eager* collectives: ``mpi.allreduceTensor(t)`` acts on
+a rank-local tensor, across processes, right now. The TPU-native equivalent
+operates on a **rank-stacked array**: an array whose leading axis indexes the
+communicator's ranks (size ``comm.size``), sharded so rank *i*'s block lives
+on device *i*. Each call shards the input over the communicator's flat mesh
+(one block per device = one "rank-local tensor"), runs the collective kernel
+under ``shard_map``, and returns the rank-stacked result.
+
+Key reference mechanics preserved:
+
+- **Resource memoization**: the reference memoizes NCCL comms / IPC handles /
+  Gloo contexts per ``(data pointer, communicator)`` with
+  collective-at-first-use semantics (``lib/resources.cpp:102-163``,
+  ``lib/resources.h:95-100``). Here the expensive lazily-created resource is
+  the *compiled XLA executable*; it is memoized per
+  ``(op, backend, shape, dtype, static args)`` on the communicator object,
+  so first use pays compilation and subsequent calls are dispatch-only.
+- **Async = dispatch + handle**: XLA dispatch is asynchronous, so the async
+  variants return immediately with a :class:`SyncHandle` wrapping the
+  in-flight arrays (the stream-handle variant of ``resources.h:230-253``);
+  launch overhead is the Python dispatch cost, mirroring the <50µs assertion
+  in ``test/collectives_all.lua:192-199``.
+- **Small/large routing**: ``op_route`` consults the frozen constants to pick
+  the latency path (fused XLA collective) below the element cutoffs and the
+  bandwidth path (chunked ring) above, the analog of falling back to stock
+  MPI below ``kSmallAllreduceSize`` (``lib/collectives.cpp:296-301``,
+  ``lib/collectives_cuda.cpp:419-425``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import constants
+from ..runtime.communicator import Communicator
+from ..runtime.handles import SyncHandle
+from . import primitives as prim
+
+_AXIS = "mpi"
+
+
+class CollectiveArgumentError(ValueError):
+    pass
+
+
+def _rank_spec(ndim: int) -> P:
+    return P(_AXIS, *([None] * (ndim - 1)))
+
+
+def _check_rank_stacked(x, comm: Communicator) -> None:
+    if x.ndim < 1 or x.shape[0] != comm.size:
+        raise CollectiveArgumentError(
+            f"eager collectives expect a rank-stacked array with leading axis "
+            f"== comm.size ({comm.size}); got shape {tuple(x.shape)}. Inside "
+            f"jit/shard_map code use torchmpi_tpu.collectives.primitives "
+            f"directly instead."
+        )
+
+
+def _resource_cache(comm: Communicator) -> dict:
+    # Lazily attached, like acquireCollectiveResources keying off the comm.
+    cache = getattr(comm, "_collective_resources", None)
+    if cache is None:
+        cache = {}
+        comm._collective_resources = cache  # type: ignore[attr-defined]
+    return cache
+
+
+def _flat_mesh(comm: Communicator) -> Mesh:
+    # The Communicator's device list is immutable: build the mesh once.
+    mesh = getattr(comm, "_eager_flat_mesh", None)
+    if mesh is None:
+        mesh = comm.flat_mesh(_AXIS)
+        comm._eager_flat_mesh = mesh  # type: ignore[attr-defined]
+    return mesh
+
+
+def _rank_sharding(comm: Communicator, ndim: int) -> NamedSharding:
+    cache = _resource_cache(comm)
+    key = ("_sharding", ndim)
+    s = cache.get(key)
+    if s is None:
+        s = NamedSharding(_flat_mesh(comm), _rank_spec(ndim))
+        cache[key] = s
+    return s
+
+
+def _compile(
+    comm: Communicator,
+    op: str,
+    backend: str,
+    aval: Tuple[Tuple[int, ...], Any],
+    static: Tuple,
+    build_kernel: Callable[[], Callable],
+):
+    """Fetch-or-build the jitted executable for this (op, comm, aval)."""
+    cache = _resource_cache(comm)
+    key = (op, backend, aval, static)
+    fn = cache.get(key)
+    if fn is None:
+        mesh = _flat_mesh(comm)
+        ndim = len(aval[0])
+        spec = _rank_spec(ndim)
+        kernel = build_kernel()
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec
+        )
+        donate = constants.get("donate_eager_buffers")
+        fn = jax.jit(shmapped, donate_argnums=(0,) if donate else ())
+        cache[key] = fn
+    return fn
+
+
+def _per_rank_shape(x_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    return (1,) + tuple(x_shape[1:])
+
+
+def _nelem_per_rank(x) -> int:
+    return int(np.prod(_per_rank_shape(x.shape)))
+
+
+# ---------------------------------------------------------------------------
+# backend kernel builders: operate on a [1, ...] per-rank block
+# ---------------------------------------------------------------------------
+
+
+def _kernels(op: str, backend: str, root: int, extra: Tuple):
+    """Return a kernel fn(block) for the given op/backend.
+
+    For ``backend='ring'`` broadcasts, ``extra`` carries the tree-vs-pipeline
+    decision (made in :func:`run` from the platform-appropriate constant, so
+    it participates in the executable cache key — ``collectives.cpp:58-64``'s
+    4MB switch)."""
+    if backend == "xla":
+        table = {
+            "allreduce": lambda b: prim.allreduce(b, _AXIS),
+            "broadcast": lambda b: prim.broadcast(b, root, _AXIS),
+            "reduce": lambda b: prim.reduce(b, root, _AXIS),
+            "allgather": lambda b: prim.allgather(b, _AXIS, dim=-1),
+            "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
+        }
+    elif backend == "ring":
+        def _ring_bcast(b):
+            if "tree" in extra:
+                return prim.tree_broadcast(b, root, _AXIS)
+            return prim.ring_broadcast(b, root, _AXIS)
+
+        table = {
+            "allreduce": lambda b: prim.ring_allreduce(b, _AXIS),
+            "broadcast": _ring_bcast,
+            "reduce": lambda b: prim.ring_reduce(b, root, _AXIS),
+            "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
+            "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
+        }
+    else:
+        raise CollectiveArgumentError(f"unknown backend {backend!r}")
+    if op not in table:
+        raise CollectiveArgumentError(f"unknown collective {op!r}")
+    return table[op]
+
+
+def op_route(op: str, nelem: int, platform: str) -> str:
+    """Size-based latency/bandwidth routing (reference
+    ``collectives.cpp:296-301``): below the cutoff use the fused XLA path.
+    Returns the backend that should service a 'ring'-requested call."""
+    suffix = "tpu" if platform != "cpu" else "cpu"
+    if op == "allreduce":
+        cutoff = constants.get(f"small_allreduce_size_{suffix}")
+    elif op == "broadcast":
+        cutoff = constants.get(f"small_broadcast_size_{suffix}")
+    else:
+        return "ring"
+    return "xla" if nelem <= cutoff else "ring"
+
+
+def run(
+    op: str,
+    x,
+    comm: Communicator,
+    backend: str = "xla",
+    root: int = 0,
+    src: int = 0,
+    dst: int = 0,
+    route_small: bool = True,
+):
+    """Synchronous eager collective on a rank-stacked array."""
+    x = jnp.asarray(x)
+    _check_rank_stacked(x, comm)
+    if op in ("broadcast", "reduce") and not 0 <= root < comm.size:
+        raise CollectiveArgumentError(f"root {root} out of range")
+    platform = comm.devices[0].platform
+    effective = backend
+    if backend == "ring" and route_small:
+        effective = op_route(op, _nelem_per_rank(x), platform)
+    extra: Tuple = (src, dst) if op == "sendreceive" else ()
+    if effective == "ring" and op == "broadcast":
+        suffix = "tpu" if platform != "cpu" else "cpu"
+        cutoff = constants.get(f"broadcast_size_tree_based_{suffix}")
+        block_bytes = _nelem_per_rank(x) * jnp.result_type(x).itemsize
+        extra = extra + (("tree" if block_bytes <= cutoff else "pipeline"),)
+    aval = (tuple(x.shape), jnp.result_type(x))
+    static = (root,) + extra
+    fn = _compile(
+        comm,
+        op,
+        effective,
+        aval,
+        static,
+        lambda: _kernels(op, effective, root, extra),
+    )
+    # Place the input on the communicator's devices (no-op if already there).
+    sharding = _rank_sharding(comm, x.ndim)
+    if getattr(x, "sharding", None) != sharding:
+        x = jax.device_put(x, sharding)
+    return fn(x)
+
+
+def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
+    """Asynchronous variant: returns a handle immediately; the arrays are
+    in flight on device (XLA async dispatch replaces the reference's
+    offload-thread + future machinery for device collectives). The handle is
+    registered in the global table so ``sync_all()`` (and thus ``stop()``)
+    drains it, matching ``resources.cpp:463-481``."""
+    from ..runtime.handles import handles
+
+    out = run(op, x, comm, **kw)
+    h = SyncHandle(arrays=out)
+    handles.register(h)
+    return h
+
+
+def barrier(comm: Communicator) -> None:
+    """Device barrier over the communicator (``torch_mpi.cpp:270-280``)."""
+    cache = _resource_cache(comm)
+    fn = cache.get("_barrier")
+    if fn is None:
+        mesh = comm.flat_mesh(_AXIS)
+        fn = jax.jit(
+            jax.shard_map(
+                lambda x: prim.barrier_value(_AXIS) + x * 0,
+                mesh=mesh,
+                in_specs=P(_AXIS),
+                out_specs=P(_AXIS),
+            )
+        )
+        cache["_barrier"] = fn
+    jax.block_until_ready(fn(jnp.zeros((comm.size,), jnp.int32)))

@@ -1,0 +1,256 @@
+"""In-graph collective primitives over a named mesh axis.
+
+These are the building blocks usable directly inside ``jit`` / ``shard_map``
+code (the idiomatic TPU path), and the kernels the eager API compiles.
+
+Two families, mirroring the reference's backend split:
+
+- **xla**: single fused XLA collectives (``psum`` / ``all_gather`` /
+  ``ppermute``) — the analog of the stock MPI / NCCL paths
+  (``lib/collectives.cpp:126-290``, ``lib/collectives_cuda.cpp:871-1161``):
+  trust the vendor collective.
+- **ring**: explicit chunked ring algorithms written with ``lax.ppermute``
+  neighbor exchanges — the TPU-native re-design of the reference's custom
+  p2p rings (``lib/detail/collectives.cpp:128-326``,
+  ``lib/detail/collectives_cuda.cpp:202-388``): ring reduce-scatter followed
+  by ring all-gather, and tree-vs-pipelined broadcast with the 4MB switch
+  (``lib/detail/collectives.cpp:27-113``). On TPU, ``ppermute`` lowers to
+  ICI neighbor DMA, which is exactly the transport the reference built by
+  hand with cudaIPC; a Pallas RDMA variant lives in ``ops/ring_kernels.py``.
+
+All functions take ``axis`` (a mesh axis name) and are shape-polymorphic but
+trace-time static, per XLA semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# XLA-backed (stock) collectives
+# ---------------------------------------------------------------------------
+
+
+def allreduce(x, axis: str = "mpi", average: bool = False):
+    """Sum-allreduce (reference semantics: sum only, division left to the
+    caller — ``lib/detail/collectives.cpp:163-165``, ``torchmpi/nn.lua:40``)."""
+    out = lax.psum(x, axis)
+    if average:
+        out = out / lax.psum(1, axis)
+    return out
+
+
+def broadcast(x, root: int = 0, axis: str = "mpi"):
+    """Everyone receives the root's value."""
+    idx = lax.axis_index(axis)
+    return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis)
+
+
+def reduce(x, root: int = 0, axis: str = "mpi"):
+    """Root receives the sum; non-root ranks keep their input (MPI_Reduce
+    leaves non-root output undefined; the reference leaves the input tensor
+    untouched, which we make the defined behavior)."""
+    idx = lax.axis_index(axis)
+    total = lax.psum(x, axis)
+    return jnp.where(idx == root, total, x)
+
+
+def allgather(x, axis: str = "mpi", dim: int = -1, tiled: bool = True):
+    """Concatenate every rank's tensor along ``dim`` (reference allgather
+    concatenates along the last dimension after a size exchange,
+    ``lib/collectives.cpp:245-290``; sizes here are static so no exchange)."""
+    if dim < 0:
+        dim = x.ndim + dim
+    return lax.all_gather(x, axis, axis=dim, tiled=tiled)
+
+
+def sendreceive(x, src: int, dst: int, axis: str = "mpi"):
+    """Point-to-point: ``dst`` receives ``src``'s tensor, everyone else keeps
+    their own (reference ``sendreceive_TH*Tensor``,
+    ``lib/collectives.cpp:204-242``)."""
+    recv = lax.ppermute(x, axis, [(src, dst)])
+    idx = lax.axis_index(axis)
+    return jnp.where(idx == dst, recv, x)
+
+
+def shift(x, offset: int = 1, axis: str = "mpi", axis_size: Optional[int] = None):
+    """Cyclic rotation by ``offset`` positions (building block for rings and
+    for sequence-parallel ring attention)."""
+    n = axis_size or lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def reduce_scatter(x, axis: str = "mpi", dim: int = 0, tiled: bool = True):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=tiled)
+
+
+def barrier_value(axis: str = "mpi"):
+    """A tiny psum whose completion orders all ranks (device barrier)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)
+
+
+# ---------------------------------------------------------------------------
+# Custom ring algorithms (the reference's p2p path, TPU-native)
+# ---------------------------------------------------------------------------
+
+
+def _flatten_pad(x, p: int):
+    """Flatten to 1-D and pad to a multiple of ``p`` chunks."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    chunk = -(-n // p)  # ceil
+    pad = chunk * p - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat, n, chunk
+
+
+def ring_allreduce(x, axis: str = "mpi", axis_size: Optional[int] = None):
+    """Chunked ring allreduce: (p-1) reduce-scatter steps then (p-1)
+    all-gather steps, the schedule memoized by the reference as a "plan"
+    (``lib/resources.cpp:582-672``, algorithm doc ``lib/detail/README.md``).
+
+    Receive-centric pull model like the reference: at every step each rank
+    combines the chunk arriving from its left neighbor. On TPU each
+    ``ppermute`` is a one-hop ICI transfer, so total bytes moved per rank is
+    ``2 n (p-1)/p`` — the bus-bandwidth-optimal volume the baseline's
+    analytic model assumes.
+    """
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x
+    flat, n, chunk = _flatten_pad(x, p)
+    chunks = flat.reshape(p, chunk)
+    r = lax.axis_index(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def rs_step(s, ch):
+        # Send chunk (r - s) mod p rightward; add incoming (r - s - 1) mod p.
+        send_idx = (r - s) % p
+        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
+        recv = lax.ppermute(buf, axis, perm)
+        recv_idx = (r - s - 1) % p
+        updated = lax.dynamic_index_in_dim(ch, recv_idx, keepdims=False) + recv
+        return lax.dynamic_update_index_in_dim(ch, updated, recv_idx, 0)
+
+    chunks = lax.fori_loop(0, p - 1, rs_step, chunks)
+
+    def ag_step(s, ch):
+        # After reduce-scatter, rank r owns fully-reduced chunk (r + 1) mod p.
+        send_idx = (r + 1 - s) % p
+        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
+        recv = lax.ppermute(buf, axis, perm)
+        recv_idx = (r - s) % p
+        return lax.dynamic_update_index_in_dim(ch, recv, recv_idx, 0)
+
+    chunks = lax.fori_loop(0, p - 1, ag_step, chunks)
+    return chunks.reshape(-1)[:n].reshape(x.shape)
+
+
+def ring_broadcast(
+    x,
+    root: int = 0,
+    axis: str = "mpi",
+    axis_size: Optional[int] = None,
+    num_chunks: Optional[int] = None,
+):
+    """Pipelined chunked ring broadcast (the reference's large-message path,
+    ``lib/detail/collectives.cpp:58-113``): the buffer is cut into chunks
+    that flow around the ring, so steady-state bandwidth is one full buffer
+    regardless of p. ``num_chunks`` defaults to p (plan-style)."""
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x
+    k = num_chunks or p
+    flat, n, chunk = _flatten_pad(x, k)
+    chunks = flat.reshape(k, chunk)
+    r = lax.axis_index(axis)
+    d = (r - root) % p  # distance downstream from root
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def step(t, ch):
+        # At step t a rank at distance d forwards chunk (t - d), which it
+        # received at step t-1; its left neighbor (distance d-1) is sending
+        # chunk (t - d + 1), so that is what arrives this step. Chunk c thus
+        # reaches distance d at step c + d - 1, giving k + p - 2 total steps.
+        send_idx = jnp.clip(t - d, 0, k - 1)
+        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
+        recv = lax.ppermute(buf, axis, perm)
+        recv_idx = t - d + 1
+        valid = (d > 0) & (recv_idx >= 0) & (recv_idx < k)
+        rclip = jnp.clip(recv_idx, 0, k - 1)
+        cur = lax.dynamic_index_in_dim(ch, rclip, keepdims=False)
+        return lax.dynamic_update_index_in_dim(
+            ch, jnp.where(valid, recv, cur), rclip, 0
+        )
+
+    chunks = lax.fori_loop(0, k + p - 2, step, chunks)
+    return chunks.reshape(-1)[:n].reshape(x.shape)
+
+
+def tree_broadcast(x, root: int = 0, axis: str = "mpi", axis_size: Optional[int] = None):
+    """Binomial-tree (recursive doubling) broadcast — the reference's
+    small/medium-message path (``lib/detail/collectives.cpp:27-56``):
+    log2(p) steps, each doubling the set of ranks that hold the data."""
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x
+    r = lax.axis_index(axis)
+    d = (r - root) % p  # tree is rooted at distance 0
+    steps = max(1, math.ceil(math.log2(p)))
+    for k in range(steps):
+        span = 1 << k
+        perm = []
+        for i in range(p):
+            di = (i - root) % p
+            if di < span and di + span < p:
+                perm.append((i, (i + span) % p))
+        if not perm:
+            break
+        recv = lax.ppermute(x, axis, perm)
+        receives = (d >= span) & (d < 2 * span)
+        x = jnp.where(receives, recv, x)
+    return x
+
+
+def ring_reduce(x, root: int = 0, axis: str = "mpi", axis_size: Optional[int] = None):
+    """Reduce-to-root as ring reduce-scatter + gather-to-root; implemented as
+    ring_allreduce masked to root (the reference reduces via the same plan)."""
+    total = ring_allreduce(x, axis=axis, axis_size=axis_size)
+    idx = lax.axis_index(axis)
+    return jnp.where(idx == root, total, x)
+
+
+def ring_allgather(x, axis: str = "mpi", dim: int = -1, axis_size: Optional[int] = None):
+    """All-gather as p-1 ring forwarding steps (same plan as the allgather
+    phase of the ring allreduce)."""
+    p = axis_size or lax.axis_size(axis)
+    if dim < 0:
+        dim = x.ndim + dim
+    if p == 1:
+        return x
+    r = lax.axis_index(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    # Accumulate into a leading rank dimension, then reassemble along dim.
+    out = jnp.zeros((p,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, r, 0)
+
+    def step(s, carry):
+        buf, out = carry
+        recv = lax.ppermute(buf, axis, perm)
+        src = (r - s - 1) % p
+        out = lax.dynamic_update_index_in_dim(out, recv, src, 0)
+        return recv, out
+
+    _, out = lax.fori_loop(0, p - 1, step, (x, out))
+    # [p, ...] -> concatenate blocks along `dim`.
+    moved = jnp.moveaxis(out, 0, dim)  # [..., p, dim_size, ...]
+    new_shape = x.shape[:dim] + (p * x.shape[dim],) + x.shape[dim + 1 :]
+    return moved.reshape(new_shape)

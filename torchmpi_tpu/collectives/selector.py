@@ -1,0 +1,126 @@
+"""Collective backend selector.
+
+Analog of ``mpi.collectiveSelector`` (``torchmpi/init.lua:463-555``): a
+preference table keyed on ``(platform, single/multi node, sync/async,
+collective)`` listing backend implementations in preference order; the first
+*available* one wins. The reference's axes were
+``[cpu|gpu][singlenode|multinode][sync|async]`` with backends
+``{p2p, nccl, gloo, mpi}``; here the platforms are ``cpu|tpu`` and the
+backends are:
+
+- ``xla``  — fused XLA collective (the vendor path; NCCL/MPI analog)
+- ``ring`` — custom chunked ``ppermute`` ring (the custom-p2p analog)
+- ``pallas`` — Pallas ICI-RDMA ring kernels (TPU only; the cudaIPC analog)
+
+``collective_availability()`` renders the availability matrix string like the
+reference's introspection dump (``init.lua:557-660``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+
+_COLLECTIVES = ("broadcast", "reduce", "allreduce", "sendreceive", "allgather")
+
+
+def _pallas_available() -> bool:
+    try:
+        from ..ops import ring_kernels
+
+        return ring_kernels.available()
+    except Exception:
+        return False
+
+
+def backend_availability() -> Dict[str, bool]:
+    platform = jax.devices()[0].platform
+    return {
+        "xla": True,
+        "ring": True,
+        "pallas": platform == "tpu" and _pallas_available(),
+    }
+
+
+# Preference order per (platform, nodes, mode, collective).
+# Mirrors the reference's choices in spirit: single-node sync allreduce
+# prefers the custom ring (its cudaIPC ring beat NCCL, README.md:104-106);
+# small sizes are rerouted to 'xla' by eager.op_route either way.
+_DEFAULT: Dict[str, Dict[str, Dict[str, Dict[str, List[str]]]]] = {
+    "cpu": {
+        "singlenode": {
+            "sync": {c: ["xla", "ring"] for c in _COLLECTIVES},
+            "async": {c: ["xla", "ring"] for c in _COLLECTIVES},
+        },
+        "multinode": {
+            "sync": {c: ["xla", "ring"] for c in _COLLECTIVES},
+            "async": {c: ["xla", "ring"] for c in _COLLECTIVES},
+        },
+    },
+    "tpu": {
+        "singlenode": {
+            "sync": {
+                "broadcast": ["pallas", "ring", "xla"],
+                "reduce": ["ring", "xla"],
+                "allreduce": ["pallas", "ring", "xla"],
+                "sendreceive": ["xla", "ring"],
+                "allgather": ["xla", "ring"],
+            },
+            "async": {c: ["xla", "ring"] for c in _COLLECTIVES},
+        },
+        "multinode": {
+            # Cross-host (DCN) traffic: trust XLA's hierarchical lowering
+            # first, custom ring second (the staged/direct choice is a
+            # constant, like kUseStagedCollectives).
+            "sync": {c: ["xla", "ring"] for c in _COLLECTIVES},
+            "async": {c: ["xla", "ring"] for c in _COLLECTIVES},
+        },
+    },
+}
+
+
+class CollectiveSelector:
+    def __init__(self):
+        self.table = _DEFAULT
+
+    def select(
+        self,
+        collective: str,
+        platform: str = None,
+        multinode: bool = False,
+        mode: str = "sync",
+    ) -> str:
+        platform = platform or jax.devices()[0].platform
+        if platform not in ("cpu", "tpu"):
+            platform = "tpu"  # any accelerator takes the tpu table
+        nodes = "multinode" if multinode else "singlenode"
+        prefs = self.table[platform][nodes][mode][collective]
+        avail = backend_availability()
+        for b in prefs:
+            if avail.get(b):
+                return b
+        return "xla"
+
+    def describe(self) -> str:
+        avail = backend_availability()
+        lines = ["Backend availability: " + ", ".join(
+            f"{k}={'yes' if v else 'no'}" for k, v in avail.items()
+        )]
+        for platform, nodes_tbl in self.table.items():
+            for nodes, mode_tbl in nodes_tbl.items():
+                for mode, coll_tbl in mode_tbl.items():
+                    for coll, prefs in coll_tbl.items():
+                        chosen = self.select(coll, platform, nodes == "multinode", mode)
+                        lines.append(
+                            f"{platform}.{nodes}.{mode}.{coll}: "
+                            f"{' > '.join(prefs)} -> {chosen}"
+                        )
+        return "\n".join(lines)
+
+
+selector = CollectiveSelector()
+
+
+def collective_availability() -> str:
+    return selector.describe()

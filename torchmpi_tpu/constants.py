@@ -1,0 +1,178 @@
+"""Tunable communication constants with freeze semantics.
+
+TPU-native analog of the reference flag system (reference:
+``lib/constants.cpp:130-352``, ``lib/constants.h:40-80``): every knob that
+shapes collective routing lives here as a mutable module-level value behind
+typed ``get_*`` / ``set_*`` accessors, and the whole table can be frozen
+(``freeze_constants``) after which every setter raises — mirroring the
+reference's ``immutableConstants`` flag which each setter checks
+(``lib/constants.cpp:163-168``).
+
+The *meaning* of the knobs is re-grounded in TPU/XLA terms:
+
+- "staged vs direct" cross-node transport (``kUseStagedCollectives``) becomes a
+  choice between host-staged DCN transfers and direct ICI/DCN device
+  collectives.
+- small-message cutoffs switch from the bandwidth-optimised chunked ring to the
+  latency path (a single fused XLA collective), the analog of falling back to
+  stock MPI below ``kSmallBcastSize``/``kSmallAllreduceSize``
+  (``lib/constants.cpp:136-141``).
+- chunk min/max sizes bound the per-step message size of the custom ring
+  backends (``lib/constants.cpp:142-145``).
+- thread-pool sizes control the host-side async offload pools used by the
+  parameter server and host collectives (``lib/constants.cpp:152-155``).
+
+When the native runtime extension is available the values are mirrored into it
+so C++ code observes the same configuration (see ``runtime/native.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Any, Callable, Dict, List
+
+
+class FrozenConstantsError(RuntimeError):
+    """Raised when mutating a constant after :func:`freeze_constants`."""
+
+
+@dataclass
+class _Constants:
+    # --- transport/routing policy (reference lib/constants.cpp:132-141) ---
+    # Stage cross-slice (DCN) traffic through host memory instead of direct
+    # device collectives (analog of staged-via-pinned-CPU vs GDR-direct).
+    use_staged_collectives: bool = False
+    # Compose collectives hierarchically (intra-slice ICI ring/reduce + inter
+    # -slice exchange) instead of one flat collective over all devices.
+    use_hierarchical_collectives: bool = True
+    # Build cartesian communicators (equal-size intra groups linked peer-to-
+    # peer) rather than tree communicators (roots only) when splitting.
+    use_cartesian_communicator: bool = True
+
+    # --- small-message latency cutoffs, in ELEMENTS (constants.cpp:136-141) ---
+    small_broadcast_size_cpu: int = 1 << 13
+    small_allreduce_size_cpu: int = 1 << 16
+    small_broadcast_size_tpu: int = 1 << 13
+    small_allreduce_size_tpu: int = 1 << 16
+
+    # --- ring chunking, in BYTES (constants.cpp:142-147) ---
+    min_buffer_size_cpu: int = 1 << 17
+    max_buffer_size_cpu: int = 1 << 20
+    min_buffer_size_tpu: int = 1 << 17
+    max_buffer_size_tpu: int = 1 << 20
+    # tree -> pipelined broadcast switch-over, in bytes (constants.cpp:146-147)
+    broadcast_size_tree_based_cpu: int = 1 << 22
+    broadcast_size_tree_based_tpu: int = 1 << 22
+
+    # --- in-flight buffering (constants.cpp:149-150, constants.h:77-78) ---
+    num_buffers_per_collective_cpu: int = 3
+    num_buffers_per_collective_tpu: int = 3
+    max_num_buffers_per_collective: int = 16
+
+    # --- host-side async offload pools (constants.cpp:152-155) ---
+    collective_thread_pool_size: int = 4
+    parameterserver_thread_pool_size: int = 4
+    num_async_collectives_in_flight: int = 1 << 20
+    num_async_parameterservers_in_flight: int = 1 << 20
+
+    # --- TPU-specific additions (no reference analog; new capability) ---
+    # Preferred backend order is handled by the selector; this picks the
+    # default custom-ring implementation: 'ppermute' (pure XLA, portable) or
+    # 'pallas' (ICI RDMA kernels, TPU only).
+    ring_implementation: str = "ppermute"
+    # Donate input buffers to eager collectives (strict in-place semantics,
+    # like the reference's inplace collective variants). Off by default:
+    # JAX users expect value semantics, and donation invalidates reuse of
+    # the input array.
+    donate_eager_buffers: bool = False
+
+
+_frozen = False
+_lock = threading.Lock()
+_values = _Constants()
+_listeners: List[Callable[[str, Any], None]] = []
+
+_FIELD_NAMES = {f.name for f in fields(_Constants)}
+
+
+def register_listener(fn: Callable[[str, Any], None]) -> None:
+    """Register a callback invoked as ``fn(name, value)`` on every set.
+
+    Used by the native runtime bridge to mirror values into C++ (the analog of
+    the reference's C getter/setter pairs being the single source of truth).
+    Callbacks run outside the module lock so a listener may itself call
+    :func:`set` without deadlocking.
+    """
+    with _lock:
+        _listeners.append(fn)
+        replay = [(f.name, getattr(_values, f.name)) for f in fields(_Constants)]
+    for name, value in replay:
+        fn(name, value)
+
+
+def get(name: str) -> Any:
+    if name not in _FIELD_NAMES:
+        raise KeyError(f"unknown constant: {name}")
+    return getattr(_values, name)
+
+
+def set(name: str, value: Any) -> None:  # noqa: A001 - parity with C setters
+    if name not in _FIELD_NAMES:
+        raise KeyError(f"unknown constant: {name}")
+    with _lock:
+        if _frozen:
+            raise FrozenConstantsError(
+                f"constants are frozen; cannot set {name!r} (freeze_constants "
+                "was called, matching the reference immutableConstants check)"
+            )
+        current = getattr(_values, name)
+        # bool is a subclass of int: require the bool-ness of value and field
+        # to match exactly, then ordinary type compatibility.
+        if isinstance(current, bool) != isinstance(value, bool) or not isinstance(
+            value, type(current)
+        ):
+            raise TypeError(
+                f"constant {name!r} expects {type(current).__name__}, "
+                f"got {type(value).__name__}"
+            )
+        setattr(_values, name, value)
+        listeners = list(_listeners)
+    for fn in listeners:
+        fn(name, value)
+
+
+def freeze_constants() -> None:
+    """Permanently freeze the table (reference ``lib/constants.cpp:130,163``)."""
+    global _frozen
+    with _lock:
+        _frozen = True
+
+
+def constants_frozen() -> bool:
+    return _frozen
+
+
+def snapshot() -> Dict[str, Any]:
+    """A plain-dict view of every constant (for introspection dumps)."""
+    return {f.name: getattr(_values, f.name) for f in fields(_Constants)}
+
+
+def _reset_for_tests() -> None:
+    """Unfreeze and restore defaults. Test-only."""
+    global _frozen, _values
+    with _lock:
+        _frozen = False
+        _values = _Constants()
+        listeners = list(_listeners)
+        replay = [(f.name, getattr(_values, f.name)) for f in fields(_Constants)]
+    for fn in listeners:
+        for name, value in replay:
+            fn(name, value)
+
+
+def __getattr__(name: str):
+    # Allow `constants.small_allreduce_size_tpu` style reads.
+    if name in _FIELD_NAMES:
+        return getattr(_values, name)
+    raise AttributeError(name)

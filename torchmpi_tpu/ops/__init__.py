@@ -1,0 +1,1 @@
+"""Pallas TPU kernels: fused reduction, ring collectives over ICI RDMA."""

@@ -1,0 +1,8 @@
+"""Pallas ICI-RDMA ring collectives (cudaIPC-ring analog). Placeholder:
+implemented in ops/ring_kernels once the XLA paths are green."""
+
+from __future__ import annotations
+
+
+def available() -> bool:
+    return False

@@ -1,0 +1,25 @@
+"""Named shard update rules (reference ``lib/parameterserver.cpp:119-213``):
+``zero`` / ``copy`` / ``add`` applied server-side to the local shard."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _rule_zero(shard: np.ndarray, incoming: np.ndarray) -> None:
+    shard[...] = 0
+
+
+def _rule_copy(shard: np.ndarray, incoming: np.ndarray) -> None:
+    shard[...] = incoming
+
+
+def _rule_add(shard: np.ndarray, incoming: np.ndarray) -> None:
+    shard[...] += incoming
+
+
+UPDATE_RULES = {
+    "zero": _rule_zero,
+    "copy": _rule_copy,
+    "add": _rule_add,
+}

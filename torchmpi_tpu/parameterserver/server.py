@@ -1,0 +1,12 @@
+"""Sharded parameter server core (to be implemented; see SURVEY.md §7.5)."""
+
+from __future__ import annotations
+
+
+class ParameterServer:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("parameter server lands in a later milestone")
+
+
+def free_all() -> None:
+    pass

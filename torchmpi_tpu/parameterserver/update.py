@@ -1,0 +1,16 @@
+"""Update schedules (Downpour / EASGD) — land with the PS milestone."""
+
+from __future__ import annotations
+
+
+class Update:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("lands with the parameter-server milestone")
+
+
+class DownpourUpdate(Update):
+    pass
+
+
+class EASGDUpdate(Update):
+    pass

@@ -1,0 +1,12 @@
+from .communicator import Communicator, CommunicatorStack, split_by_keys
+from .handles import SyncHandle, handles, sync_all, wait
+
+__all__ = [
+    "Communicator",
+    "CommunicatorStack",
+    "split_by_keys",
+    "SyncHandle",
+    "handles",
+    "sync_all",
+    "wait",
+]

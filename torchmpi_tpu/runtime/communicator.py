@@ -1,0 +1,305 @@
+"""Hierarchical named communicator stack over JAX device meshes.
+
+TPU-native re-design of the reference's communicator machinery
+(``lib/torch_mpi.cpp:38-41,233-306`` and ``lib/resources.cpp:187-350``):
+
+- The reference builds a stack of ``Communicator``s, each created by
+  Allgathering a per-rank *key string*, sorting by ``(key, rank)``, and
+  ``MPI_Comm_split``-ing ranks with equal keys into *intra* groups; a second
+  split links same-intra-rank peers across groups (*cartesian*, requires all
+  groups equal-sized — ``resources.cpp:266-280``) or group roots only
+  (*tree*) into the *inter* communicator.
+- Here, a :class:`Communicator` is a named, ordered grouping of JAX devices.
+  "Rank" is a *device rank*: the index of a device in the communicator's
+  device list. Key-splitting groups devices (not processes) so a single
+  controller can express the same hierarchical topologies the reference builds
+  with one process per GPU; under multi-controller JAX the same construction
+  runs unchanged over the global device list.
+- The intra × inter structure materialises as a 2-D
+  :class:`jax.sharding.Mesh` with axes ``('inter', 'intra')`` when cartesian;
+  non-cartesian (ragged) splits keep per-group 1-D meshes plus a roots mesh,
+  exactly the tree topology of the reference.
+
+The stack itself (push / current level / collective span) mirrors
+``mainThreadCommunicators`` + ``setCollectiveSpan``
+(``lib/torch_mpi.cpp:38-41,84-90``).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import constants
+
+KeySpec = Union[Sequence[str], Callable[[int], str]]
+
+
+class CommunicatorError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class _Member:
+    """Per-device placement inside a communicator (one reference rank)."""
+
+    global_rank: int  # rank in the communicator this was split from
+    intra_group: int  # which key-group this device landed in
+    intra_rank: int  # rank within the key-group
+    inter_rank: int  # rank in the inter communicator (-1 if not a member)
+
+
+class Communicator:
+    """One level of the hierarchical communicator stack.
+
+    Construction follows ``resources.cpp:187-350``: stable-sort members by
+    ``(key, rank)``, group equal keys into intra groups, mark cartesian iff
+    every group has the same size (and cartesian mode is on), and form the
+    inter communicator from same-intra-rank peers (cartesian) or group roots
+    (tree).
+    """
+
+    def __init__(
+        self,
+        devices: Sequence[jax.Device],
+        keys: Optional[Sequence[str]] = None,
+        name: str = "global",
+        cartesian: Optional[bool] = None,
+    ):
+        if keys is None:
+            keys = [""] * len(devices)
+        if len(keys) != len(devices):
+            raise CommunicatorError(
+                f"got {len(keys)} keys for {len(devices)} devices"
+            )
+        for k in keys:
+            if len(k.encode()) >= 1024:
+                # reference: keys are fixed 1KB buffers (resources.cpp:203-213)
+                raise CommunicatorError("communicator key must be < 1024 bytes")
+        self.name = name
+        self._devices = list(devices)
+        self._keys = [str(k) for k in keys]
+
+        # Stable sort by (key, original rank) — resources.cpp:236-244.
+        order = sorted(range(len(devices)), key=lambda r: (self._keys[r], r))
+        groups: List[List[int]] = []
+        group_keys: List[str] = []
+        for r in order:
+            if not groups or self._keys[r] != group_keys[-1]:
+                groups.append([])
+                group_keys.append(self._keys[r])
+            groups[-1].append(r)
+        self._groups = groups
+        self._group_keys = group_keys
+
+        sizes = {len(g) for g in groups}
+        if cartesian is None:
+            cartesian = constants.get("use_cartesian_communicator")
+        # cartesian iff requested AND all intra groups equal size
+        # (resources.cpp:266-280).
+        self.cartesian = bool(cartesian) and len(sizes) == 1
+
+        self._members: List[_Member] = [None] * len(devices)  # type: ignore
+        for gi, g in enumerate(groups):
+            for ir, r in enumerate(g):
+                if self.cartesian:
+                    inter_rank = gi  # every device joins an inter ring of peers
+                else:
+                    inter_rank = gi if ir == 0 else -1  # roots only (tree)
+                self._members[r] = _Member(r, gi, ir, inter_rank)
+
+        # Mesh materialisation.
+        if self.cartesian:
+            arr = np.empty((len(groups), len(groups[0])), dtype=object)
+            for gi, g in enumerate(groups):
+                for ir, r in enumerate(g):
+                    arr[gi, ir] = self._devices[r]
+            self.mesh = Mesh(arr, ("inter", "intra"))
+            self.intra_meshes = [
+                Mesh(arr[gi : gi + 1, :].reshape(-1), ("intra",))
+                for gi in range(len(groups))
+            ]
+            self.inter_meshes = [
+                Mesh(arr[:, ir], ("inter",)) for ir in range(len(groups[0]))
+            ]
+        else:
+            self.mesh = None  # ragged: no single dense mesh exists
+            self.intra_meshes = [
+                Mesh(
+                    np.array([self._devices[r] for r in g], dtype=object),
+                    ("intra",),
+                )
+                for g in groups
+            ]
+            roots = [self._devices[g[0]] for g in groups]
+            self.inter_meshes = [Mesh(np.array(roots, dtype=object), ("inter",))]
+
+    # ------------------------------------------------------------------
+    # introspection (reference lib/torch_mpi.cpp:105-127,257-280)
+    # ------------------------------------------------------------------
+    @property
+    def devices(self) -> List[jax.Device]:
+        return list(self._devices)
+
+    @property
+    def size(self) -> int:
+        return len(self._devices)
+
+    @property
+    def num_intra_groups(self) -> int:
+        return len(self._groups)
+
+    def intra_size(self, group: int = 0) -> int:
+        return len(self._groups[group])
+
+    @property
+    def has_intra_collective(self) -> bool:
+        """True when intra groups have more than one member."""
+        return any(len(g) > 1 for g in self._groups)
+
+    @property
+    def has_inter_collective(self) -> bool:
+        return len(self._groups) > 1
+
+    def member(self, rank: int) -> _Member:
+        return self._members[rank]
+
+    def intra_rank_of(self, rank: int) -> int:
+        return self._members[rank].intra_rank
+
+    def inter_rank_of(self, rank: int) -> int:
+        return self._members[rank].inter_rank
+
+    def num_nodes(self) -> int:
+        """Distinct host processes spanned (``torch_mpi.cpp:321-350``).
+
+        The reference Allgathers hostnames and counts distinct values; the
+        JAX client already knows every device's owning process.
+        """
+        return len({d.process_index for d in self._devices})
+
+    def flat_mesh(self, axis_name: str = "mpi") -> Mesh:
+        """A 1-D mesh over all member devices in rank order."""
+        return Mesh(np.array(self._devices, dtype=object), (axis_name,))
+
+    def describe(self) -> str:
+        """Topology string (analog of the startup dump, init.lua:456-459)."""
+        lines = [
+            f"Communicator '{self.name}': size={self.size} "
+            f"groups={self.num_intra_groups} "
+            f"{'cartesian' if self.cartesian else 'tree'} "
+            f"nodes={self.num_nodes()}"
+        ]
+        for gi, g in enumerate(self._groups):
+            ids = ",".join(str(self._devices[r].id) for r in g)
+            lines.append(
+                f"  intra[{gi}] key={self._group_keys[gi]!r} devices=[{ids}]"
+            )
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return (
+            f"Communicator({self.name!r}, size={self.size}, "
+            f"groups={self.num_intra_groups}, cartesian={self.cartesian})"
+        )
+
+
+class CommunicatorStack:
+    """The mutable stack of communicators + collective span.
+
+    Mirrors ``mainThreadCommunicators`` and the ``(begin, end)`` collective
+    span cursor (``lib/torch_mpi.cpp:38-41,84-103``): collectives act on the
+    communicator at ``current`` (the span end), and hierarchical collectives
+    compose levels ``[span_begin, span_end]``.
+    """
+
+    def __init__(self, root: Communicator):
+        self._stack: List[Communicator] = [root]
+        self._span = (0, 0)
+        self._lock = threading.Lock()
+
+    # --- push/set (torch_mpi.cpp:251-268) ---
+    def push(self, comm: Communicator) -> int:
+        with self._lock:
+            self._stack.append(comm)
+            level = len(self._stack) - 1
+            self._span = (level, level)
+            return level
+
+    def set_current(self, level: int) -> None:
+        with self._lock:
+            if not 0 <= level < len(self._stack):
+                raise CommunicatorError(f"no communicator at level {level}")
+            self._span = (level, level)
+
+    def set_span(self, begin: int, end: int) -> None:
+        with self._lock:
+            if not (0 <= begin <= end < len(self._stack)):
+                raise CommunicatorError(
+                    f"invalid span ({begin}, {end}) for stack depth "
+                    f"{len(self._stack)}"
+                )
+            self._span = (begin, end)
+
+    @property
+    def span(self) -> Tuple[int, int]:
+        return self._span
+
+    @property
+    def current(self) -> Communicator:
+        return self._stack[self._span[1]]
+
+    @property
+    def depth(self) -> int:
+        return len(self._stack)
+
+    def at(self, level: int) -> Communicator:
+        return self._stack[level]
+
+    def names(self) -> List[str]:
+        return [c.name for c in self._stack]
+
+
+def split_by_keys(
+    parent: Communicator,
+    keys: KeySpec,
+    name: Optional[str] = None,
+    cartesian: Optional[bool] = None,
+) -> Communicator:
+    """Create a child communicator by key-splitting the parent's devices.
+
+    ``keys`` is either one key string per parent rank or a callable
+    ``rank -> key`` (the analog of each reference rank passing its own key to
+    ``torchmpi_push_communicator``, ``torch_mpi.cpp:251-255``). Devices with
+    equal keys form intra groups of the child.
+
+    The reference pushes splits of the *current intra* communicator
+    (``torch_mpi.cpp:75-79``), so a nested split subdivides existing groups
+    rather than regrouping across them. We express that by compounding each
+    key with the parent's group index: devices in different parent intra
+    groups can never share a child group.
+    """
+    if callable(keys):
+        key_list = [str(keys(r)) for r in range(parent.size)]
+    else:
+        key_list = [str(k) for k in keys]
+    if len(key_list) != parent.size:
+        raise CommunicatorError(
+            f"got {len(key_list)} keys for communicator of size {parent.size}"
+        )
+    if parent.num_intra_groups > 1:
+        key_list = [
+            f"{parent.member(r).intra_group:06d}|{k}"
+            for r, k in enumerate(key_list)
+        ]
+    return Communicator(
+        parent.devices,
+        key_list,
+        name=name or f"{parent.name}/split",
+        cartesian=cartesian,
+    )

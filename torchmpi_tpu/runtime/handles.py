@@ -1,0 +1,153 @@
+"""Opaque synchronization handles for async work.
+
+Analog of the reference ``SynchronizationHandle`` — a tagged union over
+{MPI request index, thread-pool future index, CUDA stream} with a single
+``wait`` entry point (``lib/resources.h:230-253``,
+``lib/resources.cpp:1173-1242``). On TPU the three variants map to:
+
+- ``arrays``: in-flight ``jax.Array`` results — XLA dispatch is already
+  asynchronous, so the "stream" variant becomes the arrays themselves and
+  ``wait`` is ``jax.block_until_ready`` on them.
+- ``future``: a ``concurrent.futures.Future`` from the host offload pools
+  (parameter-server clients, host-staged collectives) — the thread-pool
+  future variant.
+- ``native``: an integer request id owned by the C++ runtime extension.
+
+Handles are registered in a table and identified by index, preserving the
+reference's C-API shape where handles cross the FFI boundary by pointer and
+are freed by ``wait`` (``resources.cpp:1212-1242``).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+class SyncHandle:
+    """Tagged union: exactly one of arrays / future / native_id is set."""
+
+    __slots__ = ("arrays", "future", "native_id", "_result", "_done", "_table_index")
+
+    def __init__(
+        self,
+        arrays: Optional[Any] = None,
+        future: Optional[Future] = None,
+        native_id: Optional[int] = None,
+    ):
+        populated = sum(x is not None for x in (arrays, future, native_id))
+        if populated != 1:
+            raise ValueError(
+                "SyncHandle requires exactly one of arrays/future/native_id"
+            )
+        self.arrays = arrays
+        self.future = future
+        self.native_id = native_id
+        self._result = None
+        self._done = False
+        self._table_index = None
+
+    def wait(self) -> Any:
+        """Block until the work completes; returns the result (if any).
+
+        Idempotent, like the reference's ``wait`` which frees the slot and
+        turns subsequent waits into no-ops (``resources.cpp:1226-1242``).
+        """
+        if self._done:
+            return self._result
+        if self.arrays is not None:
+            self._result = jax.block_until_ready(self.arrays)
+        elif self.future is not None:
+            self._result = self.future.result()
+        else:
+            from . import native  # local import: extension is optional
+
+            native.wait_request(self.native_id)
+            self._result = None
+        self._done = True
+        if self._table_index is not None:
+            handles._discard(self._table_index)
+            self._table_index = None
+        return self._result
+
+    @property
+    def done(self) -> bool:
+        if self._done:
+            return True
+        if self.future is not None:
+            return self.future.done()
+        return False
+
+    def __repr__(self) -> str:
+        kind = (
+            "arrays"
+            if self.arrays is not None
+            else "future"
+            if self.future is not None
+            else f"native:{self.native_id}"
+        )
+        return f"SyncHandle<{kind}{', done' if self._done else ''}>"
+
+
+class _HandleTable:
+    """Index-addressed handle registry (reference ``resources.cpp:545-578``,
+    the MPI request table, and the future queues at ``:399-461``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: Dict[int, SyncHandle] = {}
+        self._next = 0
+
+    def register(self, handle: SyncHandle) -> int:
+        with self._lock:
+            idx = self._next
+            self._next += 1
+            self._handles[idx] = handle
+            handle._table_index = idx
+            return idx
+
+    def _discard(self, idx: int) -> None:
+        """Drop a handle that completed via a direct wait() call."""
+        with self._lock:
+            self._handles.pop(idx, None)
+
+    def wait_index(self, idx: int) -> Any:
+        with self._lock:
+            handle = self._handles.pop(idx, None)
+        if handle is None:
+            return None  # already waited: no-op, as in the reference
+        return handle.wait()
+
+    def sync_all(self) -> None:
+        """Drain every outstanding handle (``resources.cpp:463-481``)."""
+        with self._lock:
+            pending = list(self._handles.values())
+            self._handles.clear()
+        for h in pending:
+            h.wait()
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._handles)
+
+
+handles = _HandleTable()
+
+
+def wait(handle_or_index) -> Any:
+    """`mpi.syncHandle` equivalent: wait on a handle or a registry index."""
+    if isinstance(handle_or_index, SyncHandle):
+        return handle_or_index.wait()
+    if isinstance(handle_or_index, int):
+        return handles.wait_index(handle_or_index)
+    if handle_or_index is None:
+        return None
+    raise TypeError(f"cannot wait on {type(handle_or_index).__name__}")
+
+
+def sync_all() -> None:
+    handles.sync_all()

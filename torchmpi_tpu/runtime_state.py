@@ -1,0 +1,211 @@
+"""Global runtime state: the started flag and the communicator stack.
+
+Analog of the process-global state in ``lib/torch_mpi.cpp:38-51`` (the
+``mainThreadCommunicators`` vector and current cursor) plus the start/stop
+lifecycle (``torch_mpi.cpp:233-306``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+
+from . import constants
+from .runtime import pools
+from .runtime.communicator import (
+    Communicator,
+    CommunicatorStack,
+    KeySpec,
+    split_by_keys,
+)
+from .runtime.handles import sync_all
+
+_lock = threading.Lock()
+_stack: Optional[CommunicatorStack] = None
+_started = False
+
+
+class NotStartedError(RuntimeError):
+    pass
+
+
+def start(
+    with_tpu: Optional[bool] = None,
+    with_ici_groups: bool = True,
+    custom_communicator_init: Optional[Callable[[], None]] = None,
+    with_cartesian_communicator: Optional[bool] = None,
+    collective_communicator: Optional[tuple] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> None:
+    """Initialise the runtime (``MPI.start``, ``torchmpi/init.lua:31-100``).
+
+    - ``with_tpu`` — use accelerator devices (reference ``withCuda``); default
+      auto-detect. ``False`` forces CPU devices.
+    - ``with_ici_groups`` — build per-host/ICI-domain communicators and set a
+      two-level collective span, the analog of ``initPerNodeCommunicators``'s
+      "<hostname> cuda p2p group(...)" key + span (``init.lua:417-461``) with
+      the cudaIPC p2p-access probe replaced by process/slice locality.
+    - ``custom_communicator_init`` — callback run right after start, in which
+      user code may :func:`push_communicator` (``init.lua:84-91``).
+    - ``with_cartesian_communicator`` — cartesian vs tree mode, set *before*
+      building communicators (``init.lua:61-65``).
+    - ``collective_communicator`` — explicit ``(begin, end)`` span.
+    - ``devices`` — explicit device list (tests build synthetic topologies).
+    """
+    global _stack, _started
+    with _lock:
+        if _started:
+            raise RuntimeError("torchmpi_tpu.start() called twice")
+        if with_cartesian_communicator is not None:
+            constants.set(
+                "use_cartesian_communicator", bool(with_cartesian_communicator)
+            )
+        if devices is None:
+            if with_tpu is None:
+                devices = jax.devices()
+            elif with_tpu:
+                devices = jax.devices()
+                if devices[0].platform == "cpu":
+                    raise RuntimeError(
+                        "with_tpu=True but no accelerator devices present"
+                    )
+            else:
+                devices = jax.devices("cpu")
+        root = Communicator(list(devices), name="global")
+        _stack = CommunicatorStack(root)
+        _started = True
+
+    if custom_communicator_init is not None:
+        custom_communicator_init()
+
+    if with_ici_groups:
+        _init_per_node_communicators()
+
+    if collective_communicator is not None:
+        _stack.set_span(*collective_communicator)
+
+
+def _init_per_node_communicators() -> None:
+    """Push a per-host (ICI-domain) communicator level and set the 2-level
+    collective span — ``initPerNodeCommunicators`` (``init.lua:417-461``)."""
+    root = _stack.at(0)
+    if root.num_nodes() <= 1:
+        return  # single host: the global comm is already one ICI domain
+    keys = [f"host{d.process_index} ici group" for d in root.devices]
+    level = _stack.push(
+        split_by_keys(root, keys, name="per-node ici groups")
+    )
+    # span (level-1, level): hierarchical collectives compose the per-node
+    # intra groups with the cross-node inter comm (init.lua:445-446).
+    _stack.set_span(max(0, level - 1), level)
+
+
+def stop() -> None:
+    """Teardown (``torchmpi_stop``, ``torch_mpi.cpp:282-306``): drain async
+    work, stop parameter servers, free cached resources."""
+    global _stack, _started
+    if not _started:
+        return
+    sync_all()
+    from .parameterserver import free_all as _ps_free_all
+
+    _ps_free_all()
+    pools.shutdown_all()
+    with _lock:
+        _stack = None
+        _started = False
+
+
+def started() -> bool:
+    return _started
+
+
+def _require_stack() -> CommunicatorStack:
+    if _stack is None:
+        raise NotStartedError("call torchmpi_tpu.start() first")
+    return _stack
+
+
+def stack() -> CommunicatorStack:
+    return _require_stack()
+
+
+def current_communicator() -> Communicator:
+    return _require_stack().current
+
+
+def rank() -> int:
+    """Rank of this process's first device in the current communicator.
+
+    Ranks are *devices* (reference rank = one MPI process driving one GPU; the
+    TPU analog is one mesh position per chip). In single-controller mode one
+    process owns every rank, so ``rank()`` is 0 and per-rank data is expressed
+    as rank-stacked arrays rather than Python-level offsets; under
+    multi-controller JAX each process gets the global index of its first local
+    device, so ``rank() < size()`` and reference-style
+    ``offset = rank() * per_rank`` sharding work per process. See
+    ``local_ranks()`` for all ranks owned by this process.
+    """
+    comm = current_communicator()
+    pid = jax.process_index()
+    for i, d in enumerate(comm.devices):
+        if d.process_index == pid:
+            return i
+    return 0
+
+
+def local_ranks() -> List[int]:
+    """All ranks (device indices) of the current communicator owned by this
+    process."""
+    comm = current_communicator()
+    pid = jax.process_index()
+    return [i for i, d in enumerate(comm.devices) if d.process_index == pid]
+
+
+def size() -> int:
+    """Number of ranks (devices) in the current communicator."""
+    return current_communicator().size
+
+
+def num_processes() -> int:
+    return jax.process_count()
+
+
+def push_communicator(keys: KeySpec, name: Optional[str] = None) -> int:
+    """Split the *current* communicator by keys and push the result
+    (``torchmpi_push_communicator`` splits the current level's comm,
+    ``torch_mpi.cpp:75-79,251-255``), so keys are parent-local and nested
+    splits refine the existing topology. Returns the new level."""
+    st = _require_stack()
+    comm = split_by_keys(st.current, keys, name=name)
+    return st.push(comm)
+
+
+def set_communicator(level: int) -> None:
+    _require_stack().set_current(level)
+
+
+def set_collective_span(begin: int, end: int) -> None:
+    _require_stack().set_span(begin, end)
+
+
+def communicator_names() -> List[str]:
+    return _require_stack().names()
+
+
+def num_nodes_in_communicator(level: Optional[int] = None) -> int:
+    st = _require_stack()
+    comm = st.current if level is None else st.at(level)
+    return comm.num_nodes()
+
+
+def _reset_for_tests() -> None:
+    global _stack, _started
+    try:
+        stop()
+    except Exception:
+        pass
+    _stack = None
+    _started = False
