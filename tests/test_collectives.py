@@ -122,6 +122,18 @@ def test_sendreceive(backend):
             np.testing.assert_array_equal(out[r], r)
 
 
+def test_allgather_1d_stays_rank_stacked():
+    """One scalar per rank: output must be rank-stacked [p, p], composable
+    with further eager collectives."""
+    p = mpi.size()
+    g = mpi.allgather_tensor(jnp.arange(p, dtype=jnp.float32))
+    assert g.shape == (p, p)
+    np.testing.assert_array_equal(
+        np.asarray(g), np.tile(np.arange(p, dtype=np.float32)[None], (p, 1))
+    )
+    mpi.allreduce_tensor(g)  # composability
+
+
 def test_multidim_tensors():
     p = mpi.size()
     x = jnp.broadcast_to(

@@ -1,0 +1,170 @@
+"""NN-layer synchronization tests (reference ``torchmpi/nn.lua`` semantics +
+``test/blockSequential.lua`` partition checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import nn as mpinn
+from torchmpi_tpu.nn import GradientBuckets
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+
+
+def _stacked_tree(p, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "dense1": {
+            "kernel": jnp.asarray(rng.randn(p, 20, 30).astype(np.float32)),
+            "bias": jnp.asarray(rng.randn(p, 30).astype(np.float32)),
+        },
+        "dense2": {"kernel": jnp.asarray(rng.randn(p, 30, 7).astype(np.float32))},
+    }
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_synchronize_parameters_broadcast(fused):
+    p = mpi.size()
+    tree = _stacked_tree(p)
+    out = mpinn.synchronize_parameters(tree, fused=fused)
+    for leaf, src in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)
+    ):
+        expect = np.broadcast_to(np.asarray(src)[0:1], src.shape)
+        np.testing.assert_allclose(np.asarray(leaf), expect, rtol=1e-6)
+
+
+def test_synchronize_parameters_allreduce_mean():
+    p = mpi.size()
+    tree = _stacked_tree(p)
+    out = mpinn.synchronize_parameters(tree, with_allreduce=True)
+    for leaf, src in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)
+    ):
+        mean = np.asarray(src).mean(axis=0, keepdims=True)
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.broadcast_to(mean, src.shape), rtol=1e-5
+        )
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_synchronize_gradients_sum(fused):
+    """Reference semantics: SUM, not mean (nn.lua:49-56)."""
+    p = mpi.size()
+    tree = _stacked_tree(p, seed=1)
+    out = mpinn.synchronize_gradients(tree, fused=fused)
+    for leaf, src in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)
+    ):
+        total = np.asarray(src).sum(axis=0, keepdims=True)
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.broadcast_to(total, src.shape), rtol=1e-5
+        )
+
+
+def test_gradient_buckets_partition():
+    """Equal-parameter-count partitioning (BlockSequential.lua:29-89) in
+    reverse leaf order, every leaf in exactly one bucket."""
+    p = mpi.size()
+    tree = _stacked_tree(p)
+    buckets = GradientBuckets(tree, 2)
+    assert buckets.num_buckets == 2
+    all_leaves = sorted(i for b in buckets.buckets for i in b)
+    assert all_leaves == list(range(3))
+    # reverse order: bucket 0 holds the LAST leaves
+    assert max(buckets.buckets[0]) > min(buckets.buckets[-1])
+
+
+def test_gradient_buckets_async_roundtrip():
+    p = mpi.size()
+    tree = _stacked_tree(p, seed=2)
+    buckets = GradientBuckets(tree, 2)
+    handles = buckets.allreduce_async(tree)
+    assert len(handles) == 2
+    out = buckets.wait_and_unflatten(tree, handles)
+    for leaf, src in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)
+    ):
+        total = np.asarray(src).sum(axis=0, keepdims=True)
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.broadcast_to(total, src.shape), rtol=1e-5
+        )
+
+
+def test_bucket_count_clamped():
+    p = mpi.size()
+    tree = _stacked_tree(p)
+    assert GradientBuckets(tree, 100).num_buckets <= 3
+    assert GradientBuckets(tree, 1).num_buckets == 1
+
+
+def test_in_graph_bucketed_matches_fused():
+    """Bucketed psum must equal single-psum results exactly."""
+    p = mpi.size()
+    mesh = mpi.current_communicator().flat_mesh("mpi")
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.RandomState(3)
+    tree = {
+        "a": jnp.asarray(rng.randn(p * 2, 17).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(p * 2, 5).astype(np.float32)),
+    }
+    template = {"a": jnp.zeros((2, 17)), "b": jnp.zeros((2, 5))}
+    buckets = GradientBuckets(template, 2)
+
+    def fused(t):
+        return mpinn.in_graph_synchronize_gradients(t, "mpi", average=True)
+
+    def bucketed(t):
+        return mpinn.in_graph_synchronize_gradients_bucketed(
+            t, buckets, "mpi", average=True
+        )
+
+    run = lambda f: jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=P("mpi"), out_specs=P("mpi"), check_vma=False
+        )
+    )(tree)
+    out_f, out_b = run(fused), run(bucketed)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out_f), jax.tree_util.tree_leaves(out_b)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_fused_sync_preserves_integer_leaves():
+    """Fused sync must not round-trip int leaves through float32 (values
+    above 2^24 would corrupt)."""
+    p = mpi.size()
+    big = 2**24 + 1
+    tree = {
+        "w": jnp.ones((p, 3), jnp.float32),
+        "count": jnp.full((p, 2), big, jnp.int32),
+    }
+    out = mpinn.synchronize_parameters(tree)
+    assert out["count"].dtype == jnp.int32
+    assert int(np.asarray(out["count"])[0, 0]) == big
+    out2 = mpinn.synchronize_gradients({"n": jnp.full((p, 1), big, jnp.int64)})
+    assert int(np.asarray(out2["n"])[3, 0]) == big * p
+
+
+def test_check_with_allreduce_consistent():
+    p = mpi.size()
+    rng = np.random.RandomState(4)
+    local = rng.randn(50).astype(np.float32)
+    tree = {"w": jnp.asarray(np.tile(local[None], (p, 1)))}
+    mpinn.check_with_allreduce(tree)  # must not raise
+
+
+def test_check_with_allreduce_detects_desync():
+    p = mpi.size()
+    rng = np.random.RandomState(5)
+    vals = rng.randn(p, 50).astype(np.float32)  # every replica different
+    with pytest.raises(AssertionError, match="desync"):
+        mpinn.check_with_allreduce({"w": jnp.asarray(vals)})

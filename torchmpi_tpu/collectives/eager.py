@@ -196,6 +196,10 @@ def run(
     _check_rank_stacked(x, comm)
     if op in ("broadcast", "reduce") and not 0 <= root < comm.size:
         raise CollectiveArgumentError(f"root {root} out of range")
+    if op == "allgather" and x.ndim == 1:
+        # One scalar per rank: lift to [p, 1] so the output stays rank-stacked
+        # ([p, p]: every rank's block is the gathered vector).
+        x = x[:, None]
     platform = comm.devices[0].platform
     effective = backend
     if backend == "ring" and route_small:

@@ -1,0 +1,3 @@
+from .sgd import AllReduceSGDEngine
+
+__all__ = ["AllReduceSGDEngine"]

@@ -1,0 +1,19 @@
+from .mnist import (
+    LeNet,
+    LogisticRegression,
+    accuracy,
+    cross_entropy_loss,
+    init_params,
+    make_loss_fn,
+)
+from .mlp import MLP6
+
+__all__ = [
+    "LogisticRegression",
+    "LeNet",
+    "MLP6",
+    "cross_entropy_loss",
+    "accuracy",
+    "make_loss_fn",
+    "init_params",
+]

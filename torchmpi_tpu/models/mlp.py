@@ -1,0 +1,24 @@
+"""6-layer MLP — the reference's async-DP numerics test model
+(``test/async.lua:63-148`` compares sequential vs sync-DP vs async-DP wall
+time and gradient statistics on a 6-layer MLP)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as fnn
+import jax.numpy as jnp
+
+
+class MLP6(fnn.Module):
+    features: int = 256
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for _ in range(5):
+            x = fnn.Dense(self.features, dtype=self.dtype)(x)
+            x = fnn.relu(x)
+        return fnn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,76 @@
+"""MNIST model family (reference ``examples/mnist/mnist.lua`` workloads).
+
+The reference's end-to-end convergence target is a logistic regression
+(784→10, lr 0.2, batch 336/world-size, 5 epochs — BASELINE.md); its GPU
+examples use a small convnet. Both are provided as flax modules, TPU-shaped:
+bfloat16-friendly, channels-last, MXU-aligned hidden sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as fnn
+import jax
+import jax.numpy as jnp
+
+
+class LogisticRegression(fnn.Module):
+    """784 -> 10 linear softmax classifier (mnist_allreduce.lua's model)."""
+
+    num_classes: int = 10
+
+    @fnn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return fnn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class LeNet(fnn.Module):
+    """Small convnet in the spirit of the reference GPU examples; sized so
+    conv channels and dense width tile the MXU/VPU cleanly."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x):
+        # x: [B, 28, 28, 1] channels-last (TPU conv layout)
+        x = x.reshape((x.shape[0], 28, 28, 1)).astype(self.dtype)
+        x = fnn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = fnn.relu(x)
+        x = fnn.max_pool(x, (2, 2), strides=(2, 2))
+        x = fnn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = fnn.relu(x)
+        x = fnn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = fnn.Dense(256, dtype=self.dtype)(x)
+        x = fnn.relu(x)
+        x = fnn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def cross_entropy_loss(logits, labels) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def accuracy(logits, labels) -> jnp.ndarray:
+    return jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+
+
+def make_loss_fn(model: fnn.Module) -> Callable:
+    """loss_fn(params, batch) -> loss for the engine; batch = (x, y)."""
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x)
+        return cross_entropy_loss(logits, y)
+
+    return loss_fn
+
+
+def init_params(model: fnn.Module, input_shape: Tuple[int, ...], seed: int = 0):
+    rng = jax.random.PRNGKey(seed)
+    variables = model.init(rng, jnp.zeros(input_shape, jnp.float32))
+    return variables["params"]

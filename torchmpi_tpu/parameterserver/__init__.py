@@ -1,18 +1,8 @@
 """Host-side sharded parameter server (reference N10 + L6/L7)."""
 
-from __future__ import annotations
-
-
-def free_all() -> None:
-    """Free every live parameter server (called from stop())."""
-    from . import server
-
-    server.free_all()
-
-
-from .server import ParameterServer, free_all  # noqa: E402,F811
-from .rules import UPDATE_RULES  # noqa: E402
-from .update import DownpourUpdate, EASGDUpdate, Update  # noqa: E402
+from .rules import UPDATE_RULES
+from .server import ParameterServer, free_all
+from .update import DownpourUpdate, EASGDUpdate, Update
 
 __all__ = [
     "ParameterServer",

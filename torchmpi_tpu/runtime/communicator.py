@@ -77,13 +77,14 @@ class Communicator:
             raise CommunicatorError(
                 f"got {len(keys)} keys for {len(devices)} devices"
             )
+        keys = [str(k) for k in keys]
         for k in keys:
             if len(k.encode()) >= 1024:
                 # reference: keys are fixed 1KB buffers (resources.cpp:203-213)
                 raise CommunicatorError("communicator key must be < 1024 bytes")
         self.name = name
         self._devices = list(devices)
-        self._keys = [str(k) for k in keys]
+        self._keys = keys
 
         # Stable sort by (key, original rank) — resources.cpp:236-244.
         order = sorted(range(len(devices)), key=lambda r: (self._keys[r], r))

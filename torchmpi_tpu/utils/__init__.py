@@ -1,0 +1,3 @@
+from .data import DistributedIterator, load_mnist_idx, synthetic_mnist
+
+__all__ = ["DistributedIterator", "synthetic_mnist", "load_mnist_idx"]
