@@ -1,0 +1,117 @@
+"""Benchmark entry point (driver-run on real TPU hardware).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+North-star metric (BASELINE.json): MNIST AllReduceSGD samples/sec/chip.
+The reference publishes no absolute numbers (BASELINE.md) — its harness is
+the protocol (10 warmup + 10 timed, tester.lua:103-126). ``vs_baseline``
+is measured against the recorded first-light number in
+``bench_baseline.json`` (value 1.0 means parity with round-1's recording;
+higher is better). If that file is absent, vs_baseline is 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def main():
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    if platform == "cpu" and len(devices) == 1:
+        # Dev fallback: rebuild the backend as an 8-device virtual mesh so
+        # the bench still measures distributed training (XLA_FLAGS is read
+        # only at first backend creation, which jax.devices() above already
+        # triggered — reconfigure through the config API instead).
+        from jax.extend import backend as jeb
+
+        jeb.clear_backends()
+        jax.config.update("jax_num_cpu_devices", 8)
+        devices = jax.devices()
+
+    import numpy as np
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.engine import AllReduceSGDEngine
+    from torchmpi_tpu.models import LeNet, init_params, make_loss_fn
+    from torchmpi_tpu.utils import DistributedIterator, synthetic_mnist
+
+    mpi.start()
+    comm = mpi.current_communicator()
+    p = comm.size
+
+    (xtr, ytr), _ = synthetic_mnist(num_train=16384, num_test=1)
+    model = LeNet(dtype=__import__("jax.numpy", fromlist=["bfloat16"]).bfloat16)
+    params = init_params(model, (1, 28, 28))
+    engine = AllReduceSGDEngine(
+        make_loss_fn(model), params, optimizer=optax.sgd(0.05), mode="sync"
+    )
+
+    per_rank = 256  # large per-chip batch: keep the MXU busy
+    batch = per_rank * p
+    it = DistributedIterator(
+        xtr, ytr, batch, p, sharding=engine.batch_sharding, prefetch=2
+    )
+
+    # Warmup: compile + 10 steps (tester.lua: 10 warmup + 10 timed).
+    warm = iter(it)
+    for i, b in zip(range(10), warm):
+        engine.params, engine.opt_state, loss = engine._step_fn(
+            engine.params, engine.opt_state, engine._prepare_batch(b)
+        )
+    import jax
+
+    jax.block_until_ready(engine.params)
+
+    timed_steps = 0
+    t0 = time.perf_counter()
+    for _ in range(3):  # a few passes to get >= 10 timed steps
+        for b in it:
+            engine.params, engine.opt_state, loss = engine._step_fn(
+                engine.params, engine.opt_state, engine._prepare_batch(b)
+            )
+            timed_steps += 1
+        if timed_steps >= 30:
+            break
+    jax.block_until_ready(engine.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = timed_steps * batch / dt
+    value = samples_per_sec / p
+
+    baseline_file = Path(__file__).parent / "bench_baseline.json"
+    vs = 1.0
+    if baseline_file.exists():
+        try:
+            rec = json.loads(baseline_file.read_text())
+            key = f"{platform}"
+            if rec.get(key):
+                vs = value / float(rec[key])
+        except Exception:
+            pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "MNIST LeNet AllReduceSGD samples/sec/chip",
+                "value": round(value, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+    mpi.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,12 +32,21 @@ def _current_comm(comm: Optional[Communicator]) -> Communicator:
 def _dispatch(op, x, comm, mode, backend=None, **kw):
     comm = _current_comm(comm)
     if backend is None:
-        platform = comm.devices[0].platform
-        backend = selector.select(
-            op, platform, multinode=comm.num_nodes() > 1, mode=mode
-        )
-        if backend == "pallas":
-            backend = "ring"  # eager pallas path lands with ops/ring_kernels
+        # Selector decisions are invariant per (comm, op, mode): memoize on
+        # the communicator to keep eager launch overhead minimal (the
+        # reference's <50us async-launch budget).
+        cache = getattr(comm, "_selector_cache", None)
+        if cache is None:
+            cache = comm._selector_cache = {}
+        backend = cache.get((op, mode))
+        if backend is None:
+            platform = comm._devices[0].platform
+            backend = selector.select(
+                op, platform, multinode=comm.num_nodes() > 1, mode=mode
+            )
+            if backend == "pallas":
+                backend = "ring"  # eager pallas path: ops/ring_kernels
+            cache[(op, mode)] = backend
     if mode == "sync":
         return eager.run(op, x, comm, backend=backend, **kw)
     return eager.run_async(op, x, comm, backend=backend, **kw)

@@ -200,7 +200,7 @@ def run(
         # One scalar per rank: lift to [p, 1] so the output stays rank-stacked
         # ([p, p]: every rank's block is the gathered vector).
         x = x[:, None]
-    platform = comm.devices[0].platform
+    platform = comm._devices[0].platform
     effective = backend
     if backend == "ring" and route_small:
         effective = op_route(op, _nelem_per_rank(x), platform)

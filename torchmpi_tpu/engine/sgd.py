@@ -69,6 +69,7 @@ class AllReduceSGDEngine:
         profile_dir: Optional[str] = None,
         profile_window: tuple = (3, 8),
         hooks: Optional[Dict[str, Callable]] = None,
+        batch_format: str = "auto",
     ):
         if comm is None:
             from .. import runtime_state
@@ -76,6 +77,11 @@ class AllReduceSGDEngine:
             comm = runtime_state.current_communicator()
         if mode not in ("sync", "async"):
             raise ValueError(f"mode must be 'sync' or 'async', got {mode!r}")
+        if batch_format not in ("auto", "flat", "stacked"):
+            raise ValueError(
+                f"batch_format must be auto/flat/stacked, got {batch_format!r}"
+            )
+        self.batch_format = batch_format
         self.comm = comm
         self.loss_fn = loss_fn
         self.optimizer = optimizer or optax.sgd(0.2)
@@ -230,13 +236,17 @@ class AllReduceSGDEngine:
     def _prepare_batch(self, batch):
         """Accept [p, B, ...] rank-stacked or [p*B, ...] flat batches.
 
-        A batch is treated as rank-stacked only when *every* leaf has
-        ndim >= 2 and leading axis == comm.size (a flat batch always has at
-        least one leaf — labels — of lower rank, so mixed-shape batches are
-        classified consistently rather than per-leaf)."""
+        In 'auto' mode a batch is treated as rank-stacked when *every* leaf
+        has ndim >= 2 and leading axis == comm.size. That heuristic is
+        ambiguous for flat batches of exactly p samples whose every leaf is
+        >= 2-D (e.g. one-hot labels [p, C]); pass ``batch_format='flat'`` or
+        ``'stacked'`` to the engine to make the contract explicit."""
         p = self.comm.size
         leaves = jax.tree_util.tree_leaves(batch)
-        stacked = all(a.ndim >= 2 and a.shape[0] == p for a in leaves)
+        if self.batch_format == "auto":
+            stacked = all(a.ndim >= 2 and a.shape[0] == p for a in leaves)
+        else:
+            stacked = self.batch_format == "stacked"
         if stacked:
             batch = jax.tree_util.tree_map(
                 lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),

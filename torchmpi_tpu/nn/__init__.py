@@ -170,7 +170,7 @@ class GradientBuckets:
         return [leaves[i] for i in self.buckets[b]]
 
     def allreduce_async(
-        self, grads, comm: Optional[Communicator] = None, average: bool = False
+        self, grads, comm: Optional[Communicator] = None
     ) -> List[SyncHandle]:
         """Launch one async fused allreduce per bucket; returns handles in
         launch order (wait them in reverse, ``nn.lua:207-212``)."""
@@ -184,12 +184,19 @@ class GradientBuckets:
             handles.append(
                 collectives.async_.allreduce_tensor(buf, comm=comm)
             )
-        self._avg = (average, p)
         return handles
 
-    def wait_and_unflatten(self, grads, handles: Sequence[SyncHandle]):
-        """Wait handles (reverse order) and scatter results back to tree."""
-        average, p = getattr(self, "_avg", (False, 1))
+    def wait_and_unflatten(
+        self,
+        grads,
+        handles: Sequence[SyncHandle],
+        average: bool = False,
+        comm: Optional[Communicator] = None,
+    ):
+        """Wait handles (reverse order) and scatter results back to tree.
+        ``average`` must be passed explicitly (same value the caller wants
+        applied to the summed buffers)."""
+        p = _comm(comm).size
         results = [None] * len(handles)
         for b in range(len(handles) - 1, -1, -1):
             results[b] = handles[b].wait()
@@ -224,20 +231,26 @@ def in_graph_synchronize_gradients(grads, axis: str = "mpi", average: bool = Tru
 def in_graph_synchronize_gradients_bucketed(
     grads, buckets: GradientBuckets, axis: str = "mpi", average: bool = True
 ):
-    """Bucketed psum: one collective per bucket so XLA's async-collective
-    scheduler can overlap buckets with remaining compute — the in-graph
-    analog of registerAsyncMPIBackward's per-layer overlap."""
+    """Bucketed psum: one collective per bucket (per dtype) so XLA's
+    async-collective scheduler can overlap buckets with remaining compute —
+    the in-graph analog of registerAsyncMPIBackward's per-layer overlap.
+    Leaves are grouped by dtype within each bucket so mixed-precision
+    gradients (bf16 weights + f32 norms) keep their dtypes exactly."""
     leaves = list(tree_util.tree_leaves(grads))
     n = lax.psum(1, axis) if average else 1
     for b in range(buckets.num_buckets):
-        flats = [jnp.reshape(leaves[i], (-1,)) for i in buckets.buckets[b]]
-        splits = np.cumsum([f.shape[0] for f in flats])[:-1]
-        buf = lax.psum(jnp.concatenate(flats), axis)
-        if average:
-            buf = buf / n
-        parts = jnp.split(buf, splits)
-        for part, i in zip(parts, buckets.buckets[b]):
-            leaves[i] = jnp.reshape(part, buckets.shapes[i])
+        by_dtype: Dict = {}
+        for i in buckets.buckets[b]:
+            by_dtype.setdefault(jnp.result_type(leaves[i]), []).append(i)
+        for dtype, idxs in by_dtype.items():
+            flats = [jnp.reshape(leaves[i], (-1,)) for i in idxs]
+            splits = np.cumsum([f.shape[0] for f in flats])[:-1]
+            buf = lax.psum(jnp.concatenate(flats), axis)
+            if average:
+                buf = (buf / n).astype(dtype)
+            parts = jnp.split(buf, splits)
+            for part, i in zip(parts, idxs):
+                leaves[i] = jnp.reshape(part, leaves[i].shape)
     return tree_util.tree_unflatten(buckets.treedef, leaves)
 
 

@@ -180,9 +180,12 @@ class Communicator:
         """Distinct host processes spanned (``torch_mpi.cpp:321-350``).
 
         The reference Allgathers hostnames and counts distinct values; the
-        JAX client already knows every device's owning process.
+        JAX client already knows every device's owning process. Memoized:
+        the device list is immutable.
         """
-        return len({d.process_index for d in self._devices})
+        if not hasattr(self, "_num_nodes"):
+            self._num_nodes = len({d.process_index for d in self._devices})
+        return self._num_nodes
 
     def flat_mesh(self, axis_name: str = "mpi") -> Mesh:
         """A 1-D mesh over all member devices in rank order."""

@@ -77,14 +77,22 @@ def start(
         _stack = CommunicatorStack(root)
         _started = True
 
-    if custom_communicator_init is not None:
-        custom_communicator_init()
+    try:
+        if custom_communicator_init is not None:
+            custom_communicator_init()
 
-    if with_ici_groups:
-        _init_per_node_communicators()
+        if with_ici_groups:
+            _init_per_node_communicators()
 
-    if collective_communicator is not None:
-        _stack.set_span(*collective_communicator)
+        if collective_communicator is not None:
+            _stack.set_span(*collective_communicator)
+    except BaseException:
+        # Roll back so a corrected retry of start() works instead of
+        # hitting 'called twice' on a half-initialized runtime.
+        with _lock:
+            _stack = None
+            _started = False
+        raise
 
 
 def _init_per_node_communicators() -> None:

@@ -235,4 +235,6 @@ class DistributedIterator:
                     q.get_nowait()
                 except queue.Empty:
                     break
-        self._epoch += 1
+            # advance the shuffle epoch even when the consumer stops early,
+            # so a max-steps loop never replays the same permutation
+            self._epoch += 1
