@@ -69,6 +69,7 @@ def main():
         engine.params, engine.opt_state, loss = engine._step_fn(
             engine.params, engine.opt_state, engine._prepare_batch(b)
         )
+    warm.close()  # stop the warmup producer; don't let it shadow the timing
     import jax
 
     jax.block_until_ready(engine.params)

@@ -1,0 +1,192 @@
+"""MNIST parameter-server training — parity with
+``examples/mnist/mnist_parameterserver_{downpour,easgd,dsgd,easgd_dataparallel}.lua``.
+
+Each rank runs *local* SGD on its own replica (replicas diverge between
+integrations — the defining property of async PS training) while the chosen
+Update schedule exchanges state with the sharded host-side parameter server.
+
+Run: python examples/mnist_parameterserver.py --variant downpour|easgd|dsgd
+       [--dataparallel] [--cpu-mesh N] [--epochs 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--variant", default="downpour", choices=["downpour", "easgd", "dsgd"]
+    )
+    ap.add_argument(
+        "--dataparallel",
+        action="store_true",
+        help="hierarchical PS x DP: DP groups of 2 with grad allreduce "
+        "(mnist_parameterserver_easgd_dataparallel.lua)",
+    )
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--batch", type=int, default=336)
+    ap.add_argument("--tau", type=int, default=10, help="updateFrequency")
+    ap.add_argument("--init-delay", type=int, default=20)
+    ap.add_argument("--beta", type=float, default=0.9)
+    ap.add_argument("--cpu-mesh", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.cpu_mesh:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import nn as mpinn
+    from torchmpi_tpu.models import (
+        LogisticRegression,
+        accuracy,
+        init_params,
+        make_loss_fn,
+    )
+    from torchmpi_tpu.parameterserver import (
+        DownpourUpdate,
+        EASGDUpdate,
+        synchronize_gradients_with_parameterserver,
+    )
+    from torchmpi_tpu.utils import DistributedIterator, synthetic_mnist
+
+    mpi.start()
+    comm = mpi.current_communicator()
+    p = comm.size
+    dp_level = None
+    if args.dataparallel:
+        dp_level = mpi.push_communicator(lambda r: str(r // 2), name="dp")
+        mpi.set_communicator(0)
+    print(f"ranks={p} variant={args.variant} dp={bool(dp_level)}")
+
+    (xtr, ytr), (xte, yte) = synthetic_mnist(seed=args.seed)
+    model = LogisticRegression()
+    loss_fn = make_loss_fn(model)
+    params0 = init_params(model, (1, 28, 28), seed=args.seed)
+    # rank-stacked replicas, identical at t=0
+    params = jax.tree_util.tree_map(
+        lambda w: jnp.broadcast_to(w[None], (p,) + w.shape), params0
+    )
+    mesh = comm.flat_mesh("mpi")
+    stacked_sharding = NamedSharding(mesh, P("mpi"))
+    params = jax.device_put(params, stacked_sharding)
+
+    # Per-rank local SGD step: params sharded per rank, NO cross-rank sync.
+    def local_step(params, x, y):
+        def per_rank_loss(pblock):
+            flat = jax.tree_util.tree_map(lambda a: a[0], pblock)
+            return loss_fn(flat, (x[0], y[0]))
+
+        loss, grads = jax.value_and_grad(per_rank_loss)(params)
+        new_params = jax.tree_util.tree_map(
+            lambda w, g: w - args.lr * g, params, grads
+        )
+        return new_params, grads, jnp.reshape(loss, (1,))
+
+    step_fn = jax.jit(
+        jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P("mpi"), P("mpi"), P("mpi")),
+            out_specs=(P("mpi"), P("mpi"), P("mpi")),
+            check_vma=False,
+        )
+    )
+
+    update = None
+    if args.variant == "downpour":
+        # scale by -lr/p: the server sums contributions from p ranks
+        update = DownpourUpdate(
+            local_update=lambda t: (-args.lr / p) * t,
+            send_frequency=1,
+            update_frequency=args.tau,
+            init_delay=args.init_delay,
+            comm=comm,
+            dataparallel_level=dp_level,
+        )
+    elif args.variant == "easgd":
+        update = EASGDUpdate(
+            beta=args.beta,
+            update_frequency=args.tau,
+            init_delay=args.init_delay,
+            comm=comm,
+            dataparallel_level=dp_level,
+        )
+
+    batch = max(1, args.batch // p) * p
+    it = DistributedIterator(
+        xtr, ytr, batch, p, seed=args.seed, sharding=stacked_sharding
+    )
+    ps_group = None
+    t = 0
+    for epoch in range(args.epochs):
+        for x, y in it:
+            params, grads, loss = step_fn(params, x, y)
+            if dp_level is not None:
+                # allreduce gradients within DP groups first
+                # (easgd_dataparallel.lua:69-71) — here the local step already
+                # applied them, so sync the replicas within each group instead
+                from torchmpi_tpu.collectives.eager import run_group_broadcast
+
+                dp = mpi.stack().at(dp_level)
+                params = jax.tree_util.tree_map(
+                    lambda w: run_group_broadcast(w, dp, root=0), params
+                )
+            if args.variant == "dsgd":
+                # synchronous DSGD: PS-mediated gradient averaging replaces
+                # local divergence; re-apply averaged grads to keep replicas
+                # identical (dsgd.lua trains with the PS-averaged gradient)
+                synced, ps_group = synchronize_gradients_with_parameterserver(
+                    grads, ps_group, comm=comm
+                )
+                params = jax.tree_util.tree_map(
+                    lambda w, g_loc, g_avg: w + args.lr * g_loc - args.lr * g_avg,
+                    params,
+                    grads,
+                    synced,
+                )
+            elif update is not None:
+                params = update.update(t, params, grads)
+            t += 1
+        print(f"epoch {epoch}: loss={float(jnp.mean(loss)):.4f}")
+
+    # evaluate rank 0's replica (post-integration replicas agree)
+    final = jax.tree_util.tree_map(lambda w: np.asarray(w)[0], params)
+    logits = model.apply({"params": final}, xte)
+    acc = float(accuracy(logits, yte))
+    # replica spread diagnostic
+    spread = max(
+        float(np.abs(np.asarray(w) - np.asarray(w)[0]).max())
+        for w in jax.tree_util.tree_leaves(params)
+    )
+    print(f"final: test_acc={acc:.4f} replica_spread={spread:.2e}")
+    if update is not None:
+        update.free()
+    if ps_group is not None:
+        ps_group.free()
+    mpi.stop()
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,310 @@
+"""Parameter-server tests.
+
+Mirrors ``test/parameterserver.lua``: init defaults, multi-dim tensors,
+zero/copy/add rules in loops with the documented handle/barrier reasoning
+(lua:23-183), plus the Update schedules and the mixed PS x DP composition
+(``test/hierarchical_communicators.lua`` + ``update.lua:82-113``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.parameterserver import (
+    DownpourUpdate,
+    EASGDUpdate,
+    ParameterServer,
+    PSGroup,
+    shard_range,
+    synchronize_gradients_with_parameterserver,
+)
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+    from torchmpi_tpu.parameterserver import free_all
+
+    free_all()
+
+
+def test_shard_range_uniform():
+    """getRange parity (parameterserver.cpp:282-294): full coverage, no
+    overlap, remainder spread over the first shards."""
+    for n, p in [(100, 8), (7, 8), (8, 8), (1000, 7), (3, 2)]:
+        ranges = [shard_range(n, p, r) for r in range(p)]
+        assert ranges[0][0] == 0 and ranges[-1][1] == n
+        for (a, b), (c, d) in zip(ranges, ranges[1:]):
+            assert b == c
+        sizes = [e - s for s, e in ranges]
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_init_from_value_and_receive():
+    v = np.arange(100, dtype=np.float32).reshape(10, 10)
+    ps = ParameterServer(v)
+    out = ps.receive().wait()
+    np.testing.assert_array_equal(out, v)
+    ps.free()
+
+
+def test_rule_zero_copy_add_loop():
+    """The lua test's 100-iteration rule loop (parameterserver.lua:88-150):
+    zero -> add from every rank -> value == sum of contributions."""
+    p = mpi.size()
+    n = 67  # not divisible by 8: exercises ragged shards
+    ps = ParameterServer(np.zeros(n, np.float32))
+    for it in range(20):
+        ps.send(np.zeros(n, np.float32), rule="zero").wait()
+        hs = [
+            ps.send(np.full(n, float(r + 1), np.float32), rule="add", client=r)
+            for r in range(p)
+        ]
+        for h in hs:
+            h.wait()
+        out = ps.receive().wait()
+        np.testing.assert_array_equal(out, p * (p + 1) / 2)
+    ps.free()
+
+
+def test_rule_copy_last_writer_wins():
+    ps = ParameterServer(np.zeros(10, np.float32))
+    ps.send(np.full(10, 3.0), rule="copy").wait()
+    np.testing.assert_array_equal(ps.receive().wait(), 3.0)
+    ps.free()
+
+
+def test_scaled_send():
+    """Downpour's localUpdate -lr scaling via the scale argument."""
+    ps = ParameterServer(np.zeros(10, np.float32))
+    ps.send(np.ones(10), rule="add", scale=-0.5).wait()
+    np.testing.assert_allclose(ps.receive().wait(), -0.5)
+    ps.free()
+
+
+def test_multidim_tensors():
+    v = np.random.RandomState(0).randn(4, 5, 6).astype(np.float32)
+    ps = ParameterServer(v)
+    ps.send(np.ones_like(v), rule="add").wait()
+    np.testing.assert_allclose(ps.receive().wait(), v + 1, rtol=1e-6)
+    ps.free()
+
+
+def test_unknown_rule_rejected():
+    ps = ParameterServer(np.zeros(4, np.float32))
+    with pytest.raises(KeyError):
+        ps.send(np.ones(4), rule="multiply")
+    ps.free()
+
+
+def test_send_after_free_rejected():
+    ps = ParameterServer(np.zeros(4, np.float32))
+    ps.free()
+    with pytest.raises(RuntimeError):
+        ps.send(np.ones(4))
+
+
+def test_wrong_size_rejected():
+    ps = ParameterServer(np.zeros(4, np.float32))
+    with pytest.raises(ValueError):
+        ps.send(np.ones(5))
+    ps.free()
+
+
+def test_async_handles_overlap():
+    """Sends are async (thread-pool futures); handles complete with the
+    server-applied guarantee (the Ssend happens-before)."""
+    p = mpi.size()
+    ps = ParameterServer(np.zeros(1 << 14, np.float32))
+    hs = [ps.send(np.ones(1 << 14), rule="add", client=r) for r in range(p)]
+    assert all(isinstance(h, mpi.SyncHandle) for h in hs)
+    for h in hs:
+        h.wait()
+    np.testing.assert_array_equal(ps.receive().wait(), p)
+    ps.free()
+
+
+# ---------------------------------------------------------------------------
+# PSGroup + DSGD
+# ---------------------------------------------------------------------------
+
+
+def _stacked(p, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "a": jnp.asarray(rng.randn(p, 11).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(p, 3, 4).astype(np.float32)),
+    }
+
+
+def test_psgroup_roundtrip():
+    p = mpi.size()
+    tree = _stacked(p)
+    grp = PSGroup(tree)
+    center = grp.receive_full()
+    # initialised from rank 0's replica
+    np.testing.assert_allclose(center["a"], np.asarray(tree["a"])[0], rtol=1e-6)
+    grp.free()
+
+
+def test_dsgd_equals_allreduce():
+    """DSGD through the PS must equal an averaged allreduce."""
+    p = mpi.size()
+    tree = _stacked(p, seed=3)
+    synced, grp = synchronize_gradients_with_parameterserver(tree)
+    for name in ("a", "b"):
+        expect = np.asarray(tree[name]).mean(axis=0)
+        got = np.asarray(synced[name])
+        for r in range(p):
+            np.testing.assert_allclose(got[r], expect, rtol=1e-5)
+    # group reuse across steps (cache.parameterServers analog)
+    synced2, grp2 = synchronize_gradients_with_parameterserver(tree, grp)
+    assert grp2 is grp
+    grp.free()
+
+
+# ---------------------------------------------------------------------------
+# Update schedules
+# ---------------------------------------------------------------------------
+
+
+def test_downpour_schedule():
+    """Downpour semantics: center accumulates scaled gradient sums; replicas
+    adopt the center at integration steps."""
+    p = mpi.size()
+    params = {"w": jnp.zeros((p, 8), jnp.float32)}
+    lr = 0.1
+    upd = DownpourUpdate(
+        local_update=lambda t: -lr * t,
+        send_frequency=1,
+        update_frequency=2,
+        init_delay=1,
+        prefetch=0,
+    )
+    ones = {"w": jnp.ones((p, 8), jnp.float32)}
+    # steps 0..5 with constant gradient 1
+    for step in range(6):
+        params = upd.update(step, params, ones)
+    # gradient units accumulate every step from step 0 (like the reference's
+    # tensorReferences); sends at steps 2,3,4,5 deliver 3+1+1+1 = 6 units,
+    # each unit adding sum_r(-lr * 1) = -p*lr to the center
+    center = upd.ps.receive_full()["w"]
+    units = 6
+    np.testing.assert_allclose(center, -lr * p * units, rtol=1e-5)
+    # integration happened at step 3 and 5 (init_delay + k*update_frequency)
+    assert np.allclose(np.asarray(params["w"]), np.asarray(params["w"])[0])
+    upd.free()
+
+
+def test_easgd_moves_toward_center():
+    p = mpi.size()
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(p, 6).astype(np.float32)
+    params = {"w": jnp.asarray(w0)}
+    upd = EASGDUpdate(beta=0.9, update_frequency=1, init_delay=0, prefetch=0)
+    zeros = {"w": jnp.zeros((p, 6), jnp.float32)}
+    params1 = upd.update(0, params, zeros)  # shard at step 0
+    params2 = upd.update(1, params1, zeros)  # first integration
+    alpha = 0.9 / p
+    center0 = w0[0]  # init from rank 0
+    expect = w0 + alpha * (center0[None] - w0)
+    np.testing.assert_allclose(np.asarray(params2["w"]), expect, rtol=1e-5)
+    # the elastic differences -alpha*(center - x_old) were sent with 'add'
+    # in the same tick ("we send immediately after integrating"): the center
+    # moves toward the replicas
+    for h in upd.handles_send:
+        h.wait()
+    center = upd.ps.receive_full()["w"]
+    np.testing.assert_allclose(
+        center, center0 - alpha * (center0[None] - w0).sum(axis=0), rtol=1e-4
+    )
+    upd.free()
+
+
+def test_prefetch_distance_schedule():
+    """prefetch > 0: the first integration precedes the first prefetch
+    (update.lua counter arithmetic); integrate falls back to a synchronous
+    fetch instead of crashing."""
+    p = mpi.size()
+    upd = DownpourUpdate(
+        local_update=lambda t: t,
+        send_frequency=1,
+        update_frequency=5,
+        prefetch=2,
+        init_delay=0,
+    )
+    params = {"w": jnp.zeros((p, 4), jnp.float32)}
+    ones = {"w": jnp.ones((p, 4), jnp.float32)}
+    for step in range(16):
+        params = upd.update(step, params, ones)
+    upd.free()
+
+
+def test_free_with_pending_send_never_hangs():
+    ps = ParameterServer(np.zeros(8, np.float32))
+    h = ps.send(np.ones(8), rule="add")
+    ps.free()
+    h.wait()  # must complete (applied or failed), never hang
+
+
+def test_update_prefetch_validation():
+    with pytest.raises(ValueError):
+        DownpourUpdate(update_frequency=5, prefetch=9)
+
+
+def test_mixed_ps_dataparallel():
+    """PS over sharding comm x DP groups: only DP roots integrate, then the
+    integrated params broadcast within each DP group
+    (update.lua:82-113, mnist_parameterserver_easgd_dataparallel.lua)."""
+    p = mpi.size()
+    # DP groups of 2: ranks {0,1},{2,3},{4,5},{6,7}; roots 0,2,4,6
+    dp_level = mpi.push_communicator(lambda r: str(r // 2), name="dp")
+    mpi.set_communicator(0)
+    params = {"w": jnp.zeros((p, 4), jnp.float32)}
+    upd = DownpourUpdate(
+        local_update=lambda t: t,
+        send_frequency=1,
+        update_frequency=1,
+        init_delay=0,
+        prefetch=0,
+        sharding_level=0,
+        dataparallel_level=dp_level,
+    )
+    ones = {"w": jnp.ones((p, 4), jnp.float32)}
+    params = upd.update(0, params, ones)  # shard (center = 0)
+    params = upd.update(1, params, ones)  # fetch+integrate, then send
+    w = np.asarray(params["w"])
+    # all replicas within each dp group identical (root integrated the
+    # center fetched at integration time = 0, then broadcast to its group)
+    for g in range(p // 2):
+        np.testing.assert_array_equal(w[2 * g], w[2 * g + 1])
+    np.testing.assert_array_equal(w, 0)
+    # the same-tick send lands after integration: accumulated 2 gradient
+    # units x p ranks x 1.0 now sit on the center
+    center = upd.ps.receive_full()["w"]
+    np.testing.assert_allclose(center, 2.0 * p, rtol=1e-5)
+    upd.free()
+
+
+def test_group_broadcast_eager_op():
+    from torchmpi_tpu.collectives.eager import run_group_broadcast
+
+    p = mpi.size()
+    mpi.push_communicator(lambda r: str(r // 4), name="halves")
+    comm = mpi.current_communicator()
+    x = jnp.arange(p, dtype=jnp.float32)[:, None] * jnp.ones((1, 5))
+    out = np.asarray(run_group_broadcast(x, comm, root=0))
+    # group {0..3} root 0, group {4..7} root 4
+    np.testing.assert_array_equal(out[:4], 0)
+    np.testing.assert_array_equal(out[4:], 4)
+
+
+def test_stop_frees_parameter_servers():
+    ps = ParameterServer(np.zeros(4, np.float32))
+    mpi.stop()
+    # global server thread stopped; instance freed via shutdown
+    from torchmpi_tpu.parameterserver.server import _server
+
+    assert _server._thread is None or not _server._thread.is_alive()

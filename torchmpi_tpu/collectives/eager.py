@@ -241,6 +241,49 @@ def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
     return h
 
 
+def run_group_broadcast(x, comm: Communicator, root: int = 0):
+    """Broadcast within each *intra group* of ``comm`` from the member with
+    intra rank ``root`` — the hierarchical building block of mixed
+    PS × data-parallel updates (``update.lua:104-112``) and of the
+    reference's non-cartesian hierarchical allreduce's final intra
+    broadcast (``collectives_cuda.cpp:569-579``).
+
+    Works for cartesian and ragged (tree) communicators alike: the source
+    map rank -> group-root is a static permutation, so the op lowers to a
+    cross-device gather.
+    """
+    x = jnp.asarray(x)
+    _check_rank_stacked(x, comm)
+    cache = _resource_cache(comm)
+    key = ("_group_bcast", root, tuple(x.shape), jnp.result_type(x))
+    fn = cache.get(key)
+    if fn is None:
+        groups: dict = {}
+        for r in range(comm.size):
+            m = comm.member(r)
+            groups.setdefault(m.intra_group, {})[m.intra_rank] = r
+        src = np.zeros((comm.size,), np.int32)
+        for r in range(comm.size):
+            g = groups[comm.member(r).intra_group]
+            if root not in g:
+                raise CollectiveArgumentError(
+                    f"intra root {root} out of range for group of size {len(g)}"
+                )
+            src[r] = g[root]
+        sharding = _rank_sharding(comm, x.ndim)
+        idx = jnp.asarray(src)
+        fn = jax.jit(
+            lambda a: jax.lax.with_sharding_constraint(
+                jnp.take(a, idx, axis=0), sharding
+            )
+        )
+        cache[key] = fn
+    sharding = _rank_sharding(comm, x.ndim)
+    if getattr(x, "sharding", None) != sharding:
+        x = jax.device_put(x, sharding)
+    return fn(x)
+
+
 def barrier(comm: Communicator) -> None:
     """Device barrier over the communicator (``torch_mpi.cpp:270-280``)."""
     cache = _resource_cache(comm)

@@ -184,6 +184,9 @@ class GradientBuckets:
             handles.append(
                 collectives.async_.allreduce_tensor(buf, comm=comm)
             )
+        # Remember which communicator these collectives ran on so the
+        # averaging divisor in wait_and_unflatten defaults correctly.
+        self._launch_comm = comm
         return handles
 
     def wait_and_unflatten(
@@ -194,8 +197,10 @@ class GradientBuckets:
         comm: Optional[Communicator] = None,
     ):
         """Wait handles (reverse order) and scatter results back to tree.
-        ``average`` must be passed explicitly (same value the caller wants
-        applied to the summed buffers)."""
+        ``average`` must be passed explicitly; the divisor defaults to the
+        communicator the matching allreduce_async launched on."""
+        if comm is None:
+            comm = getattr(self, "_launch_comm", None)
         p = _comm(comm).size
         results = [None] * len(handles)
         for b in range(len(handles) - 1, -1, -1):

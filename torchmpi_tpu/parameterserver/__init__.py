@@ -1,14 +1,18 @@
 """Host-side sharded parameter server (reference N10 + L6/L7)."""
 
 from .rules import UPDATE_RULES
-from .server import ParameterServer, free_all
+from .server import ParameterServer, free_all, shard_range
+from .tensors import PSGroup, synchronize_gradients_with_parameterserver
 from .update import DownpourUpdate, EASGDUpdate, Update
 
 __all__ = [
     "ParameterServer",
+    "PSGroup",
     "free_all",
+    "shard_range",
     "UPDATE_RULES",
     "Update",
     "DownpourUpdate",
     "EASGDUpdate",
+    "synchronize_gradients_with_parameterserver",
 ]

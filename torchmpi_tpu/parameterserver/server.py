@@ -1,12 +1,366 @@
-"""Sharded parameter server core (to be implemented; see SURVEY.md §7.5)."""
+"""Sharded parameter server: host-memory shards + async client protocol.
+
+TPU-native re-design of ``lib/parameterserver.cpp`` (N10). The reference
+shards each tensor uniformly over the communicator's processes; every rank
+mallocs its shard, clients Isend a *rule name* then Ssend each server its
+slice, a single global polling thread (100µs cadence) receives chunks and
+applies named update rules, and 1-byte *triggers* request shards back
+(``parameterserver.cpp:296-541,641-663``).
+
+Here the shards are host (CPU RAM) numpy buffers on the TPU VM — exactly
+where the reference keeps them (GPU tensors were staged through pinned CPU
+buffers anyway). The wire protocol is preserved over a transport
+abstraction:
+
+- ``update`` messages carry (client, rule name, shard slice) — the
+  Isend-rule + Ssend-slice pair, with completion events giving the same
+  happens-before the reference built from Ssend semantics
+  (``parameterserver.cpp:339-347``).
+- ``trigger`` messages carry a reply future the server fulfils with the
+  current shard (the 1-byte trigger + Ssend-back protocol,
+  ``parameterserver.cpp:356-400,500-539``).
+- One **global server thread** polls every live instance's mailboxes at
+  100µs cadence (``launchParameterServer``, ``parameterserver.cpp:641-663``).
+- Client send/receive are offloaded to the parameter-server thread pool and
+  return :class:`SyncHandle` futures (``resources.cpp:399-434``).
+
+The in-process transport serves single-controller JAX, where every rank
+(device) is driven by this process; a multi-controller deployment plugs a
+socket transport into the same mailbox interface (messages are already
+numpy-serializable).
+
+Tag namespace parity: messages are segregated per PS instance id, the
+analog of ``instance * kSentinelTag + {rule,clientChunk,serverChunk,
+trigger}`` (``parameterserver.cpp:296-301``).
+"""
 
 from __future__ import annotations
 
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.communicator import Communicator
+from ..runtime.handles import SyncHandle
+from ..runtime.pools import parameterserver_pool
+from .rules import UPDATE_RULES
+
+_POLL_INTERVAL_S = 100e-6  # the reference server's 100us scan cadence
+
+
+def shard_range(n: int, size: int, rank: int) -> Tuple[int, int]:
+    """Uniform shard [start, end) of an n-element tensor for ``rank`` of
+    ``size`` (``getRange``, ``parameterserver.cpp:282-294``); the first
+    ``n % size`` shards take one extra element."""
+    base, extra = divmod(n, size)
+    start = rank * base + min(rank, extra)
+    return start, start + base + (1 if rank < extra else 0)
+
+
+@dataclass
+class _Message:
+    kind: str  # 'update' | 'trigger'
+    client: int
+    rule: Optional[str] = None
+    payload: Optional[np.ndarray] = None
+    done: Optional[threading.Event] = None  # update: server-applied event
+    reply: Optional[Future] = None  # trigger: fulfilled with shard copy
+
+
+class _Instance:
+    """Server-side state of one ParameterServer: per-rank shards + mailboxes."""
+
+    def __init__(self, instance_id: int, full: np.ndarray, size: int):
+        self.id = instance_id
+        self.shape = full.shape
+        self.dtype = full.dtype
+        self.size = size
+        flat = full.reshape(-1)
+        self.shards: List[np.ndarray] = []
+        self.ranges: List[Tuple[int, int]] = []
+        for r in range(size):
+            s, e = shard_range(flat.shape[0], size, r)
+            self.ranges.append((s, e))
+            self.shards.append(flat[s:e].copy())
+        self.mailboxes: List[deque] = [deque() for _ in range(size)]
+        self.locks = [threading.Lock() for _ in range(size)]
+        self.freed = False
+
+    def post(self, server_rank: int, msg: _Message) -> None:
+        with self.locks[server_rank]:
+            if self.freed:
+                # Never strand a waiter on a freed instance: complete the
+                # event / fail the reply instead of queueing into a mailbox
+                # nobody will ever serve.
+                if msg.done is not None:
+                    msg.done.set()
+                if msg.reply is not None:
+                    msg.reply.set_exception(
+                        RuntimeError("parameter server freed")
+                    )
+                return
+            self.mailboxes[server_rank].append(msg)
+
+    def serve_once(self) -> bool:
+        """Drain every mailbox once; returns True if any work was done
+        (``serverReceive``, ``parameterserver.cpp:404-541``)."""
+        worked = False
+        for r in range(self.size):
+            while True:
+                with self.locks[r]:
+                    if not self.mailboxes[r]:
+                        break
+                    msg = self.mailboxes[r].popleft()
+                worked = True
+                if msg.kind == "update":
+                    rule = UPDATE_RULES.get(msg.rule)
+                    if rule is None:
+                        if msg.done:
+                            msg.done.set()
+                        raise KeyError(f"unknown update rule {msg.rule!r}")
+                    rule(self.shards[r], msg.payload)
+                    if msg.done:
+                        msg.done.set()
+                elif msg.kind == "trigger":
+                    msg.reply.set_result(self.shards[r].copy())
+        return worked
+
+
+class _GlobalServer:
+    """The single polling thread scanning all PS instances
+    (``launchParameterServer``, ``parameterserver.cpp:641-663``).
+
+    Concurrency invariant: update rules are applied ONLY by the polling
+    thread — or inline by :meth:`shutdown`/:meth:`unregister` strictly after
+    that thread has exited — so two threads never mutate the same shard.
+    Freed instances are moved to a *doomed* list that the polling thread
+    drains (serving what already arrived, failing stragglers) so no client
+    ever blocks on a message nobody will serve.
+    """
+
+    def __init__(self):
+        self._instances: Dict[int, _Instance] = {}
+        self._doomed: List[_Instance] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._terminate = threading.Event()
+        self._ids = itertools.count()
+
+    def register(self, full: np.ndarray, size: int) -> _Instance:
+        with self._lock:
+            inst = _Instance(next(self._ids), full, size)
+            self._instances[inst.id] = inst
+            if self._thread is None or not self._thread.is_alive():
+                self._terminate.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, name="tm-ps-server", daemon=True
+                )
+                self._thread.start()
+            return inst
+
+    @staticmethod
+    def _drain(inst: _Instance) -> None:
+        """Serve what arrived, then fail any racing stragglers."""
+        inst.freed = True  # post() auto-completes everything from here on
+        inst.serve_once()
+        for r in range(inst.size):
+            with inst.locks[r]:
+                while inst.mailboxes[r]:
+                    msg = inst.mailboxes[r].popleft()
+                    if msg.done is not None:
+                        msg.done.set()
+                    if msg.reply is not None:
+                        msg.reply.set_exception(
+                            RuntimeError("parameter server freed")
+                        )
+
+    def unregister(self, inst: _Instance) -> None:
+        inst.freed = True  # immediate: send()/receive() reject from now on
+        with self._lock:
+            self._instances.pop(inst.id, None)
+            thread_live = (
+                self._thread is not None
+                and self._thread.is_alive()
+                and not self._terminate.is_set()
+            )
+            if thread_live:
+                self._doomed.append(inst)  # polling thread drains it
+            if not self._instances:
+                self._terminate.set()
+        if not thread_live:
+            self._drain(inst)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                doomed = self._doomed
+                self._doomed = []
+                instances = list(self._instances.values())
+                stop = self._terminate.is_set() and not doomed
+                if stop and self._thread is threading.current_thread():
+                    # mark dead under the lock so a concurrent register()
+                    # spawns a fresh thread instead of relying on this one
+                    self._thread = None
+            if stop:
+                return
+            worked = bool(doomed)
+            for inst in doomed:
+                self._drain(inst)
+            for inst in instances:
+                worked |= inst.serve_once()
+            if not worked and not self._terminate.is_set():
+                time.sleep(_POLL_INTERVAL_S)
+
+    def shutdown(self):
+        """Stop serving: join the polling thread, then drain everything
+        (``torchmpi_stop``'s setTerminateParameterServerThread + join,
+        ``torch_mpi.cpp:287-292``). Draining happens strictly after the
+        join so no rule is ever applied by two threads; in-flight client
+        ops are completed or failed, never stranded — dropping them would
+        deadlock the thread-pool shutdown that follows in ``stop()``."""
+        with self._lock:
+            self._doomed.extend(self._instances.values())
+            self._instances.clear()
+            self._terminate.set()
+            thread = self._thread
+            self._thread = None
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5)
+        # Inline drain of anything the thread didn't get to (thread already
+        # dead, or join timed out — in the latter degenerate case stragglers
+        # are at least failed rather than stranded).
+        with self._lock:
+            doomed = self._doomed
+            self._doomed = []
+        for inst in doomed:
+            self._drain(inst)
+
+
+_server = _GlobalServer()
+
 
 class ParameterServer:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("parameter server lands in a later milestone")
+    """One sharded tensor distributed over a communicator's ranks.
+
+    ``init`` is a collective wrapped in barriers in the reference
+    (``parameterserver.cpp:677-745``); here construction registers the
+    instance with the global server atomically.
+
+    Clients are communicator ranks. ``send``/``receive`` are asynchronous
+    (offloaded to the PS thread pool) and return :class:`SyncHandle`s.
+    """
+
+    def __init__(
+        self,
+        initial_value,
+        comm: Optional[Communicator] = None,
+    ):
+        if comm is None:
+            from .. import runtime_state
+
+            comm = runtime_state.current_communicator()
+        self.comm = comm
+        full = np.asarray(initial_value)
+        if full.dtype not in (np.float32, np.float64):
+            # reference instantiates Float/Double only
+            full = full.astype(np.float32)
+        self._inst = _server.register(full, comm.size)
+        self.shape = full.shape
+        self.dtype = full.dtype
+
+    # ------------------------------------------------------------------
+    def send(
+        self,
+        values,
+        rule: str = "add",
+        client: int = 0,
+        scale: Optional[float] = None,
+    ) -> SyncHandle:
+        """Apply ``rule`` with this client's ``values`` to every shard
+        (``clientSend``, ``parameterserver.cpp:309-353``). The handle
+        completes when all servers have *applied* the update (the Ssend
+        happens-before guarantee, strengthened from receive-started to
+        applied)."""
+        if rule not in UPDATE_RULES:
+            raise KeyError(
+                f"unknown update rule {rule!r} (have {sorted(UPDATE_RULES)})"
+            )
+        if self._inst.freed:
+            raise RuntimeError("parameter server already freed")
+        flat = np.asarray(values, dtype=self.dtype).reshape(-1)
+        if flat.shape[0] != int(np.prod(self.shape)):
+            raise ValueError(
+                f"send expects {int(np.prod(self.shape))} elements, got "
+                f"{flat.shape[0]}"
+            )
+        if scale is not None:
+            flat = flat * self.dtype.type(scale)
+
+        inst = self._inst
+
+        def do_send():
+            events = []
+            for r in range(inst.size):
+                s, e = inst.ranges[r]
+                ev = threading.Event()
+                inst.post(
+                    r,
+                    _Message(
+                        "update",
+                        client=client,
+                        rule=rule,
+                        payload=flat[s:e].copy(),
+                        done=ev,
+                    ),
+                )
+                events.append(ev)
+            for ev in events:
+                ev.wait()
+
+        return SyncHandle(future=parameterserver_pool.submit(do_send))
+
+    def receive(self, client: int = 0) -> SyncHandle:
+        """Fetch the full tensor: trigger every server, assemble shards
+        (``clientReceive``, ``parameterserver.cpp:356-400``). ``wait()``
+        returns the assembled ndarray."""
+        if self._inst.freed:
+            raise RuntimeError("parameter server already freed")
+        inst = self._inst
+        shape, dtype = self.shape, self.dtype
+
+        def do_receive():
+            replies = []
+            for r in range(inst.size):
+                f: Future = Future()
+                inst.post(r, _Message("trigger", client=client, reply=f))
+                replies.append(f)
+            out = np.empty((int(np.prod(shape)),), dtype)
+            for r, f in enumerate(replies):
+                s, e = inst.ranges[r]
+                out[s:e] = f.result()
+            return out.reshape(shape)
+
+        return SyncHandle(future=parameterserver_pool.submit(do_receive))
+
+    def free(self) -> None:
+        """Free the instance (barrier-wrapped collective in the reference,
+        ``parameterserver.cpp:735-745``)."""
+        _server.unregister(self._inst)
+
+    @property
+    def freed(self) -> bool:
+        return self._inst.freed
+
+    def shard_of(self, rank: int) -> np.ndarray:
+        """Debug/introspection view of a rank's shard (copy)."""
+        return self._inst.shards[rank].copy()
 
 
 def free_all() -> None:
-    pass
+    _server.shutdown()

@@ -1,0 +1,159 @@
+"""Pytree-level parameter-server helpers with a global cache.
+
+Analog of ``torchmpi/parameterserver/init.lua`` (L6): per-tensor PS
+instances cached by identity (``cache.parameterServers``), list-wise
+``initTensors`` / ``prefetchTensors`` / ``integrateTensors`` /
+``sendTensors`` operations (``parameterserver/init.lua:128-219``), plus the
+DSGD gradient synchronization pattern from
+``examples/mnist/mnist_parameterserver_dsgd.lua:63-89``.
+
+Pytree convention: parameters are **rank-stacked** ([p, ...] leaves, rank
+r's replica at index r) — the single-controller representation of the
+reference's per-process tensors. Every rank acts as a PS client: sends
+contribute each rank's block, fetches return one (possibly different,
+staleness included) center snapshot per rank.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+
+from ..runtime.communicator import Communicator
+from ..runtime.handles import SyncHandle
+from .server import ParameterServer
+
+
+def _comm(comm: Optional[Communicator]) -> Communicator:
+    if comm is not None:
+        return comm
+    from .. import runtime_state
+
+    return runtime_state.current_communicator()
+
+
+class PSGroup:
+    """One ParameterServer per pytree leaf (the ``cache.parameterServers``
+    registry, ``torchmpi/cache.lua:19-35``), initialised from rank 0's
+    replica (``initTensors`` default init, ``parameterserver/init.lua:
+    128-151``)."""
+
+    def __init__(self, params, comm: Optional[Communicator] = None):
+        self.comm = _comm(comm)
+        self.p = self.comm.size
+        leaves, self.treedef = tree_util.tree_flatten(params)
+        self.servers: List[ParameterServer] = []
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            if arr.shape[0] != self.p:
+                raise ValueError(
+                    f"PSGroup expects rank-stacked leaves [p={self.p}, ...]; "
+                    f"got {arr.shape}"
+                )
+            self.servers.append(ParameterServer(arr[0], comm=self.comm))
+        self._prefetched: Optional[List[List[SyncHandle]]] = None
+
+    # ------------------------------------------------------------------
+    def send_tensors(
+        self,
+        values,
+        rule: str = "add",
+        local_update: Optional[Callable] = None,
+        scale: Optional[float] = None,
+        client_ranks: Optional[Sequence[int]] = None,
+    ) -> List[SyncHandle]:
+        """Every client rank sends its block of each leaf
+        (``sendTensors``, ``parameterserver/init.lua:187-219``).
+        ``local_update`` preprocesses each block before sending (Downpour's
+        ``t:mul(-lr)``)."""
+        leaves = tree_util.tree_leaves(values)
+        ranks = range(self.p) if client_ranks is None else client_ranks
+        handles = []
+        for srv, leaf in zip(self.servers, leaves):
+            arr = np.asarray(leaf)
+            for r in ranks:
+                block = arr[r]
+                if local_update is not None:
+                    block = local_update(block)
+                handles.append(srv.send(block, rule=rule, client=r, scale=scale))
+        return handles
+
+    def prefetch_tensors(
+        self, client_ranks: Optional[Sequence[int]] = None
+    ) -> List[SyncHandle]:
+        """Issue async fetches of every leaf for every client rank
+        (``prefetchTensors``, ``parameterserver/init.lua:159-170``)."""
+        ranks = list(range(self.p)) if client_ranks is None else list(client_ranks)
+        self._prefetch_ranks = ranks
+        self._prefetched = [
+            [srv.receive(client=r) for r in ranks] for srv in self.servers
+        ]
+        return [h for per_srv in self._prefetched for h in per_srv]
+
+    def integrate_tensors(self, params, fn: Callable, client_ranks=None):
+        """Wait prefetches and fold them into the rank-stacked params:
+        ``new_block = fn(fetched, block)`` per (leaf, client rank)
+        (``integrateTensors``, ``parameterserver/init.lua:173-184``).
+        Ranks that did not prefetch keep their block unchanged.
+
+        If no prefetch is outstanding (e.g. the first integration of a
+        schedule whose first prefetch lands *after* it — the reference's
+        counter arithmetic allows this and falls back to the init-time
+        buffers), a synchronous fetch is issued now."""
+        if self._prefetched is None:
+            self.prefetch_tensors(client_ranks=client_ranks)
+        leaves = list(tree_util.tree_leaves(params))
+        for i, (srv, per_srv) in enumerate(zip(self.servers, self._prefetched)):
+            arr = np.array(leaves[i])  # mutable host copy
+            for r, h in zip(self._prefetch_ranks, per_srv):
+                fetched = h.wait()
+                arr[r] = fn(fetched, arr[r])
+            leaves[i] = jnp.asarray(arr)
+        self._prefetched = None
+        return tree_util.tree_unflatten(self.treedef, leaves)
+
+    def receive_full(self, client: int = 0):
+        """Synchronously fetch the full center value of every leaf."""
+        leaves = [srv.receive(client=client).wait() for srv in self.servers]
+        return tree_util.tree_unflatten(self.treedef, leaves)
+
+    def free(self) -> None:
+        for srv in self.servers:
+            srv.free()
+
+
+def synchronize_gradients_with_parameterserver(
+    grads,
+    ps_group: Optional[PSGroup] = None,
+    comm: Optional[Communicator] = None,
+    average: bool = True,
+):
+    """Synchronous DSGD gradient exchange through the parameter server
+    (``mnist_parameterserver_dsgd.lua:63-89``): rank 0 zeroes the center,
+    every rank adds its gradients, every rank receives, divide by size.
+    Returns ``(synced_grads, ps_group)`` — pass the group back in to reuse
+    the cached servers."""
+    comm = _comm(comm)
+    p = comm.size
+    if ps_group is None:
+        ps_group = PSGroup(grads, comm=comm)
+
+    # rank 0 zeroes; handle-wait + barrier gives everyone the happens-before
+    for h in ps_group.send_tensors(grads, rule="zero", client_ranks=[0]):
+        h.wait()
+    # everyone accumulates
+    for h in ps_group.send_tensors(grads, rule="add"):
+        h.wait()
+    # everyone receives the sum
+    leaves = tree_util.tree_leaves(grads)
+    out = []
+    for srv, leaf in zip(ps_group.servers, leaves):
+        center = srv.receive().wait()
+        if average:
+            center = center / p
+        out.append(jnp.broadcast_to(jnp.asarray(center), np.asarray(leaf).shape))
+    return tree_util.tree_unflatten(ps_group.treedef, out), ps_group

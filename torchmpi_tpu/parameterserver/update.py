@@ -1,16 +1,242 @@
-"""Update schedules (Downpour / EASGD) — land with the PS milestone."""
+"""Parameter-server update schedules: Update base, Downpour, EASGD.
+
+Analog of ``torchmpi/parameterserver/{update,downpourupdate,easgdupdate}.lua``
+(L7). The base class owns the step-counted schedule:
+
+- ``__shard`` at ``init_delay``: create the PS group on the *sharding*
+  communicator level (``update.lua:49-55``).
+- ``__fetch`` at ``init_delay + update_frequency + prefetch`` then every
+  ``update_frequency``: issue async prefetches (``update.lua:58-65``;
+  ``prefetch`` must be in [0, update_frequency], ``update.lua:29-30``).
+- ``__integrate`` / ``__send``: subclass-defined.
+- Mixed PS × data-parallel: when the sharding and dataparallel communicator
+  levels differ, only each DP group's root integrates, and integrated
+  parameters are broadcast within DP groups afterwards
+  (``update.lua:82-113``).
+
+State convention: ``update(step, params, grads) -> params`` on rank-stacked
+pytrees; each rank's replica evolves independently between integrations —
+exactly the per-process divergence the reference's async modes exhibit.
+"""
 
 from __future__ import annotations
 
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+
+from ..runtime.communicator import Communicator
+from ..runtime.handles import SyncHandle
+from .tensors import PSGroup
+
+
+def _wait_all(handles: List[SyncHandle]) -> List:
+    return [h.wait() for h in handles]
+
 
 class Update:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("lands with the parameter-server milestone")
+    def __init__(
+        self,
+        comm: Optional[Communicator] = None,
+        sharding_level: Optional[int] = None,
+        dataparallel_level: Optional[int] = None,
+        update_frequency: int = 10,
+        init_delay: int = 100,
+        prefetch: int = 0,
+    ):
+        if not 0 <= prefetch <= update_frequency:
+            raise ValueError(
+                f"prefetch must be in [0, {update_frequency}]"
+            )
+        from .. import runtime_state
+
+        self._state = runtime_state
+        self.comm = comm
+        self.sharding_level = sharding_level
+        self.dataparallel_level = dataparallel_level
+        self.update_frequency = update_frequency
+        self.init_delay = init_delay
+        self.prefetch = prefetch
+
+        # schedule counters (update.lua:38-42)
+        self.init_parameterserver = init_delay
+        self.next_prefetch = init_delay + update_frequency + prefetch
+        self.next_integration = init_delay + update_frequency
+
+        self.ps: Optional[PSGroup] = None
+        self.handles_send: List[SyncHandle] = []
+        self.handles_prefetch: List[SyncHandle] = []
+
+    # ------------------------------------------------------------------
+    def _sharding_comm(self) -> Communicator:
+        if self.sharding_level is not None:
+            return self._state.stack().at(self.sharding_level)
+        return self.comm or self._state.current_communicator()
+
+    def _dataparallel_comm(self) -> Optional[Communicator]:
+        if self.dataparallel_level is None:
+            return None
+        return self._state.stack().at(self.dataparallel_level)
+
+    def _integrating_ranks(self) -> Optional[List[int]]:
+        """Ranks that fetch/integrate: all, unless a distinct dataparallel
+        communicator exists — then only each DP intra-group's root
+        (update.lua:86-95)."""
+        dp = self._dataparallel_comm()
+        if dp is None:
+            return None  # all ranks
+        return [
+            r for r in range(dp.size) if dp.member(r).intra_rank == 0
+        ]
+
+    # ------------------------------------------------------------------
+    def _shard(self, step: int, params) -> None:
+        if step == self.init_parameterserver:
+            self.ps = PSGroup(params, comm=self._sharding_comm())
+
+    def _fetch(self, step: int) -> None:
+        if step == self.next_prefetch and self.ps is not None:
+            _wait_all(self.handles_send)
+            self.handles_send = []
+            self.handles_prefetch = self.ps.prefetch_tensors(
+                client_ranks=self._integrating_ranks()
+            )
+            self.next_prefetch += self.update_frequency
+
+    def _integrate(self, step: int, params):
+        raise NotImplementedError
+
+    def _send(self, step: int, params, grads) -> None:
+        raise NotImplementedError
+
+    def update(self, step: int, params, grads):
+        """One schedule tick (``Update.update``, update.lua:77-115). Runs
+        shard -> fetch -> integrate -> send unconditionally like the
+        reference (subclass accumulation happens even before sharding)."""
+        self._shard(step, params)
+
+        integrated = False
+        self._fetch(step)
+        params, integrated = self._integrate(step, params)
+        self._send(step, params, grads)
+
+        # Mixed PS x DP: broadcast integrated params within DP groups
+        # (update.lua:104-112).
+        dp = self._dataparallel_comm()
+        if dp is not None and integrated:
+            from ..collectives import eager
+
+            params = tree_util.tree_map(
+                lambda w: eager.run_group_broadcast(w, dp, root=0), params
+            )
+        return params
+
+    def free(self) -> None:
+        if self.ps is not None:
+            self.ps.free()
+            self.ps = None
 
 
 class DownpourUpdate(Update):
-    pass
+    """Downpour SGD (``downpourupdate.lua``): accumulate gradients locally,
+    every ``send_frequency`` steps send the accumulated (locally scaled,
+    e.g. multiplied by -lr) gradients with the ``add`` rule; integration
+    copies the fetched center into the local replica."""
+
+    def __init__(
+        self,
+        local_update: Callable = None,
+        send_frequency: int = 1,
+        **kw,
+    ):
+        super().__init__(**kw)
+        self.send_frequency = send_frequency
+        self.next_send = self.init_delay + send_frequency
+        self.local_update = local_update or (lambda t: t)
+        self._accum = None
+
+    def _send(self, step: int, params, grads) -> None:
+        # accumulate every step (downpourupdate.lua:47-52)
+        if self._accum is None:
+            self._accum = tree_util.tree_map(jnp.asarray, grads)
+        else:
+            self._accum = tree_util.tree_map(
+                lambda a, g: a + g, self._accum, grads
+            )
+        if step == self.next_send and self.ps is not None:
+            self.handles_send = self.ps.send_tensors(
+                self._accum, rule="add", local_update=self.local_update
+            )
+            _wait_all(self.handles_send)
+            self.handles_send = []
+            self._accum = tree_util.tree_map(jnp.zeros_like, self._accum)
+            self.next_send += self.send_frequency
+
+    def _integrate(self, step: int, params):
+        if step == self.next_integration and self.ps is not None:
+            _wait_all(self.handles_prefetch)
+            self.handles_prefetch = []
+            params = self.ps.integrate_tensors(
+                params,
+                lambda fetched, block: fetched,
+                client_ranks=self._integrating_ranks(),
+            )
+            self.next_integration += self.update_frequency
+            return params, True
+        return params, False
 
 
 class EASGDUpdate(Update):
-    pass
+    """Elastic-averaging SGD (``easgdupdate.lua``): at each integration,
+    with alpha = beta / size, the replica moves toward the fetched center
+    (``x += alpha (center - x)``) and the elastic difference
+    ``-alpha (center - x_old)`` is sent back with ``add`` at the next send
+    step (the center moves toward the replica)."""
+
+    def __init__(self, beta: float = 0.9, **kw):
+        super().__init__(**kw)
+        self.beta = beta
+        self.next_send = self.next_integration
+        self._elastic = None  # per-leaf rank-stacked elastic differences
+
+    def _send(self, step: int, params, grads) -> None:
+        if step == self.next_send and self.ps is not None and self._elastic is not None:
+            self.handles_send = self.ps.send_tensors(self._elastic, rule="add")
+            self.next_send += self.update_frequency
+
+    def _integrate(self, step: int, params):
+        if step == self.next_integration and self.ps is not None:
+            _wait_all(self.handles_prefetch)
+            self.handles_prefetch = []
+            comm = self._sharding_comm()
+            alpha = self.beta / comm.size
+
+            elastic_leaves = []
+
+            def fold(fetched, block):
+                # easgdupdate.lua:68-77: old = fetched - x; x += alpha*old;
+                # elastic sent later = -alpha*old
+                old = np.asarray(fetched) - np.asarray(block)
+                new_block = np.asarray(block) + alpha * old
+                elastic_leaves.append(-alpha * old)
+                return new_block
+
+            params = self.ps.integrate_tensors(
+                params, fold, client_ranks=self._integrating_ranks()
+            )
+            # Regroup per-leaf, per-rank elastic diffs into stacked leaves.
+            ranks = self._integrating_ranks() or list(range(self.ps.p))
+            per_leaf = len(ranks)
+            stacked = []
+            for i, srv in enumerate(self.ps.servers):
+                buf = np.zeros((self.ps.p,) + srv.shape, srv.dtype)
+                for j, r in enumerate(ranks):
+                    buf[r] = elastic_leaves[i * per_leaf + j]
+                stacked.append(jnp.asarray(buf))
+            self._elastic = tree_util.tree_unflatten(self.ps.treedef, stacked)
+            self.next_integration += self.update_frequency
+            return params, True
+        return params, False
