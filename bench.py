@@ -66,8 +66,11 @@ def main():
     # Warmup: compile + 10 steps (tester.lua: 10 warmup + 10 timed).
     warm = iter(it)
     for i, b in zip(range(10), warm):
-        engine.params, engine.opt_state, loss = engine._step_fn(
-            engine.params, engine.opt_state, engine._prepare_batch(b)
+        engine.params, engine.opt_state, engine.model_state, loss = (
+            engine._step_fn(
+                engine.params, engine.opt_state, engine.model_state,
+                engine._prepare_batch(b),
+            )
         )
     warm.close()  # stop the warmup producer; don't let it shadow the timing
     import jax
@@ -78,8 +81,11 @@ def main():
     t0 = time.perf_counter()
     for _ in range(3):  # a few passes to get >= 10 timed steps
         for b in it:
-            engine.params, engine.opt_state, loss = engine._step_fn(
-                engine.params, engine.opt_state, engine._prepare_batch(b)
+            engine.params, engine.opt_state, engine.model_state, loss = (
+                engine._step_fn(
+                    engine.params, engine.opt_state, engine.model_state,
+                    engine._prepare_batch(b),
+                )
             )
             timed_steps += 1
         if timed_steps >= 30:

@@ -70,7 +70,13 @@ class AllReduceSGDEngine:
         profile_window: tuple = (3, 8),
         hooks: Optional[Dict[str, Callable]] = None,
         batch_format: str = "auto",
+        model_state=None,
     ):
+        """``model_state``: optional mutable-collection pytree (e.g. flax
+        ``batch_stats``). When given, ``loss_fn`` must have the signature
+        ``loss_fn(params, state, batch) -> (loss, new_state)``; the state is
+        pmean-synchronized across ranks every step (cross-replica batch-norm
+        statistics)."""
         if comm is None:
             from .. import runtime_state
 
@@ -101,6 +107,11 @@ class AllReduceSGDEngine:
 
         # Replicate initial params/opt state across the communicator.
         self.params = jax.device_put(params, self.replicated)
+        self.model_state = (
+            jax.device_put(model_state, self.replicated)
+            if model_state is not None
+            else None
+        )
         self.opt_state = jax.device_put(
             self.optimizer.init(params), self.replicated
         )
@@ -112,32 +123,44 @@ class AllReduceSGDEngine:
         loss_fn, optimizer = self.loss_fn, self.optimizer
         mode, buckets = self.mode, self.buckets
         average = self.average_gradients
+        has_state = self.model_state is not None
 
-        def step(params, opt_state, batch):
-            # batch leaves: [p*B, ...] sharded over _AXIS; per-rank block
-            # inside shard_map is [B, ...] = one reference rank's minibatch.
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        def sync_grads(grads):
             if mode == "async":
-                grads = mpinn.in_graph_synchronize_gradients_bucketed(
+                return mpinn.in_graph_synchronize_gradients_bucketed(
                     grads, buckets, _AXIS, average=average
                 )
-            else:
-                grads = mpinn.in_graph_synchronize_gradients(
-                    grads, _AXIS, average=average
+            return mpinn.in_graph_synchronize_gradients(
+                grads, _AXIS, average=average
+            )
+
+        def step(params, opt_state, model_state, batch):
+            # batch leaves: [p*B, ...] sharded over _AXIS; per-rank block
+            # inside shard_map is [B, ...] = one reference rank's minibatch.
+            if has_state:
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, model_state, batch)
+                new_state = jax.tree_util.tree_map(
+                    lambda s: jax.lax.pmean(s, _AXIS), new_state
                 )
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                new_state = model_state
+            grads = sync_grads(grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             loss = jax.lax.pmean(loss, _AXIS)
-            return params, opt_state, loss
+            return params, opt_state, new_state, loss
 
         shmapped = jax.shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(_AXIS)),
-            out_specs=(P(), P(), P()),
+            in_specs=(P(), P(), P(), P(_AXIS)),
+            out_specs=(P(), P(), P(), P()),
             check_vma=False,
         )
-        return jax.jit(shmapped, donate_argnums=(0, 1))
+        return jax.jit(shmapped, donate_argnums=(0, 1, 2))
 
     def _build_broadcast(self):
         bcast = jax.shard_map(
@@ -202,8 +225,10 @@ class AllReduceSGDEngine:
                     jax.profiler.start_trace(self.profile_dir)
                     profiling = True
 
-                self.params, self.opt_state, loss = self._step_fn(
-                    self.params, self.opt_state, batch
+                self.params, self.opt_state, self.model_state, loss = (
+                    self._step_fn(
+                        self.params, self.opt_state, self.model_state, batch
+                    )
                 )
                 state["loss"] = loss
                 self._hook("on_forward", state)
@@ -260,6 +285,13 @@ class AllReduceSGDEngine:
         )
 
     def evaluate(self, apply_fn: Callable, x, y, metric: Callable) -> float:
-        """Replicated evaluation of ``metric(apply_fn(params, x), y)``."""
+        """Replicated evaluation of ``metric(apply_fn(...), y)``.
+
+        ``apply_fn(params, x)`` normally; when the engine holds mutable
+        ``model_state`` (e.g. batch_stats), ``apply_fn(params, state, x)``.
+        """
         params = jax.device_get(self.params)
+        if self.model_state is not None:
+            state = jax.device_get(self.model_state)
+            return float(metric(apply_fn(params, state, x), y))
         return float(metric(apply_fn(params, x), y))

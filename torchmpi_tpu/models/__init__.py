@@ -1,3 +1,4 @@
+from .mlp import MLP6
 from .mnist import (
     LeNet,
     LogisticRegression,
@@ -6,12 +7,18 @@ from .mnist import (
     init_params,
     make_loss_fn,
 )
-from .mlp import MLP6
+from .resnet import ResNet, ResNet18, ResNet50
+from .transformer import LongContextTransformer, RingAttentionBlock
 
 __all__ = [
     "LogisticRegression",
     "LeNet",
     "MLP6",
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "LongContextTransformer",
+    "RingAttentionBlock",
     "cross_entropy_loss",
     "accuracy",
     "make_loss_fn",

@@ -1,0 +1,134 @@
+"""ResNet family (ResNet-18/50) in flax, TPU-shaped.
+
+BASELINE.json config #4: "ResNet-50 ImageNet data-parallel via
+synchronizeGradients". Standard bottleneck ResNet-v1.5 (stride-2 in the 3x3
+conv), channels-last NHWC (TPU conv layout), bfloat16-friendly with float32
+batch-norm statistics and a float32 final head. Written from the
+architecture description; no code is derived from the reference repo
+(which contains no convnets).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as fnn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(fnn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+    norm: ModuleDef = fnn.BatchNorm
+
+    @fnn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            self.norm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        conv = partial(fnn.Conv, use_bias=False, dtype=self.dtype)
+
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = norm()(y)
+        y = fnn.relu(y)
+        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = norm()(y)
+        y = fnn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(scale_init=fnn.initializers.zeros)(y)
+
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), strides=self.strides, name="proj"
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return fnn.relu(residual + y)
+
+
+class BasicBlock(fnn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+    norm: ModuleDef = fnn.BatchNorm
+
+    @fnn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            self.norm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        conv = partial(fnn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (3, 3), strides=self.strides)(x)
+        y = norm()(y)
+        y = fnn.relu(y)
+        y = conv(self.features, (3, 3))(y)
+        y = norm(scale_init=fnn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features, (1, 1), strides=self.strides, name="proj"
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return fnn.relu(residual + y)
+
+
+class ResNet(fnn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef = BottleneckBlock
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, H, W, 3] NHWC
+        x = x.astype(self.dtype)
+        x = fnn.Conv(
+            self.num_filters,
+            (7, 7),
+            strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv_init",
+        )(x)
+        x = fnn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+            name="bn_init",
+        )(x)
+        x = fnn.relu(x)
+        x = fnn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(
+                    self.num_filters * 2**i,
+                    strides=strides,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = fnn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def ResNet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block=BasicBlock, **kw)
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block=BottleneckBlock, **kw)

@@ -1,0 +1,52 @@
+"""Multi-axis mesh construction from communicators.
+
+Factor a communicator's devices into named parallelism axes (dp / tp / sp /
+...) — the TPU-native generalisation of the reference's 2-level intra/inter
+communicator hierarchy to arbitrary strategy products. The last axis varies
+fastest, so adjacent-ICI neighbors land on the innermost (most
+bandwidth-hungry) axis, matching the scaling-book recipe of putting tp/sp
+on the shortest ICI hops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..runtime.communicator import Communicator
+
+
+def make_parallel_mesh(
+    comm: Optional[Communicator] = None,
+    axes: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Build a named mesh over the communicator's devices.
+
+    ``axes`` maps axis name -> size in declaration order (outermost first),
+    e.g. ``{"dp": 2, "tp": 2, "sp": 2}`` on 8 devices. One axis may be -1
+    (inferred). Sizes must multiply to the communicator size.
+    """
+    if comm is None:
+        from .. import runtime_state
+
+        comm = runtime_state.current_communicator()
+    axes = dict(axes or {"dp": comm.size})
+    sizes = list(axes.values())
+    n = comm.size
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if unknown:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known != 0:
+            raise ValueError(f"cannot infer axis: {n} devices over {known}")
+        sizes[unknown[0]] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"axes {dict(zip(axes, sizes))} do not cover {n} devices"
+        )
+    arr = np.array(comm.devices, dtype=object).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
