@@ -1,0 +1,179 @@
+"""Native C++ runtime tests (csrc/tpumpi.cpp via ctypes)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from torchmpi_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime not built/available"
+)
+
+
+def _lib():
+    return native.get_lib()
+
+
+def test_version():
+    assert _lib().tpumpi_version().decode().startswith("tpumpi-native")
+
+
+def test_constants_roundtrip_and_freeze_flag():
+    lib = _lib()
+    lib.tpumpi_reset_constants()
+    assert lib.tpumpi_set_constant(b"test_knob", 42) == 0
+    assert lib.tpumpi_get_constant(b"test_knob", -1) == 42
+    assert lib.tpumpi_get_constant(b"missing", 7) == 7
+    lib.tpumpi_freeze_constants()
+    assert lib.tpumpi_constants_frozen() == 1
+    assert lib.tpumpi_set_constant(b"test_knob", 1) == -1  # frozen
+    lib.tpumpi_reset_constants()
+
+
+def test_python_constants_mirrored():
+    """The Python constants table mirrors into C++ via the listener."""
+    from torchmpi_tpu import constants
+
+    lib = _lib()
+    constants.set("small_allreduce_size_tpu", 12345)
+    assert lib.tpumpi_get_constant(b"small_allreduce_size_tpu", -1) == 12345
+
+
+def test_handle_registry():
+    lib = _lib()
+    h = lib.tpumpi_handle_create()
+    t = threading.Thread(target=lambda: lib.tpumpi_handle_complete(h, 99))
+    t.start()
+    assert lib.tpumpi_handle_wait(h) == 99
+    t.join()
+    # double wait: freed slot is a no-op returning 0 (resources.cpp parity)
+    assert lib.tpumpi_handle_wait(h) == 0
+
+
+def test_native_sync_handle_integration():
+    from torchmpi_tpu.runtime.handles import SyncHandle
+
+    lib = _lib()
+    h = lib.tpumpi_handle_create()
+    sh = SyncHandle(native_id=h)
+    lib.tpumpi_handle_complete(h, 1)
+    sh.wait()
+    sh.wait()  # idempotent
+
+
+def test_ring_plan_validity():
+    """Plan correctness: every rank's recv at step s equals its left
+    neighbor's send at step s, and after the reduce-scatter phase rank r
+    owns chunk (r+1) % size. Chunk indices are in [0, size); buffers with
+    k*size chunks repeat the schedule per group."""
+    for size in (2, 4, 8):
+        plans = [native.ring_plan(r, size) for r in range(size)]
+        steps = 2 * (size - 1)
+        for r in range(size):
+            send, recv = plans[r]
+            assert len(send) == steps
+            assert all(0 <= c < size for c in send)
+            left = (r - 1) % size
+            lsend, _ = plans[left]
+            for s in range(steps):
+                assert recv[s] == lsend[s], (size, r, s)
+        # ownership after RS phase: last recv of phase 1 for rank r is
+        # chunk (r+1) % size
+        for r in range(size):
+            _, recv = plans[r]
+            assert recv[size - 2] == (r + 1) % size
+
+
+def test_ring_plan_invalid_args():
+    with pytest.raises(ValueError):
+        native.ring_plan(9, 8)
+
+
+def test_native_shard_store_rules():
+    flat = np.arange(10, dtype=np.float32)
+    store = native.NativeShardStore([4, 3, 3], np.float32, flat)
+    np.testing.assert_array_equal(store.read(0), [0, 1, 2, 3])
+    np.testing.assert_array_equal(store.read(2), [7, 8, 9])
+    store.apply(1, "add", np.ones(3, np.float32))
+    np.testing.assert_array_equal(store.read(1), [5, 6, 7])
+    store.apply(1, "copy", np.full(3, 2.0, np.float32))
+    np.testing.assert_array_equal(store.read(1), 2.0)
+    store.apply(1, "zero", np.zeros(3, np.float32))
+    np.testing.assert_array_equal(store.read(1), 0.0)
+    store.free()
+    with pytest.raises(RuntimeError):
+        store.read(0)
+
+
+def test_native_shard_store_f64():
+    flat = np.arange(6, dtype=np.float64)
+    store = native.NativeShardStore([3, 3], np.float64, flat)
+    store.apply(0, "add", np.full(3, 0.5))
+    np.testing.assert_array_equal(store.read(0), [0.5, 1.5, 2.5])
+    store.free()
+
+
+def test_ps_uses_native_backend():
+    """With the native runtime on, ParameterServer shards live in C++."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.parameterserver import ParameterServer, free_all
+
+    mpi.start()
+    ps = ParameterServer(np.arange(20, dtype=np.float32))
+    assert ps._inst.native is not None
+    ps.send(np.ones(20, np.float32), rule="add").wait()
+    np.testing.assert_array_equal(
+        ps.receive().wait(), np.arange(20) + 1
+    )
+    ps.free()
+    free_all()
+    mpi.stop()
+
+
+def test_ps_python_fallback():
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import ParameterServer, free_all
+
+    constants.set("use_native_runtime", False)
+    mpi.start()
+    ps = ParameterServer(np.arange(8, dtype=np.float32))
+    assert ps._inst.native is None
+    ps.send(np.ones(8, np.float32), rule="add").wait()
+    np.testing.assert_array_equal(ps.receive().wait(), np.arange(8) + 1)
+    ps.free()
+    free_all()
+    mpi.stop()
+
+
+def test_native_barrier_threads():
+    b = native.NativeBarrier("pytest", 4)
+    hits = []
+    lock = threading.Lock()
+
+    def worker(i):
+        for round_ in range(3):
+            b.wait()
+            with lock:
+                hits.append((round_, i))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(hits) == 12
+    # all of round k completes before any of round k+1 starts
+    rounds = [r for r, _ in hits]
+    assert rounds == sorted(rounds)
+    b.destroy()
+
+
+def test_pool_create_destroy():
+    lib = _lib()
+    pid = lib.tpumpi_pool_create(4)
+    assert pid >= 0
+    lib.tpumpi_pool_destroy(pid)
+    lib.tpumpi_pool_destroy(pid)  # double destroy is a no-op

@@ -1,0 +1,25 @@
+"""Build the native runtime: ``python -m torchmpi_tpu.build_native``."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    csrc = Path(__file__).resolve().parent / "csrc"
+    proc = subprocess.run(["make"], cwd=csrc)
+    if proc.returncode == 0:
+        from .runtime import native
+
+        lib = native.get_lib()
+        if lib is not None:
+            print(f"built + loaded: {native._SO} ({lib.tpumpi_version().decode()})")
+            return 0
+    print("native build failed; pure-Python fallbacks remain active")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,10 @@ class _Constants:
     # default custom-ring implementation: 'ppermute' (pure XLA, portable) or
     # 'pallas' (ICI RDMA kernels, TPU only).
     ring_implementation: str = "ppermute"
+    # Use the native C++ runtime (csrc/libtpumpi.so) for PS shard storage,
+    # handle registry, and plans when it is available; pure-Python fallback
+    # otherwise (analog of the reference's optional-backend detection).
+    use_native_runtime: bool = True
     # Donate input buffers to eager collectives (strict in-place semantics,
     # like the reference's inplace collective variants). Off by default:
     # JAX users expect value semantics, and donation invalidates reuse of
@@ -142,11 +146,26 @@ def set(name: str, value: Any) -> None:  # noqa: A001 - parity with C setters
         fn(name, value)
 
 
+_freeze_listeners: List[Callable[[], None]] = []
+
+
+def register_freeze_listener(fn: Callable[[], None]) -> None:
+    """Called when the table freezes (mirrors the freeze into native code)."""
+    with _lock:
+        _freeze_listeners.append(fn)
+        frozen = _frozen
+    if frozen:
+        fn()
+
+
 def freeze_constants() -> None:
     """Permanently freeze the table (reference ``lib/constants.cpp:130,163``)."""
     global _frozen
     with _lock:
         _frozen = True
+        listeners = list(_freeze_listeners)
+    for fn in listeners:
+        fn()
 
 
 def constants_frozen() -> bool:
@@ -166,6 +185,15 @@ def _reset_for_tests() -> None:
         _values = _Constants()
         listeners = list(_listeners)
         replay = [(f.name, getattr(_values, f.name)) for f in fields(_Constants)]
+    # unfreeze the native mirror too, else replay below would raise
+    try:
+        from .runtime import native as _native
+
+        lib = _native._lib
+        if lib is not None:
+            lib.tpumpi_reset_constants()
+    except Exception:
+        pass
     for fn in listeners:
         for name, value in replay:
             fn(name, value)

@@ -1,0 +1,479 @@
+// Native runtime core for torchmpi_tpu.
+//
+// C++ equivalents of the reference's native components (SURVEY.md §2.1),
+// exposed as a C API loaded from Python via ctypes:
+//
+//  - tunable-constants table with freeze semantics  (≅ lib/constants.cpp)
+//  - condvar thread pool + bounded SPMC pool        (≅ lib/thread_pool-in.h,
+//                                                      lib/spmc_thread_pool-in.h)
+//  - future/handle registry with wait()             (≅ lib/resources.cpp
+//                                                      request table + futures,
+//                                                      SynchronizationHandle)
+//  - memoized ring chunk plans                      (≅ lib/resources.cpp:582-672,
+//                                                      lib/detail/README.md)
+//  - parameter-server shard store with named update
+//    rules applied outside the Python GIL           (≅ lib/parameterserver.cpp
+//                                                      shard + rule core)
+//  - POSIX named-semaphore local barrier            (≅ lib/barrier.cpp)
+//
+// The compute path (collectives) is XLA/Pallas; this library is the host
+// runtime around it, mirroring where the reference spent native code.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <future>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <semaphore.h>
+#include <unistd.h>
+
+#define TPUMPI_API extern "C" __attribute__((visibility("default")))
+
+// ---------------------------------------------------------------------------
+// Constants table with freeze (≅ lib/constants.cpp:130-352)
+// ---------------------------------------------------------------------------
+namespace {
+
+std::mutex g_const_mutex;
+std::unordered_map<std::string, int64_t> g_constants;
+std::atomic<bool> g_frozen{false};
+
+}  // namespace
+
+TPUMPI_API int tpumpi_set_constant(const char* name, int64_t value) {
+  if (g_frozen.load()) return -1;  // immutableConstants check
+  std::lock_guard<std::mutex> lock(g_const_mutex);
+  g_constants[name] = value;
+  return 0;
+}
+
+TPUMPI_API int64_t tpumpi_get_constant(const char* name, int64_t fallback) {
+  std::lock_guard<std::mutex> lock(g_const_mutex);
+  auto it = g_constants.find(name);
+  return it == g_constants.end() ? fallback : it->second;
+}
+
+TPUMPI_API void tpumpi_freeze_constants() { g_frozen.store(true); }
+TPUMPI_API int tpumpi_constants_frozen() { return g_frozen.load() ? 1 : 0; }
+
+// test-only
+TPUMPI_API void tpumpi_reset_constants() {
+  std::lock_guard<std::mutex> lock(g_const_mutex);
+  g_constants.clear();
+  g_frozen.store(false);
+}
+
+// ---------------------------------------------------------------------------
+// Thread pool (condvar, ≅ lib/thread_pool-in.h)
+// ---------------------------------------------------------------------------
+namespace {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(size_t n) : stop_(false) {
+    for (size_t i = 0; i < n; ++i) {
+      workers_.emplace_back([this] {
+        for (;;) {
+          std::function<void()> task;
+          {
+            std::unique_lock<std::mutex> lock(mutex_);
+            cv_.wait(lock, [this] { return stop_ || !tasks_.empty(); });
+            if (stop_ && tasks_.empty()) return;
+            task = std::move(tasks_.front());
+            tasks_.pop_front();
+          }
+          task();
+        }
+      });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void enqueue(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      tasks_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+  size_t size() const { return workers_.size(); }
+
+ private:
+  std::vector<std::thread> workers_;
+  std::deque<std::function<void()>> tasks_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  bool stop_;
+};
+
+std::mutex g_pool_mutex;
+std::unordered_map<int64_t, std::unique_ptr<ThreadPool>> g_pools;
+int64_t g_next_pool = 0;
+
+}  // namespace
+
+TPUMPI_API int64_t tpumpi_pool_create(int64_t num_threads) {
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  int64_t id = g_next_pool++;
+  g_pools[id] =
+      std::make_unique<ThreadPool>(static_cast<size_t>(num_threads));
+  return id;
+}
+
+TPUMPI_API void tpumpi_pool_destroy(int64_t pool) {
+  std::unique_ptr<ThreadPool> dying;
+  {
+    std::lock_guard<std::mutex> lock(g_pool_mutex);
+    auto it = g_pools.find(pool);
+    if (it == g_pools.end()) return;
+    dying = std::move(it->second);
+    g_pools.erase(it);
+  }
+  // destructor joins outside the registry lock
+}
+
+// ---------------------------------------------------------------------------
+// Handle registry (≅ SynchronizationHandle + future/request tables,
+// lib/resources.h:230-253, lib/resources.cpp:399-461,545-578)
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Handle {
+  std::promise<int64_t> promise;
+  std::shared_future<int64_t> future;
+  std::atomic<bool> completed{false};
+  Handle() : future(promise.get_future().share()) {}
+};
+
+std::mutex g_handle_mutex;
+std::unordered_map<int64_t, std::shared_ptr<Handle>> g_handles;
+int64_t g_next_handle = 0;
+
+std::shared_ptr<Handle> take_handle(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_handle_mutex);
+  auto it = g_handles.find(id);
+  if (it == g_handles.end()) return nullptr;
+  return it->second;
+}
+
+}  // namespace
+
+TPUMPI_API int64_t tpumpi_handle_create() {
+  std::lock_guard<std::mutex> lock(g_handle_mutex);
+  int64_t id = g_next_handle++;
+  g_handles[id] = std::make_shared<Handle>();
+  return id;
+}
+
+// Idempotent: the second and later completes are no-ops (a throwing
+// std::promise::set_value must never unwind across the C boundary).
+TPUMPI_API void tpumpi_handle_complete(int64_t id, int64_t status) {
+  auto h = take_handle(id);
+  if (h && !h->completed.exchange(true)) h->promise.set_value(status);
+}
+
+// Blocks until complete; frees the slot; returns status (0 unknown-id, like
+// the reference's wait-on-freed-handle no-op, resources.cpp:1226-1242).
+TPUMPI_API int64_t tpumpi_handle_wait(int64_t id) {
+  auto h = take_handle(id);
+  if (!h) return 0;
+  int64_t status = h->future.get();
+  std::lock_guard<std::mutex> lock(g_handle_mutex);
+  g_handles.erase(id);
+  return status;
+}
+
+TPUMPI_API int64_t tpumpi_handles_outstanding() {
+  std::lock_guard<std::mutex> lock(g_handle_mutex);
+  return static_cast<int64_t>(g_handles.size());
+}
+
+// ---------------------------------------------------------------------------
+// Ring chunk plans (≅ lib/resources.cpp:582-672): for `chunks` chunks on a
+// ring of `size` at position `rank`, the (p-1) reduce-scatter steps then
+// (p-1) allgather steps, each step = (send_chunk, recv_chunk). Memoized.
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Plan {
+  std::vector<int64_t> send;  // 2*(size-1) entries
+  std::vector<int64_t> recv;
+};
+
+std::mutex g_plan_mutex;
+std::map<std::tuple<int64_t, int64_t, int64_t>, Plan> g_plans;
+
+const Plan& get_plan(int64_t rank, int64_t size) {
+  std::lock_guard<std::mutex> lock(g_plan_mutex);
+  auto key = std::make_tuple(int64_t(0), rank, size);
+  auto it = g_plans.find(key);
+  if (it != g_plans.end()) return it->second;
+  Plan plan;
+  auto mod = [](int64_t a, int64_t m) { return ((a % m) + m) % m; };
+  // reduce-scatter phase: step s sends chunk (rank-s), receives (rank-s-1)
+  for (int64_t s = 0; s < size - 1; ++s) {
+    plan.send.push_back(mod(rank - s, size));
+    plan.recv.push_back(mod(rank - s - 1, size));
+  }
+  // allgather phase: step s sends (rank+1-s), receives (rank-s)
+  for (int64_t s = 0; s < size - 1; ++s) {
+    plan.send.push_back(mod(rank + 1 - s, size));
+    plan.recv.push_back(mod(rank - s, size));
+  }
+  return g_plans.emplace(key, std::move(plan)).first->second;
+}
+
+}  // namespace
+
+// Fills out_send/out_recv (each 2*(size-1) int64 slots) with chunk indices
+// in [0, size). A buffer of k*size chunks runs the same schedule per group
+// of `size` chunks (offset j*size), exactly like the reference plan's
+// repetition over chunk groups. Returns step count.
+TPUMPI_API int64_t tpumpi_ring_plan(int64_t rank, int64_t size,
+                                    int64_t* out_send, int64_t* out_recv) {
+  if (size < 2 || rank < 0 || rank >= size) return -1;
+  const Plan& plan = get_plan(rank, size);
+  std::memcpy(out_send, plan.send.data(), plan.send.size() * sizeof(int64_t));
+  std::memcpy(out_recv, plan.recv.data(), plan.recv.size() * sizeof(int64_t));
+  return static_cast<int64_t>(plan.send.size());
+}
+
+// ---------------------------------------------------------------------------
+// Parameter-server shard store (≅ lib/parameterserver.cpp shard + rules).
+// Rules: 0=zero, 1=copy, 2=add (parameterserver.cpp:119-213). float32 (0)
+// and float64 (1), matching the reference's Float/Double instantiation.
+// Applies without holding the Python GIL (ctypes releases it around calls).
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Shard {
+  std::vector<uint8_t> data;
+  int dtype;  // 0 = f32, 1 = f64
+  std::mutex mutex;
+};
+
+struct PSStore {
+  std::vector<std::shared_ptr<Shard>> shards;
+};
+
+std::mutex g_ps_mutex;
+std::unordered_map<int64_t, std::unique_ptr<PSStore>> g_ps;
+int64_t g_next_ps = 0;
+
+template <typename T>
+void apply_rule_typed(uint8_t* shard, const uint8_t* incoming, int64_t n,
+                      int64_t rule) {
+  T* s = reinterpret_cast<T*>(shard);
+  const T* in = reinterpret_cast<const T*>(incoming);
+  switch (rule) {
+    case 0:
+      std::memset(shard, 0, n * sizeof(T));
+      break;
+    case 1:
+      std::memcpy(shard, incoming, n * sizeof(T));
+      break;
+    case 2:
+      for (int64_t i = 0; i < n; ++i) s[i] += in[i];
+      break;
+  }
+}
+
+// Returns a shared_ptr copy so a concurrent tpumpi_ps_free cannot destroy
+// the shard (and its mutex) while a reader/writer still holds it.
+std::shared_ptr<Shard> find_shard(int64_t store, int64_t shard_idx) {
+  std::lock_guard<std::mutex> lock(g_ps_mutex);
+  auto it = g_ps.find(store);
+  if (it == g_ps.end()) return nullptr;
+  auto& shards = it->second->shards;
+  if (shard_idx < 0 || shard_idx >= (int64_t)shards.size()) return nullptr;
+  return shards[shard_idx];
+}
+
+}  // namespace
+
+// dtype: 0=f32, 1=f64. shard_sizes: element count per shard.
+TPUMPI_API int64_t tpumpi_ps_create(const int64_t* shard_sizes,
+                                    int64_t num_shards, int dtype,
+                                    const uint8_t* initial_flat) {
+  if (dtype != 0 && dtype != 1) return -1;
+  size_t esize = dtype == 0 ? 4 : 8;
+  auto store = std::make_unique<PSStore>();
+  size_t offset = 0;
+  for (int64_t i = 0; i < num_shards; ++i) {
+    auto shard = std::make_shared<Shard>();
+    shard->dtype = dtype;
+    size_t bytes = shard_sizes[i] * esize;
+    shard->data.resize(bytes);
+    if (initial_flat) {
+      std::memcpy(shard->data.data(), initial_flat + offset, bytes);
+    }
+    offset += bytes;
+    store->shards.push_back(std::move(shard));
+  }
+  std::lock_guard<std::mutex> lock(g_ps_mutex);
+  int64_t id = g_next_ps++;
+  g_ps[id] = std::move(store);
+  return id;
+}
+
+TPUMPI_API int tpumpi_ps_apply(int64_t store, int64_t shard_idx, int64_t rule,
+                               const uint8_t* incoming, int64_t n_elements) {
+  std::shared_ptr<Shard> shard = find_shard(store, shard_idx);
+  if (!shard || rule < 0 || rule > 2) return -1;
+  size_t esize = shard->dtype == 0 ? 4 : 8;
+  if ((size_t)n_elements * esize != shard->data.size()) return -2;
+  std::lock_guard<std::mutex> lock(shard->mutex);
+  if (shard->dtype == 0) {
+    apply_rule_typed<float>(shard->data.data(), incoming, n_elements, rule);
+  } else {
+    apply_rule_typed<double>(shard->data.data(), incoming, n_elements, rule);
+  }
+  return 0;
+}
+
+TPUMPI_API int tpumpi_ps_read(int64_t store, int64_t shard_idx, uint8_t* out,
+                              int64_t n_elements) {
+  std::shared_ptr<Shard> shard = find_shard(store, shard_idx);
+  if (!shard) return -1;
+  size_t esize = shard->dtype == 0 ? 4 : 8;
+  if ((size_t)n_elements * esize != shard->data.size()) return -2;
+  std::lock_guard<std::mutex> lock(shard->mutex);
+  std::memcpy(out, shard->data.data(), shard->data.size());
+  return 0;
+}
+
+TPUMPI_API void tpumpi_ps_free(int64_t store) {
+  std::lock_guard<std::mutex> lock(g_ps_mutex);
+  g_ps.erase(store);
+}
+
+TPUMPI_API int64_t tpumpi_ps_count() {
+  std::lock_guard<std::mutex> lock(g_ps_mutex);
+  return static_cast<int64_t>(g_ps.size());
+}
+
+// ---------------------------------------------------------------------------
+// POSIX named-semaphore local barrier (≅ lib/barrier.cpp + resources.cpp:
+// 486-539, which the reference left disabled; functional here).
+// Classic two-phase (arrive + depart) so the barrier is reusable.
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Barrier {
+  std::string name;
+  sem_t* mutex_sem;
+  sem_t* turnstile1;
+  sem_t* turnstile2;
+  int* count;  // in shared memory? single-process fallback: heap
+  int size;
+  // For simplicity the count lives in a semaphore-emulated counter:
+  // we use sem getvalue on a counting semaphore.
+};
+
+std::mutex g_barrier_mutex;
+std::unordered_map<int64_t, std::unique_ptr<Barrier>> g_barriers;
+int64_t g_next_barrier = 0;
+
+}  // namespace
+
+// `owner` != 0: unlink any stale semaphores from a crashed prior run before
+// creating (the creator process passes owner=1; joiners pass owner=0 and
+// must be started after the owner).
+TPUMPI_API int64_t tpumpi_barrier_create(const char* name, int size,
+                                         int owner) {
+  auto b = std::make_unique<Barrier>();
+  b->name = name;
+  b->size = size;
+  std::string n1 = std::string("/tpumpi_") + name + "_m";
+  std::string n2 = std::string("/tpumpi_") + name + "_t1";
+  std::string n3 = std::string("/tpumpi_") + name + "_t2";
+  if (owner) {
+    for (const char* suffix : {"_m", "_t1", "_t2", "_c"}) {
+      sem_unlink((std::string("/tpumpi_") + name + suffix).c_str());
+    }
+  }
+  b->mutex_sem = sem_open(n1.c_str(), O_CREAT, 0600, 1);
+  b->turnstile1 = sem_open(n2.c_str(), O_CREAT, 0600, 0);
+  b->turnstile2 = sem_open(n3.c_str(), O_CREAT, 0600, 0);
+  if (b->mutex_sem == SEM_FAILED || b->turnstile1 == SEM_FAILED ||
+      b->turnstile2 == SEM_FAILED) {
+    return -1;
+  }
+  // count semaphore: arrivals tracked via an extra counting semaphore
+  std::string n4 = std::string("/tpumpi_") + name + "_c";
+  sem_t* counter = sem_open(n4.c_str(), O_CREAT, 0600, 0);
+  if (counter == SEM_FAILED) return -1;
+  b->count = reinterpret_cast<int*>(counter);  // stored as sem handle
+  std::lock_guard<std::mutex> lock(g_barrier_mutex);
+  int64_t id = g_next_barrier++;
+  g_barriers[id] = std::move(b);
+  return id;
+}
+
+TPUMPI_API int tpumpi_barrier_wait(int64_t id) {
+  Barrier* b;
+  {
+    std::lock_guard<std::mutex> lock(g_barrier_mutex);
+    auto it = g_barriers.find(id);
+    if (it == g_barriers.end()) return -1;
+    b = it->second.get();
+  }
+  sem_t* counter = reinterpret_cast<sem_t*>(b->count);
+  // phase 1
+  sem_wait(b->mutex_sem);
+  sem_post(counter);
+  int val = 0;
+  sem_getvalue(counter, &val);
+  if (val == b->size) {
+    for (int i = 0; i < b->size; ++i) sem_post(b->turnstile1);
+  }
+  sem_post(b->mutex_sem);
+  sem_wait(b->turnstile1);
+  // phase 2 (reset)
+  sem_wait(b->mutex_sem);
+  sem_trywait(counter);
+  sem_getvalue(counter, &val);
+  if (val == 0) {
+    for (int i = 0; i < b->size; ++i) sem_post(b->turnstile2);
+  }
+  sem_post(b->mutex_sem);
+  sem_wait(b->turnstile2);
+  return 0;
+}
+
+TPUMPI_API void tpumpi_barrier_destroy(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_barrier_mutex);
+  auto it = g_barriers.find(id);
+  if (it == g_barriers.end()) return;
+  Barrier* b = it->second.get();
+  sem_close(b->mutex_sem);
+  sem_close(b->turnstile1);
+  sem_close(b->turnstile2);
+  sem_close(reinterpret_cast<sem_t*>(b->count));
+  for (const char* suffix : {"_m", "_t1", "_t2", "_c"}) {
+    sem_unlink((std::string("/tpumpi_") + b->name + suffix).c_str());
+  }
+  g_barriers.erase(it);
+}
+
+TPUMPI_API const char* tpumpi_version() { return "tpumpi-native-0.1.0"; }

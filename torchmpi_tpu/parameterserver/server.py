@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import constants
 from ..runtime.communicator import Communicator
 from ..runtime.handles import SyncHandle
 from ..runtime.pools import parameterserver_pool
@@ -74,7 +75,14 @@ class _Message:
 
 
 class _Instance:
-    """Server-side state of one ParameterServer: per-rank shards + mailboxes."""
+    """Server-side state of one ParameterServer: per-rank shards + mailboxes.
+
+    Shard storage and rule application live in the native C++ runtime when
+    it is available (``constants.use_native_runtime``): updates are applied
+    outside the GIL, the same split the reference uses (wire protocol in the
+    scripting layer, byte-crunching in ``lib/parameterserver.cpp``). The
+    numpy store is the portable fallback.
+    """
 
     def __init__(self, instance_id: int, full: np.ndarray, size: int):
         self.id = instance_id
@@ -82,15 +90,54 @@ class _Instance:
         self.dtype = full.dtype
         self.size = size
         flat = full.reshape(-1)
-        self.shards: List[np.ndarray] = []
         self.ranges: List[Tuple[int, int]] = []
+        sizes = []
         for r in range(size):
             s, e = shard_range(flat.shape[0], size, r)
             self.ranges.append((s, e))
-            self.shards.append(flat[s:e].copy())
+            sizes.append(e - s)
+        self.native = None
+        if constants.get("use_native_runtime"):
+            try:
+                from ..runtime.native import NativeShardStore, available
+
+                if available():
+                    self.native = NativeShardStore(sizes, self.dtype, flat)
+            except Exception:
+                self.native = None
+        if self.native is None:
+            self._shards: List[np.ndarray] = [
+                flat[s:e].copy() for s, e in self.ranges
+            ]
         self.mailboxes: List[deque] = [deque() for _ in range(size)]
         self.locks = [threading.Lock() for _ in range(size)]
         self.freed = False
+
+    # --- storage backend dispatch ---
+    def apply_rule(self, r: int, rule: str, payload) -> None:
+        if self.native is not None:
+            from ..runtime.native import NativeShardStore
+
+            if rule in NativeShardStore.RULES:
+                self.native.apply(r, rule, payload)
+            else:
+                # Custom Python rule on a native shard: read-modify-write.
+                # serve_once is single-threaded per instance, so this is
+                # race-free with other rule applications.
+                buf = self.native.read(r)
+                UPDATE_RULES[rule](buf, payload)
+                self.native.apply(r, "copy", buf)
+        else:
+            UPDATE_RULES[rule](self._shards[r], payload)
+
+    def read_shard(self, r: int) -> np.ndarray:
+        if self.native is not None:
+            return self.native.read(r)
+        return self._shards[r].copy()
+
+    def release_storage(self) -> None:
+        if self.native is not None:
+            self.native.free()
 
     def post(self, server_rank: int, msg: _Message) -> None:
         with self.locks[server_rank]:
@@ -119,16 +166,24 @@ class _Instance:
                     msg = self.mailboxes[r].popleft()
                 worked = True
                 if msg.kind == "update":
-                    rule = UPDATE_RULES.get(msg.rule)
-                    if rule is None:
+                    try:
+                        if msg.rule not in UPDATE_RULES:
+                            raise KeyError(f"unknown update rule {msg.rule!r}")
+                        self.apply_rule(r, msg.rule, msg.payload)
+                    except Exception:
+                        # Never kill the (single, shared) server thread and
+                        # never strand the sender's completion event.
+                        import traceback
+
+                        traceback.print_exc()
+                    finally:
                         if msg.done:
                             msg.done.set()
-                        raise KeyError(f"unknown update rule {msg.rule!r}")
-                    rule(self.shards[r], msg.payload)
-                    if msg.done:
-                        msg.done.set()
                 elif msg.kind == "trigger":
-                    msg.reply.set_result(self.shards[r].copy())
+                    try:
+                        msg.reply.set_result(self.read_shard(r))
+                    except Exception as e:  # fulfil with the error
+                        msg.reply.set_exception(e)
         return worked
 
 
@@ -179,6 +234,7 @@ class _GlobalServer:
                         msg.reply.set_exception(
                             RuntimeError("parameter server freed")
                         )
+        inst.release_storage()
 
     def unregister(self, inst: _Instance) -> None:
         inst.freed = True  # immediate: send()/receive() reject from now on
@@ -358,8 +414,11 @@ class ParameterServer:
         return self._inst.freed
 
     def shard_of(self, rank: int) -> np.ndarray:
-        """Debug/introspection view of a rank's shard (copy)."""
-        return self._inst.shards[rank].copy()
+        """Debug/introspection view of a rank's shard (copy). Raises after
+        free() on every backend (storage may be released natively)."""
+        if self._inst.freed:
+            raise RuntimeError("parameter server freed")
+        return self._inst.read_shard(rank)
 
 
 def free_all() -> None:
