@@ -44,8 +44,6 @@ def _dispatch(op, x, comm, mode, backend=None, **kw):
             backend = selector.select(
                 op, platform, multinode=comm.num_nodes() > 1, mode=mode
             )
-            if backend == "pallas":
-                backend = "ring"  # eager pallas path: ops/ring_kernels
             cache[(op, mode)] = backend
     if mode == "sync":
         return eager.run(op, x, comm, backend=backend, **kw)

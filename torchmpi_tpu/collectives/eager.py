@@ -111,7 +111,7 @@ def _compile(
         spec = _rank_spec(ndim)
         kernel = build_kernel()
         shmapped = jax.shard_map(
-            kernel, mesh=mesh, in_specs=spec, out_specs=spec
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
         )
         donate = constants.get("donate_eager_buffers")
         fn = jax.jit(shmapped, donate_argnums=(0,) if donate else ())
@@ -160,6 +160,23 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple):
             "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
+    elif backend == "pallas":
+        # Pallas ICI-RDMA ring for allreduce; other ops take the ppermute
+        # ring (the reference similarly mixed transports per collective).
+        from ..ops.ring_kernels import ring_allreduce_pallas
+
+        def _pallas_bcast(b):
+            if "tree" in extra:
+                return prim.tree_broadcast(b, root, _AXIS)
+            return prim.ring_broadcast(b, root, _AXIS)
+
+        table = {
+            "allreduce": lambda b: ring_allreduce_pallas(b, _AXIS),
+            "broadcast": _pallas_bcast,
+            "reduce": lambda b: prim.ring_reduce(b, root, _AXIS),
+            "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
+            "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
+        }
     else:
         raise CollectiveArgumentError(f"unknown backend {backend!r}")
     if op not in table:
@@ -167,18 +184,18 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple):
     return table[op]
 
 
-def op_route(op: str, nelem: int, platform: str) -> str:
+def op_route(op: str, nelem: int, platform: str, requested: str = "ring") -> str:
     """Size-based latency/bandwidth routing (reference
-    ``collectives.cpp:296-301``): below the cutoff use the fused XLA path.
-    Returns the backend that should service a 'ring'-requested call."""
+    ``collectives.cpp:296-301``): below the cutoff use the fused XLA path,
+    above it the requested bandwidth backend (ring or pallas)."""
     suffix = "tpu" if platform != "cpu" else "cpu"
     if op == "allreduce":
         cutoff = constants.get(f"small_allreduce_size_{suffix}")
     elif op == "broadcast":
         cutoff = constants.get(f"small_broadcast_size_{suffix}")
     else:
-        return "ring"
-    return "xla" if nelem <= cutoff else "ring"
+        return requested
+    return "xla" if nelem <= cutoff else requested
 
 
 def run(
@@ -202,8 +219,8 @@ def run(
         x = x[:, None]
     platform = comm._devices[0].platform
     effective = backend
-    if backend == "ring" and route_small:
-        effective = op_route(op, _nelem_per_rank(x), platform)
+    if backend in ("ring", "pallas") and route_small:
+        effective = op_route(op, _nelem_per_rank(x), platform, backend)
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
     if effective == "ring" and op == "broadcast":
         suffix = "tpu" if platform != "cpu" else "cpu"

@@ -1,0 +1,84 @@
+"""Fused accumulation kernels (Pallas TPU).
+
+Analog of the reference's CUDA reduce kernel (``lib/detail/reduce_kernel.cu``:
+``out[i] += in[i]`` on a stream, vectorized float4 + __ldg, "2 SMs enough to
+saturate BW"). On TPU the VPU is fed from VMEM, so the kernel is a chunked
+grid over the flattened buffer with blocks sized to tile into (8, 128)
+lanes; XLA fuses most elementwise adds already — this kernel exists for the
+custom ring path, where the per-chunk accumulate must happen inside the
+Pallas collective, and as the standalone fused-add primitive the reference
+exposes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# 2-D blocks tile the VPU lanes: (rows, 128) with f32-aligned sublanes.
+_LANES = 128
+_ROWS = 1024  # 512KB f32 per operand block
+
+
+def _accumulate_kernel(out_ref, in_ref, result_ref):
+    result_ref[:] = out_ref[:] + in_ref[:]
+
+
+def _scale_add_kernel(alpha_ref, out_ref, in_ref, result_ref):
+    result_ref[:] = out_ref[:] + alpha_ref[0] * in_ref[:]
+
+
+def _to_rows(flat):
+    """Pad + reshape a flat buffer to [rows, 128] with rows % _ROWS == 0."""
+    n = flat.shape[0]
+    per_block = _ROWS * _LANES
+    padded = -(-n // per_block) * per_block
+    if padded != n:
+        flat = jnp.concatenate([flat, jnp.zeros(padded - n, flat.dtype)])
+    return flat.reshape(-1, _LANES), n
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def accumulate(out, inp, interpret: bool = False):
+    """``out + inp`` through the Pallas kernel (chunked grid), any shape."""
+    rows_out, n = _to_rows(out.reshape(-1))
+    rows_in, _ = _to_rows(inp.reshape(-1).astype(out.dtype))
+    grid = rows_out.shape[0] // _ROWS
+    spec = pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0))
+    res = pl.pallas_call(
+        _accumulate_kernel,
+        out_shape=jax.ShapeDtypeStruct(rows_out.shape, out.dtype),
+        grid=(grid,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(rows_out, rows_in)
+    return res.reshape(-1)[:n].reshape(out.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scale_accumulate(out, inp, alpha, interpret: bool = False):
+    """``out + alpha * inp`` (the PS 'add'-with-scale fused form)."""
+    rows_out, n = _to_rows(out.reshape(-1))
+    rows_in, _ = _to_rows(inp.reshape(-1).astype(out.dtype))
+    grid = rows_out.shape[0] // _ROWS
+    spec = pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0))
+    alpha_arr = jnp.asarray([alpha], out.dtype)
+    res = pl.pallas_call(
+        _scale_add_kernel,
+        out_shape=jax.ShapeDtypeStruct(rows_out.shape, out.dtype),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec,
+            spec,
+        ],
+        out_specs=spec,
+        interpret=interpret,
+    )(alpha_arr, rows_out, rows_in)
+    return res.reshape(-1)[:n].reshape(out.shape)

@@ -1,8 +1,206 @@
-"""Pallas ICI-RDMA ring collectives (cudaIPC-ring analog). Placeholder:
-implemented in ops/ring_kernels once the XLA paths are green."""
+"""Pallas ring collectives over ICI RDMA.
+
+TPU-native re-design of the reference's custom cudaIPC/p2p rings
+(``lib/detail/collectives_cuda.cpp:202-388``): the same receive-centric
+chunked ring — (p-1) reduce-scatter steps, (p-1) all-gather steps — but the
+transport is inter-chip RDMA (``pltpu.make_async_remote_copy``) instead of
+cudaMemcpy over IPC pointers, the staging buffers are double-buffered VMEM
+scratch (the reference's per-chunk GPU staging buffers + IPC events,
+``:163-195``), and the per-chunk accumulate is the fused add that
+``reduce_kernel.cu`` provided.
+
+Step discipline: every step ends with ``copy.wait()`` (send done + the
+symmetric incoming chunk arrived), which in lockstep SPMD guarantees the
+neighbor consumed a slot two steps before it is overwritten — the
+double-buffer capacity argument the reference enforced with interprocess
+events and per-step MPI barriers (``:65-66,100-101``).
+
+The kernel runs under ``shard_map`` (one program per device). With one local
+chip this path cannot execute on hardware; correctness is validated in TPU
+interpret mode (``pltpu.InterpretParams``) on the virtual CPU mesh, and
+``available()`` gates the eager selector to real multi-chip TPU meshes.
+"""
 
 from __future__ import annotations
 
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_MIN_ROWS = 8  # f32 sublane tile
+
 
 def available() -> bool:
-    return False
+    """True when the pallas ring can service eager collectives: a real TPU
+    platform with more than one device."""
+    try:
+        devs = jax.devices()
+    except Exception:
+        return False
+    return devs[0].platform == "tpu" and len(devs) > 1
+
+
+def _ring_allreduce_kernel(
+    p: int, axis: str, my_ref, x_ref, o_ref, comm_buf, send_sem, recv_sem, cap_sem
+):
+    """One device's program: x_ref/o_ref are [p, rows, 128]; comm_buf is
+    [2, rows, 128] scratch; my_ref is the device's ring position (SMEM).
+
+    Capacity discipline: ``copy.wait()`` proves our data LANDED in the right
+    neighbor's slot, not that the neighbor CONSUMED it — a fast sender could
+    clobber slot k at step t+2 while a slow receiver still reads step t's
+    data. ``cap_sem[slot]`` closes that race: the consumer signals its LEFT
+    neighbor after reading a slot, and a sender reusing a slot (t >= 2)
+    waits for that signal first. Consumes at the last two steps don't
+    signal, so all semaphores end the kernel drained (state persists across
+    pallas invocations, incl. interpret mode — leftovers would poison the
+    next collective).
+    """
+    my = my_ref[0]
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my + p - 1, p)
+    o_ref[:] = x_ref[:]
+
+    # neighbor barrier: nobody starts pushing until both neighbors arrived
+    # (the reference's per-collective MPI barrier before the IPC ring)
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier,
+        inc=1,
+        device_id={axis: left},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_signal(
+        barrier,
+        inc=1,
+        device_id={axis: right},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    total = 2 * (p - 1)
+
+    def ring_step(t: int, send_idx, recv_idx, accumulate: bool):
+        slot = t % 2
+        if t >= 2:  # slot reuse: wait until right consumed our step t-2 data
+            pltpu.semaphore_wait(cap_sem.at[slot], 1)
+        copy = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[send_idx],
+            dst_ref=comm_buf.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        copy.start()
+        copy.wait()
+        if accumulate:
+            o_ref[recv_idx] = o_ref[recv_idx] + comm_buf[slot]
+        else:
+            o_ref[recv_idx] = comm_buf[slot]
+        if t < total - 2:  # tell LEFT its slot is free for step t+2
+            pltpu.semaphore_signal(
+                cap_sem.at[slot],
+                inc=1,
+                device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+    # reduce-scatter: step s sends chunk (my - s), accumulates (my - s - 1)
+    for s in range(p - 1):
+        ring_step(
+            s,
+            lax.rem(my - s + p, p),
+            lax.rem(my - s - 1 + p, p),
+            accumulate=True,
+        )
+
+    # all-gather: step s sends (my + 1 - s) (fully reduced), installs (my - s)
+    for s in range(p - 1):
+        ring_step(
+            p - 1 + s,
+            lax.rem(my + 1 - s + 2 * p, p),
+            lax.rem(my - s + p, p),
+            accumulate=False,
+        )
+
+
+# VMEM budget per kernel invocation: x + o ([p, rows, 128] each) plus the
+# [2, rows, 128] scratch must fit comfortably in ~16MB/core.
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+# test hook: force interpret mode for every call (lets the eager dispatch
+# path be exercised on the CPU mesh)
+_FORCE_INTERPRET = False
+
+
+def _max_rows(p: int) -> int:
+    per_row_bytes = (2 * p + 2) * _LANES * 4  # x + o + double buffer
+    rows = _VMEM_BUDGET_BYTES // per_row_bytes
+    return max(_MIN_ROWS, rows // _MIN_ROWS * _MIN_ROWS)
+
+
+def _ring_allreduce_call(chunks, p, axis, rows, interpret):
+    my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
+    kernel = functools.partial(_ring_allreduce_kernel, p, axis)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((p, rows, _LANES), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=7),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(my, chunks)
+
+
+def ring_allreduce_pallas(
+    x,
+    axis: str = "mpi",
+    axis_size: Optional[int] = None,
+    interpret: bool = False,
+):
+    """Allreduce the per-device block ``x`` over mesh axis ``axis`` with the
+    Pallas RDMA ring. Call inside ``shard_map`` (any mesh shape: devices are
+    addressed by mesh coordinates along ``axis``). f32 math; any shape.
+    Buffers larger than the VMEM budget are ring-reduced in sequential
+    segments (the reference's kMin/kMaxBufferSize chunking, constants.cpp:
+    142-145)."""
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x
+    interpret = interpret or _FORCE_INTERPRET
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    rows = -(-n // (p * _LANES))
+    rows = -(-rows // _MIN_ROWS) * _MIN_ROWS  # sublane-align each chunk
+    max_rows = _max_rows(p)
+    seg_rows = min(rows, max_rows)
+    padded = p * seg_rows * _LANES
+    num_segments = -(-n // padded)
+    total = num_segments * padded
+    if total != n:
+        flat = jnp.concatenate([flat, jnp.zeros(total - n, jnp.float32)])
+    outs = []
+    for seg in range(num_segments):
+        chunk = flat[seg * padded : (seg + 1) * padded].reshape(
+            p, seg_rows, _LANES
+        )
+        outs.append(_ring_allreduce_call(chunk, p, axis, seg_rows, interpret))
+    out = jnp.concatenate([o.reshape(-1) for o in outs]) if len(outs) > 1 else outs[0].reshape(-1)
+    return out[:n].reshape(orig_shape).astype(orig_dtype)
