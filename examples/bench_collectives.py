@@ -1,0 +1,68 @@
+"""Collectives benchmark — the ``test/collectives_all.lua -benchmark`` run:
+size sweep with per-op bus-bandwidth reporting on the current devices.
+
+Run: python examples/bench_collectives.py [--cpu-mesh 8] [--ops allreduce]
+     [--backends xla,ring] [--max-pow 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="broadcast,reduce,allreduce,allgather")
+    ap.add_argument("--backends", default="xla,ring")
+    ap.add_argument("--modes", default="sync")
+    ap.add_argument("--min-pow", type=int, default=12)
+    ap.add_argument("--max-pow", type=int, default=20)
+    ap.add_argument("--cpu-mesh", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.cpu_mesh:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.utils.tester import run_matrix, sweep_sizes
+
+    mpi.start()
+    comm = mpi.current_communicator()
+    print(f"devices={comm.size} platform={comm.devices[0].platform}")
+    print(f"{'op':<12}{'backend':<9}{'elements':>10}{'us':>12}{'busGB/s':>10}  ok")
+
+    def report(r):
+        print(
+            f"{r.op:<12}{r.backend:<9}{r.nelem:>10}{r.mean_us:>12.1f}"
+            f"{r.bus_gbps:>10.2f}  {'yes' if r.correct else 'NO'}"
+        )
+
+    results = run_matrix(
+        comm,
+        ops=args.ops.split(","),
+        backends=args.backends.split(","),
+        modes=args.modes.split(","),
+        sizes=sweep_sizes(args.min_pow, args.max_pow),
+        benchmark=True,
+        report=report,
+    )
+    bad = [r for r in results if not r.correct]
+    print(f"{len(results)} configs, {len(bad)} incorrect")
+    mpi.stop()
+    return len(bad)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

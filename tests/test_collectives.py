@@ -79,6 +79,7 @@ def test_allreduce_dtypes(dtype):
 @pytest.mark.parametrize("root", [0, 3, 7])
 def test_broadcast_closed_form(backend, mode, root):
     p = mpi.size()
+    root = root % p  # mesh-size adaptive (scripts/test_all.sh sweeps p)
     x = _ranks_block(p, 1000, jnp.float32)
     ns = _ns(backend, mode)
     out = _run(lambda: ns.broadcast_tensor(x, root=root), mode)
@@ -89,6 +90,7 @@ def test_broadcast_closed_form(backend, mode, root):
 @pytest.mark.parametrize("root", [0, 5])
 def test_reduce_closed_form(backend, root):
     p = mpi.size()
+    root = root % p
     x = _ranks_block(p, 777, jnp.float32)
     out = np.asarray(_ns(backend, "sync").reduce_tensor(x, root=root))
     np.testing.assert_array_equal(out[root], p * (p - 1) / 2)
@@ -114,11 +116,14 @@ def test_allgather_closed_form(backend, mode):
 @pytest.mark.parametrize("backend", BACKENDS)
 def test_sendreceive(backend):
     p = mpi.size()
+    src, dst = 2 % p, 5 % p
+    if src == dst:
+        src, dst = 0, p - 1
     x = _ranks_block(p, 64, jnp.float32)
-    out = np.asarray(_ns(backend, "sync").sendreceive_tensor(x, src=2, dst=5))
-    np.testing.assert_array_equal(out[5], 2)
+    out = np.asarray(_ns(backend, "sync").sendreceive_tensor(x, src=src, dst=dst))
+    np.testing.assert_array_equal(out[dst], src)
     for r in range(p):
-        if r != 5:
+        if r != dst:
             np.testing.assert_array_equal(out[r], r)
 
 
@@ -226,16 +231,17 @@ def test_tree_vs_pipeline_broadcast_cutoff():
     p = mpi.size()
     comm = mpi.current_communicator()
     mpi.constants.set("small_broadcast_size_cpu", 1)
+    root = 2 % p
     x = _ranks_block(p, 512, jnp.float32)  # 2KB per rank
     np.testing.assert_array_equal(
-        np.asarray(mpi.ring.broadcast_tensor(x, root=2)), 2
+        np.asarray(mpi.ring.broadcast_tensor(x, root=root)), root
     )
     n_cached = len(comm._collective_resources)
     # Drop the cutoff below 2KB: same shape now takes the pipeline variant,
     # compiling a distinct executable.
     mpi.constants.set("broadcast_size_tree_based_cpu", 1024)
     np.testing.assert_array_equal(
-        np.asarray(mpi.ring.broadcast_tensor(x, root=2)), 2
+        np.asarray(mpi.ring.broadcast_tensor(x, root=root)), root
     )
     assert len(comm._collective_resources) == n_cached + 1
 

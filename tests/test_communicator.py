@@ -9,6 +9,11 @@ cartesian-ness are asserted (lua:50-74).
 import jax
 import pytest
 
+
+def _need8():
+    if len(jax.devices()) != 8:
+        pytest.skip("topology fixture assumes 8 ranks (mesh sweep)")
+
 import torchmpi_tpu as mpi
 from torchmpi_tpu.runtime.communicator import (
     Communicator,
@@ -32,6 +37,7 @@ def test_start_twice_raises():
 
 
 def test_key_split_mod2():
+    _need8()
     """Keys rank%2 -> 2 intra groups of 4, cartesian."""
     mpi.start()
     level = mpi.push_communicator(lambda r: str(r % 2), name="mod2")
@@ -47,6 +53,7 @@ def test_key_split_mod2():
 
 
 def test_key_split_ragged_is_tree():
+    _need8()
     """Unequal group sizes force tree (non-cartesian) topology
     (resources.cpp:266-280)."""
     mpi.start()
@@ -62,6 +69,7 @@ def test_key_split_ragged_is_tree():
 
 
 def test_tree_mode_forced():
+    _need8()
     """with_cartesian_communicator=False forces tree even for equal groups
     (the reference's tree-vs-cartesian start flag, init.lua:61-65)."""
     mpi.start(with_cartesian_communicator=False)
@@ -85,6 +93,7 @@ def test_span_semantics():
 
 
 def test_three_level_hierarchy():
+    _need8()
     """Mirror of the lua test's div in {2,4}: nested splits give consistent
     intra sizes."""
     mpi.start()
@@ -97,6 +106,7 @@ def test_three_level_hierarchy():
 
 
 def test_nested_split_refines_parent():
+    _need8()
     """Pushing splits the CURRENT communicator (torch_mpi.cpp:75-79): devices
     in different parent intra groups never share a child group."""
     mpi.start()
@@ -116,12 +126,14 @@ def test_nested_split_refines_parent():
 
 
 def test_oversized_key_rejected():
+    _need8()
     mpi.start()
     with pytest.raises(CommunicatorError):
         mpi.push_communicator(["x" * 2000] * 8)
 
 
 def test_communicator_mesh_shapes():
+    _need8()
     mpi.start()
     mpi.push_communicator(lambda r: str(r % 2))
     comm = mpi.current_communicator()
@@ -133,6 +145,7 @@ def test_communicator_mesh_shapes():
 
 
 def test_describe_and_names():
+    _need8()
     mpi.start()
     mpi.push_communicator(lambda r: str(r // 4), name="nodes")
     s = mpi.current_communicator().describe()
@@ -145,4 +158,4 @@ def test_stop_resets():
     mpi.stop()
     assert not mpi.started()
     mpi.start()  # restartable
-    assert mpi.size() == 8
+    assert mpi.size() == len(jax.devices())

@@ -151,7 +151,7 @@ def test_fused_sync_preserves_integer_leaves():
     assert out["count"].dtype == jnp.int32
     assert int(np.asarray(out["count"])[0, 0]) == big
     out2 = mpinn.synchronize_gradients({"n": jnp.full((p, 1), big, jnp.int64)})
-    assert int(np.asarray(out2["n"])[3, 0]) == big * p
+    assert int(np.asarray(out2["n"])[p - 1, 0]) == big * p
 
 
 def test_check_with_allreduce_consistent():
@@ -164,6 +164,8 @@ def test_check_with_allreduce_consistent():
 
 def test_check_with_allreduce_detects_desync():
     p = mpi.size()
+    if p == 1:
+        pytest.skip("desync is undefined with a single replica")
     rng = np.random.RandomState(5)
     vals = rng.randn(p, 50).astype(np.float32)  # every replica different
     with pytest.raises(AssertionError, match="desync"):

@@ -44,6 +44,8 @@ def test_accumulate_large_multiblock():
 def test_pallas_ring_allreduce_interpret(p, n):
     """The RDMA ring allreduce (interpret mode) must equal the sum across
     devices, including non-divisible and sublane-padded sizes."""
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
     mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
     rng = np.random.RandomState(p * 1000 + n)
     x = rng.randn(p, n).astype(np.float32)
@@ -64,6 +66,8 @@ def test_pallas_ring_allreduce_interpret(p, n):
 
 
 def test_pallas_ring_multidim_and_dtype():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
     p = 4
     mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
     rng = np.random.RandomState(9)
@@ -104,6 +108,8 @@ def test_available_gating():
 
 
 def test_pallas_ring_2d_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
     """MESH-coordinate addressing: the ring over one axis of a 2-D mesh must
     stay within its row (a LOGICAL flat id would cross rows)."""
     mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("x", "mpi"))
@@ -125,6 +131,8 @@ def test_pallas_ring_2d_mesh():
 
 
 def test_pallas_ring_vmem_segmentation():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
     """Buffers beyond the VMEM budget split into sequential ring segments."""
     from torchmpi_tpu.ops import ring_kernels as rk
 

@@ -1,0 +1,146 @@
+"""Tester harness, checkpoint, tracing utilities."""
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+
+
+def test_sweep_sizes_protocol():
+    from torchmpi_tpu.utils.tester import sweep_sizes
+
+    sizes = sweep_sizes(8, 23)
+    assert len(sizes) == 16
+    assert sizes[0] >= 1 << 8 and sizes[-1] >= 1 << 23
+    # jitter is deterministic per seed
+    assert sweep_sizes(8, 23) == sweep_sizes(8, 23)
+    assert sweep_sizes(8, 10, jitter_seed=None) == [256, 512, 1024]
+
+
+def test_bus_bandwidth_models():
+    from torchmpi_tpu.utils.tester import bus_bytes
+
+    # BASELINE.md analytic models
+    assert bus_bytes("allreduce", 1000, 8) == 2 * 1000 * 7 / 8
+    assert bus_bytes("broadcast", 1000, 8) == 1000
+    assert bus_bytes("reduce", 1000, 8) == 1000
+    assert bus_bytes("allgather", 1000, 8) == 7000
+
+
+def test_run_one_config_correctness_modes():
+    from torchmpi_tpu.utils.tester import run_one_config
+
+    comm = mpi.current_communicator()
+    for op in ("allreduce", "broadcast", "reduce", "allgather"):
+        res = run_one_config(op, 512, comm, backend="ring", mode="sync")
+        assert res.correct, op
+    res = run_one_config("allreduce", 256, comm, backend="xla", mode="async",
+                         benchmark=True, warmup=1, timed=2)
+    assert res.correct and res.mean_us > 0
+    if comm.size > 1:  # ring-model volume is 0 for a single rank
+        assert res.bus_gbps > 0
+
+
+def test_engine_checkpoint_roundtrip(tmp_path):
+    import jax
+    import optax
+
+    from torchmpi_tpu.engine import AllReduceSGDEngine
+    from torchmpi_tpu.models import LogisticRegression, init_params, make_loss_fn
+    from torchmpi_tpu.utils import checkpoint
+    from torchmpi_tpu.utils.data import synthetic_mnist
+
+    p = mpi.size()
+    (xtr, ytr), _ = synthetic_mnist(num_train=256, num_test=1)
+    model = LogisticRegression()
+    params = init_params(model, (1, 28, 28))
+    engine = AllReduceSGDEngine(make_loss_fn(model), params, optimizer=optax.sgd(0.1))
+    x = xtr[: 2 * p].reshape(p, 2, 28, 28)
+    y = ytr[: 2 * p].reshape(p, 2)
+    engine.train(lambda: iter([(x, y)]), max_epochs=1)
+
+    checkpoint.save_engine(tmp_path / "ck", engine, step=7, extra={"tag": "t"})
+    trained = jax.device_get(engine.params)
+
+    engine2 = AllReduceSGDEngine(make_loss_fn(model), params, optimizer=optax.sgd(0.1))
+    meta = checkpoint.restore_engine(tmp_path / "ck", engine2)
+    assert meta["step"] == 7 and meta["tag"] == "t"
+    restored = jax.device_get(engine2.params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(trained), jax.tree_util.tree_leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored engine continues training
+    engine2.train(lambda: iter([(x, y)]), max_epochs=1)
+
+
+def test_ps_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from torchmpi_tpu.parameterserver import PSGroup, free_all
+    from torchmpi_tpu.utils import checkpoint
+
+    p = mpi.size()
+    tree = {"w": jnp.asarray(np.random.RandomState(0).randn(p, 33), jnp.float32)}
+    grp = PSGroup(tree)
+    grp.servers[0].send(np.full(33, 5.0, np.float32), rule="copy").wait()
+    checkpoint.save_parameter_servers(tmp_path / "ps", grp)
+
+    grp2 = PSGroup(tree)
+    checkpoint.restore_parameter_servers(tmp_path / "ps", grp2)
+    np.testing.assert_array_equal(grp2.servers[0].receive().wait(), 5.0)
+    grp.free()
+    grp2.free()
+    free_all()
+
+
+def test_vlog_and_timer(capsys):
+    from torchmpi_tpu.utils import tracing
+
+    tracing.set_debug_level(1)
+    tracing.vlog(1, "visible")
+    tracing.vlog(2, "hidden")
+    err = capsys.readouterr().err
+    assert "visible" in err and "hidden" not in err
+    tracing.set_debug_level(0)
+
+    t = tracing.Timer()
+    assert t.time() >= 0
+
+
+def test_profiler_window(tmp_path):
+    from torchmpi_tpu.utils.tracing import ProfilerWindow
+
+    win = ProfilerWindow(str(tmp_path / "trace"), begin=1, end=2)
+    for s in range(4):
+        win.step(s)
+    win.close()
+    assert any(tmp_path.glob("trace/**/*")), "trace files written"
+
+
+def test_deadlock_watchdog():
+    """The PS send watchdog (10s-spin-abort analog) fires when the server
+    can never apply the update."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import ParameterServer
+    from torchmpi_tpu.parameterserver.server import _server
+
+    constants.set("deadlock_timeout_seconds", 1)
+    ps = ParameterServer(np.zeros(4, np.float32))
+    # simulate a dead server: stop the polling thread without draining
+    _server._terminate.set()
+    if _server._thread is not None:
+        _server._thread.join(timeout=5)
+    h = ps.send(np.ones(4, np.float32), rule="add")
+    with pytest.raises(RuntimeError, match="deadlock"):
+        h.wait()
+    constants.set("deadlock_timeout_seconds", 0)
+    from torchmpi_tpu.parameterserver import free_all
+
+    free_all()

@@ -81,6 +81,11 @@ class _Constants:
     # default custom-ring implementation: 'ppermute' (pure XLA, portable) or
     # 'pallas' (ICI RDMA kernels, TPU only).
     ring_implementation: str = "ppermute"
+    # Deadlock watchdog for host-side waits (parameter-server client ops):
+    # seconds before a blocked wait aborts with a diagnostic. 0 disables.
+    # Analog of the reference's 10s spin-acquire abort (resources.cpp:
+    # 124-133), its only runtime failure detector.
+    deadlock_timeout_seconds: int = 0
     # Use the native C++ runtime (csrc/libtpumpi.so) for PS shard storage,
     # handle registry, and plans when it is available; pure-Python fallback
     # otherwise (analog of the reference's optional-backend detection).

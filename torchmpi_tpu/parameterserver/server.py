@@ -376,8 +376,15 @@ class ParameterServer:
                     ),
                 )
                 events.append(ev)
+            timeout = constants.get("deadlock_timeout_seconds") or None
             for ev in events:
-                ev.wait()
+                if not ev.wait(timeout):
+                    # the reference's spin-abort failure detector
+                    raise RuntimeError(
+                        f"parameter-server send blocked > {timeout}s "
+                        "(possible deadlock: server thread dead or "
+                        "mismatched collective ordering)"
+                    )
 
         return SyncHandle(future=parameterserver_pool.submit(do_send))
 
@@ -397,9 +404,17 @@ class ParameterServer:
                 inst.post(r, _Message("trigger", client=client, reply=f))
                 replies.append(f)
             out = np.empty((int(np.prod(shape)),), dtype)
+            timeout = constants.get("deadlock_timeout_seconds") or None
             for r, f in enumerate(replies):
                 s, e = inst.ranges[r]
-                out[s:e] = f.result()
+                try:
+                    out[s:e] = f.result(timeout)
+                except TimeoutError:
+                    raise RuntimeError(
+                        f"parameter-server receive blocked > {timeout}s "
+                        "(possible deadlock: server thread dead or "
+                        "mismatched collective ordering)"
+                    ) from None
             return out.reshape(shape)
 
         return SyncHandle(future=parameterserver_pool.submit(do_receive))

@@ -1,3 +1,12 @@
 from .data import DistributedIterator, load_mnist_idx, synthetic_mnist
+from .tracing import ProfilerWindow, Timer, set_debug_level, vlog
 
-__all__ = ["DistributedIterator", "synthetic_mnist", "load_mnist_idx"]
+__all__ = [
+    "DistributedIterator",
+    "synthetic_mnist",
+    "load_mnist_idx",
+    "ProfilerWindow",
+    "Timer",
+    "vlog",
+    "set_debug_level",
+]

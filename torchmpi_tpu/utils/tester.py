@@ -1,0 +1,160 @@
+"""Collective correctness/benchmark harness.
+
+Analog of ``torchmpi/tester.lua`` + the measurement protocol of
+``test/collectives_all.lua``: size sweep 2^8..2^23 elements with random
+jitter (``tester.lua:43-47``), correctness on the first run from closed-form
+values (rank r contributes r), benchmark mode = 10 warmup + 10 timed runs
+reporting µs and effective bus GB/s from the analytic communication-volume
+models (``tester.lua:103-126``, ``collectives_all.lua:313-318``):
+
+- allreduce: ``2 n (p-1)/p`` bytes moved per rank (ring model)
+- broadcast / reduce: ``n`` bytes (pipelined model)
+- allgather: ``n (p-1)`` bytes
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import collectives
+from ..runtime.communicator import Communicator
+
+
+def sweep_sizes(
+    min_pow: int = 8, max_pow: int = 23, jitter_seed: Optional[int] = 0
+) -> List[int]:
+    """2^min..2^max with the reference's random jitter on each size."""
+    rng = np.random.RandomState(jitter_seed)
+    sizes = []
+    for k in range(min_pow, max_pow + 1):
+        base = 1 << k
+        jitter = int(rng.randint(0, max(1, base // 8))) if jitter_seed is not None else 0
+        sizes.append(base + jitter)
+    return sizes
+
+
+def bus_bytes(op: str, nbytes: int, p: int) -> float:
+    """Analytic communication volume per rank (BASELINE.md models)."""
+    if op == "allreduce":
+        return 2 * nbytes * (p - 1) / p
+    if op in ("broadcast", "reduce"):
+        return float(nbytes)
+    if op == "allgather":
+        return float(nbytes * (p - 1))
+    if op == "sendreceive":
+        return float(nbytes)
+    raise ValueError(op)
+
+
+@dataclass
+class BenchResult:
+    op: str
+    backend: str
+    nelem: int
+    mean_us: float
+    bus_gbps: float
+    correct: bool
+
+
+_EXPECTED = {
+    "allreduce": lambda p, root: p * (p - 1) / 2,
+    "broadcast": lambda p, root: float(root),
+    "reduce": lambda p, root: p * (p - 1) / 2,  # on root only
+}
+
+
+def run_one_config(
+    op: str,
+    nelem: int,
+    comm: Communicator,
+    backend: Optional[str] = None,
+    mode: str = "sync",
+    benchmark: bool = False,
+    warmup: int = 10,
+    timed: int = 10,
+    root: int = 0,
+) -> BenchResult:
+    """One (op, size, backend, mode) cell of the config matrix
+    (``tester.runOneConfig``). Correctness is always checked on the first
+    run; benchmark mode adds the timed loop."""
+    p = comm.size
+    x = jnp.tile(
+        jnp.arange(p, dtype=jnp.float32)[:, None], (1, max(1, nelem))
+    )
+    ns = collectives.async_ if mode == "async" else collectives
+    if backend:
+        ns = getattr(ns, backend) if backend != "selector" else ns
+
+    def call():
+        if op == "allreduce":
+            r = ns.allreduce_tensor(x, comm=comm)
+        elif op == "broadcast":
+            r = ns.broadcast_tensor(x, root=root, comm=comm)
+        elif op == "reduce":
+            r = ns.reduce_tensor(x, root=root, comm=comm)
+        elif op == "allgather":
+            r = ns.allgather_tensor(x, comm=comm)
+        elif op == "sendreceive":
+            r = ns.sendreceive_tensor(x, src=0, dst=p - 1, comm=comm)
+        else:
+            raise ValueError(op)
+        if mode == "async":
+            r = r.wait()
+        return r
+
+    out = np.asarray(jax.block_until_ready(call()))
+    correct = True
+    if op in ("allreduce", "broadcast"):
+        correct = bool(np.allclose(out, _EXPECTED[op](p, root)))
+    elif op == "reduce":
+        correct = bool(np.allclose(out[root], p * (p - 1) / 2))
+    elif op == "allgather":
+        expect = np.repeat(np.arange(p, dtype=np.float32), out.shape[1] // p)
+        correct = bool(np.allclose(out[0], expect))
+
+    mean_us = float("nan")
+    gbps = float("nan")
+    if benchmark:
+        for _ in range(warmup):
+            call()
+        jax.block_until_ready(call())
+        t0 = time.perf_counter()
+        for _ in range(timed):
+            r = call()
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / timed
+        mean_us = dt * 1e6
+        nbytes = nelem * 4
+        gbps = bus_bytes(op, nbytes, p) / dt / 1e9
+    return BenchResult(op, backend or "selector", nelem, mean_us, gbps, correct)
+
+
+def run_matrix(
+    comm: Communicator,
+    ops: Iterable[str] = ("broadcast", "reduce", "allreduce", "allgather"),
+    backends: Iterable[str] = ("xla", "ring"),
+    modes: Iterable[str] = ("sync", "async"),
+    sizes: Optional[List[int]] = None,
+    benchmark: bool = False,
+    report: Optional[Callable[[BenchResult], None]] = None,
+) -> List[BenchResult]:
+    """The full config-matrix sweep (``collectives_all.lua:554-598``)."""
+    sizes = sizes or sweep_sizes()
+    results = []
+    for op in ops:
+        for backend in backends:
+            for mode in modes:
+                for n in sizes:
+                    res = run_one_config(
+                        op, n, comm, backend, mode, benchmark=benchmark
+                    )
+                    results.append(res)
+                    if report:
+                        report(res)
+    return results

@@ -1,0 +1,110 @@
+"""Tracing / profiling / logging utilities.
+
+Reference analogs (SURVEY.md §5):
+
+- nvprof window between fixed steps (``sgdengine.lua:38-63``, ``wrap.sh``
+  NVPROF=1) → :class:`ProfilerWindow` around ``jax.profiler`` traces (the
+  engine wires this via ``profile_dir``/``profile_window``).
+- ``VLOG_1/VLOG_2`` compile-time debug macros with thread ids
+  (``resources.h:43-53``) → :func:`vlog` gated by the
+  ``TORCHMPI_TPU_DEBUG`` env var (0/1/2).
+- per-rank log redirection ``LOG_TO_FILE=1`` → ``/tmp/mpi_<rank>``
+  (``wrap.sh:70-77``) → :func:`redirect_logs_per_process`.
+- ``torch.Timer`` benchmark timing (``tester.lua``) → :class:`Timer`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+_DEBUG_LEVEL = int(os.environ.get("TORCHMPI_TPU_DEBUG", "0") or 0)
+
+
+def debug_level() -> int:
+    return _DEBUG_LEVEL
+
+
+def set_debug_level(level: int) -> None:
+    global _DEBUG_LEVEL
+    _DEBUG_LEVEL = int(level)
+
+
+def vlog(level: int, msg: str) -> None:
+    """VLOG-style leveled debug logging with thread id (resources.h:43-53)."""
+    if _DEBUG_LEVEL >= level:
+        tid = threading.get_ident() & 0xFFFF
+        print(f"[tm:{level}][t{tid:04x}] {msg}", file=sys.stderr, flush=True)
+
+
+class Timer:
+    """torch.Timer-alike: lap timing for benchmark loops."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def time(self) -> float:
+        return time.perf_counter() - self._t0
+
+
+class ProfilerWindow:
+    """Open a jax.profiler trace for steps [begin, end) — the engine's
+    nvprof-window analog, usable standalone:
+
+        win = ProfilerWindow('/tmp/trace', 3, 8)
+        for step in ...:
+            win.step(step)   # starts/stops the trace at the boundaries
+    """
+
+    def __init__(self, log_dir: str, begin: int = 3, end: int = 8):
+        self.log_dir = log_dir
+        self.begin = begin
+        self.end = end
+        self._active = False
+
+    def step(self, step: int) -> None:
+        import jax
+
+        if step == self.begin and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif step >= self.end and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def redirect_logs_per_process(directory: str = "/tmp", prefix: str = "tm_") -> Path:
+    """Redirect this process's stdout/stderr to ``<dir>/<prefix><rank>``
+    (wrap.sh LOG_TO_FILE analog). Returns the log path."""
+    import jax
+
+    rank = jax.process_index()
+    path = Path(directory) / f"{prefix}{rank}"
+    f = open(path, "a", buffering=1)
+    os.dup2(f.fileno(), sys.stdout.fileno())
+    os.dup2(f.fileno(), sys.stderr.fileno())
+    return path
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named trace annotation (shows up in the profiler timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
