@@ -50,14 +50,16 @@ def main():
     comm = mpi.current_communicator()
     p = comm.size
 
-    (xtr, ytr), _ = synthetic_mnist(num_train=16384, num_test=1)
+    (xtr, ytr), _ = synthetic_mnist(num_train=65536, num_test=1)
     model = LeNet(dtype=__import__("jax.numpy", fromlist=["bfloat16"]).bfloat16)
     params = init_params(model, (1, 28, 28))
     engine = AllReduceSGDEngine(
         make_loss_fn(model), params, optimizer=optax.sgd(0.05), mode="sync"
     )
 
-    per_rank = 256  # large per-chip batch: keep the MXU busy
+    # Large per-chip batch saturates the MXU (swept 256..8192; 4096 peak),
+    # capped so every chip count up to 64 still gets >= 2 batches/epoch.
+    per_rank = min(4096, max(256, 65536 // (2 * p)))
     batch = per_rank * p
     it = DistributedIterator(
         xtr, ytr, batch, p, sharding=engine.batch_sharding, prefetch=2

@@ -163,6 +163,23 @@ def test_engine_async_mlp_convergence():
     np.testing.assert_allclose(finals["async"], finals["sync"], rtol=1e-4)
 
 
+def test_engine_does_not_donate_caller_params():
+    """The jitted step donates its inputs; the engine must own copies so
+    the caller's params (which device_put may alias on matching shardings)
+    survive training — and can seed a second engine."""
+    p = mpi.size()
+    model = LogisticRegression()
+    params = init_params(model, (1, 28, 28))
+    x = np.zeros((p, 2, 28, 28), np.float32)
+    y = np.zeros((p, 2), np.int32)
+    for _ in range(2):  # second engine reuses the same caller-owned params
+        engine = AllReduceSGDEngine(make_loss_fn(model), params)
+        engine.train(lambda: iter([(x, y)]), max_epochs=1)
+    # caller's tree still readable
+    for leaf in jax.tree_util.tree_leaves(params):
+        np.asarray(leaf)
+
+
 def test_engine_rejects_bad_mode():
     model = LogisticRegression()
     params = init_params(model, (1, 28, 28))

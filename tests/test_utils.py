@@ -80,6 +80,31 @@ def test_engine_checkpoint_roundtrip(tmp_path):
     engine2.train(lambda: iter([(x, y)]), max_epochs=1)
 
 
+def test_engine_checkpoint_adam_state(tmp_path):
+    """Stateful optimizers (namedtuple opt states) must restore with their
+    typed structure and keep training."""
+    import jax
+    import optax
+
+    from torchmpi_tpu.engine import AllReduceSGDEngine
+    from torchmpi_tpu.models import LogisticRegression, init_params, make_loss_fn
+    from torchmpi_tpu.utils import checkpoint
+
+    p = mpi.size()
+    model = LogisticRegression()
+    params = init_params(model, (1, 28, 28))
+    x = np.zeros((p, 2, 28, 28), np.float32)
+    y = np.zeros((p, 2), np.int32)
+    engine = AllReduceSGDEngine(make_loss_fn(model), params, optimizer=optax.adam(1e-3))
+    engine.train(lambda: iter([(x, y)]), max_epochs=1)
+    checkpoint.save_engine(tmp_path / "ck", engine, step=1)
+
+    engine2 = AllReduceSGDEngine(make_loss_fn(model), params, optimizer=optax.adam(1e-3))
+    checkpoint.restore_engine(tmp_path / "ck", engine2)
+    # adam's mu/nu must be typed and usable by the next update
+    engine2.train(lambda: iter([(x, y)]), max_epochs=1)
+
+
 def test_ps_checkpoint_roundtrip(tmp_path):
     import jax.numpy as jnp
 

@@ -105,13 +105,19 @@ class AllReduceSGDEngine:
         self.batch_sharding = NamedSharding(self.mesh, P(_AXIS))
         self.replicated = NamedSharding(self.mesh, P())
 
-        # Replicate initial params/opt state across the communicator.
-        self.params = jax.device_put(params, self.replicated)
-        self.model_state = (
-            jax.device_put(model_state, self.replicated)
-            if model_state is not None
-            else None
-        )
+        # Replicate initial params/opt state across the communicator. Copy
+        # defensively: device_put may alias the caller's buffers when the
+        # sharding already matches (single device), and the jitted step
+        # DONATES its inputs — without the copy, the caller's params would
+        # be deleted by the first step.
+        def _own(tree):
+            return jax.device_put(
+                jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), tree),
+                self.replicated,
+            )
+
+        self.params = _own(params)
+        self.model_state = _own(model_state) if model_state is not None else None
         self.opt_state = jax.device_put(
             self.optimizer.init(params), self.replicated
         )

@@ -40,7 +40,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -409,7 +409,9 @@ class ParameterServer:
                 s, e = inst.ranges[r]
                 try:
                     out[s:e] = f.result(timeout)
-                except TimeoutError:
+                except FuturesTimeoutError:
+                    # concurrent.futures.TimeoutError is not the builtin
+                    # TimeoutError before Python 3.11
                     raise RuntimeError(
                         f"parameter-server receive blocked > {timeout}s "
                         "(possible deadlock: server thread dead or "

@@ -42,9 +42,19 @@ def save_engine(path, engine, step: int = 0, extra: Optional[Dict] = None) -> No
 def restore_engine(path, engine) -> Dict[str, Any]:
     """Restore state saved by :func:`save_engine` into the engine (device
     placement follows the engine's replicated sharding). Returns the meta
-    dict (incl. ``step``)."""
+    dict (incl. ``step``).
+
+    The engine's current state is passed as the restore template so typed
+    pytree nodes (optax namedtuple states like ScaleByAdamState) come back
+    with their original structure instead of plain lists/dicts."""
     path = Path(path).resolve()
-    state = _ckptr().restore(path / "state")
+    template = {
+        "params": jax.device_get(engine.params),
+        "opt_state": jax.device_get(engine.opt_state),
+    }
+    if engine.model_state is not None:
+        template["model_state"] = jax.device_get(engine.model_state)
+    state = _ckptr().restore(path / "state", item=template)
     engine.params = jax.device_put(state["params"], engine.replicated)
     engine.opt_state = jax.device_put(state["opt_state"], engine.replicated)
     if "model_state" in state and engine.model_state is not None:
