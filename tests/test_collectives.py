@@ -275,6 +275,49 @@ def test_collective_availability_string():
     assert "xla=yes" in s and "allreduce" in s
 
 
+def test_hierarchical_allreduce_matches_flat():
+    """Two-level intra x inter ring composition == flat allreduce
+    (allreducep2pHierarchicalImpl parity, incl. the cartesian shortcut)."""
+    from torchmpi_tpu.collectives.eager import (
+        CollectiveArgumentError,
+        run_hierarchical_allreduce,
+    )
+
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks for a 2-level topology")
+    mpi.push_communicator(lambda r: str(r % 2), name="2level")
+    comm = mpi.current_communicator()
+    assert comm.cartesian and comm.has_inter_collective
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(p, 257).astype(np.float32))
+    for impl in ("ring", "xla"):
+        out = np.asarray(run_hierarchical_allreduce(x, comm, impl=impl))
+        np.testing.assert_allclose(
+            out, np.tile(np.asarray(x).sum(axis=0), (p, 1)), rtol=1e-5
+        )
+    # flat comm rejects the hierarchical path
+    with pytest.raises(CollectiveArgumentError):
+        run_hierarchical_allreduce(x, mpi.stack().at(0))
+
+
+def test_ring_backend_routes_hierarchical():
+    """On a hierarchical cartesian comm with the constant on, the ring
+    backend's large allreduce takes the two-level composition."""
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks")
+    mpi.push_communicator(lambda r: str(r // 2), name="pairs")
+    comm = mpi.current_communicator()
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    x = _ranks_block(p, 700, jnp.float32)
+    out = np.asarray(mpi.ring.allreduce_tensor(x, comm=comm))
+    np.testing.assert_array_equal(out, p * (p - 1) / 2)
+    assert any(
+        k[0] == "hier_allreduce" for k in comm._collective_resources
+    ), "hierarchical path not taken"
+
+
 def test_checkWithAllreduce_invariant():
     """Replica-consistency check (init.lua:372-395): allreduced |mean| must
     equal p * local |mean| when replicas agree, to 1e-7."""

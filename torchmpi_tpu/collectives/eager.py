@@ -221,6 +221,17 @@ def run(
     effective = backend
     if backend in ("ring", "pallas") and route_small:
         effective = op_route(op, _nelem_per_rank(x), platform, backend)
+    if (
+        op == "allreduce"
+        and effective == "ring"
+        and constants.get("use_hierarchical_collectives")
+        and comm.cartesian
+        and comm.has_inter_collective
+        and comm.has_intra_collective
+    ):
+        # two-level ring composition on hierarchical cartesian comms
+        # (collectives_cuda.cpp:501-581)
+        return run_hierarchical_allreduce(x, comm, impl="ring")
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
     if effective == "ring" and op == "broadcast":
         suffix = "tpu" if platform != "cpu" else "cpu"
@@ -256,6 +267,59 @@ def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
     h = SyncHandle(arrays=out)
     handles.register(h)
     return h
+
+
+def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
+    """Explicit two-level allreduce over a cartesian communicator: ring
+    reduce within each intra group, ring across the inter dimension, then
+    the intra all-gather — the reference's hierarchical dispatch
+    (``allreducep2pHierarchicalImpl``, ``collectives_cuda.cpp:501-581``).
+    The *cartesian shortcut* is structural here: every device sits in an
+    inter ring of same-intra-rank peers, so no trailing intra broadcast is
+    needed (``docs/communicators.md:24-31``).
+
+    Requires a cartesian comm with both levels populated; the flat path is
+    the right tool otherwise (callers fall back).
+    """
+    x = jnp.asarray(x)
+    _check_rank_stacked(x, comm)
+    if not (comm.cartesian and comm.has_inter_collective and comm.has_intra_collective):
+        raise CollectiveArgumentError(
+            "hierarchical allreduce needs a cartesian communicator with "
+            "multiple intra groups of size > 1"
+        )
+    cache = _resource_cache(comm)
+    key = ("hier_allreduce", impl, tuple(x.shape), jnp.result_type(x))
+    fn = cache.get(key)
+    if fn is None:
+        # group-major permutation: stacked axis0 (global rank order) ->
+        # mesh order. Communicator._groups is already group-major with
+        # members in intra-rank order — the exact mesh layout.
+        perm = np.concatenate(comm._groups).astype(np.int32)
+        inv = np.argsort(perm).astype(np.int32)
+        mesh = comm.mesh  # 2D (inter, intra)
+        spec = P(("inter", "intra"), *([None] * (x.ndim - 1)))
+
+        if impl == "ring":
+            def kernel(b):
+                b = prim.ring_allreduce(b, "intra")
+                return prim.ring_allreduce(b, "inter")
+        else:
+            def kernel(b):
+                return jax.lax.psum(jax.lax.psum(b, "intra"), "inter")
+
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        )
+        perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
+
+        def run_fn(a):
+            return jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
+
+        donate = constants.get("donate_eager_buffers")
+        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
+        cache[key] = fn
+    return fn(x)
 
 
 def run_group_broadcast(x, comm: Communicator, root: int = 0):

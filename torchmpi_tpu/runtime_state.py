@@ -38,6 +38,9 @@ def start(
     with_cartesian_communicator: Optional[bool] = None,
     collective_communicator: Optional[tuple] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
 ) -> None:
     """Initialise the runtime (``MPI.start``, ``torchmpi/init.lua:31-100``).
 
@@ -53,10 +56,40 @@ def start(
       building communicators (``init.lua:61-65``).
     - ``collective_communicator`` — explicit ``(begin, end)`` span.
     - ``devices`` — explicit device list (tests build synthetic topologies).
+    - ``coordinator_address``/``num_processes``/``process_id`` — multi-
+      controller JAX: forwarded to ``jax.distributed.initialize`` (the
+      ``MPI_Init`` analog for multi-host TPU pods; on Cloud TPU the
+      arguments are auto-detected and may be omitted by passing
+      ``coordinator_address=""``). Single-controller runs skip this.
     """
     global _stack, _started
     with _lock:
         if _started:
+            raise RuntimeError("torchmpi_tpu.start() called twice")
+    if coordinator_address is None and (
+        num_processes is not None or process_id is not None
+    ):
+        raise ValueError(
+            "num_processes/process_id require coordinator_address (pass "
+            "coordinator_address='' for Cloud TPU auto-detection)"
+        )
+    if coordinator_address is not None:
+        already = False
+        try:
+            already = bool(jax.distributed.is_initialized())
+        except AttributeError:
+            pass
+        if not already:
+            kw = {}
+            if coordinator_address:
+                kw["coordinator_address"] = coordinator_address
+            if num_processes is not None:
+                kw["num_processes"] = num_processes
+            if process_id is not None:
+                kw["process_id"] = process_id
+            jax.distributed.initialize(**kw)
+    with _lock:
+        if _started:  # re-check: distributed init released the lock
             raise RuntimeError("torchmpi_tpu.start() called twice")
         if with_cartesian_communicator is not None:
             constants.set(
