@@ -125,6 +125,24 @@ def test_ps_checkpoint_roundtrip(tmp_path):
     free_all()
 
 
+def test_autotune_allreduce_cutoff():
+    """The autotuner (the reference's c_api.h:93-95 TODO) measures both
+    paths with routing pinned off and sets a sane cutoff constant."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.utils.autotune import tune_allreduce_cutoff
+
+    comm = mpi.current_communicator()
+    cutoff, results = tune_allreduce_cutoff(
+        comm, min_pow=8, max_pow=10, warmup=1, timed=2
+    )
+    assert cutoff > 0
+    assert len(results) == 3
+    for n, xla_us, ring_us in results:
+        assert xla_us > 0 and ring_us > 0
+    suffix = "tpu" if comm.devices[0].platform != "cpu" else "cpu"
+    assert constants.get(f"small_allreduce_size_{suffix}") == cutoff
+
+
 def test_vlog_and_timer(capsys):
     from torchmpi_tpu.utils import tracing
 

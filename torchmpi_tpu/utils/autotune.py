@@ -1,0 +1,62 @@
+"""Cutoff autotuner.
+
+The reference ships hand-tuned small-message cutoffs and leaves autotuning
+as a TODO ("implement an autotuner; YMMV", ``lib/c_api.h:93-95``). This
+implements it: measure the latency (fused XLA) and bandwidth (ring) paths
+across the size sweep on the *actual* communicator and set the crossover
+as the platform's cutoff constant.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .. import constants
+from ..runtime.communicator import Communicator
+from .tester import run_one_config, sweep_sizes
+
+
+def tune_allreduce_cutoff(
+    comm: Optional[Communicator] = None,
+    min_pow: int = 8,
+    max_pow: int = 20,
+    warmup: int = 3,
+    timed: int = 5,
+    apply: bool = True,
+) -> Tuple[int, List]:
+    """Find the element count where the ring path starts beating the fused
+    XLA path for allreduce; optionally set it as the platform cutoff.
+    Returns ``(cutoff_elements, measurements)``."""
+    if comm is None:
+        from .. import runtime_state
+
+        comm = runtime_state.current_communicator()
+    if apply and constants.constants_frozen():
+        # fail fast: the expensive sweep would end in FrozenConstantsError
+        raise constants.FrozenConstantsError(
+            "constants are frozen; call with apply=False to only measure"
+        )
+    platform = comm.devices[0].platform
+    suffix = "tpu" if platform != "cpu" else "cpu"
+
+    results = []
+    crossover = None
+    for n in sweep_sizes(min_pow, max_pow, jitter_seed=None):
+        xla = run_one_config(
+            "allreduce", n, comm, backend="xla", benchmark=True,
+            warmup=warmup, timed=timed, route_override=False,
+        )
+        ring = run_one_config(
+            "allreduce", n, comm, backend="ring", benchmark=True,
+            warmup=warmup, timed=timed, route_override=False,
+        )
+        results.append((n, xla.mean_us, ring.mean_us))
+        if crossover is None and ring.mean_us < xla.mean_us:
+            # op_route keeps nelem <= cutoff on the fused path, so the
+            # cutoff must sit strictly BELOW the first ring win
+            crossover = n - 1
+    # Never-crosses -> keep everything on the fused path (huge cutoff).
+    cutoff = crossover if crossover is not None else 1 << (max_pow + 4)
+    if apply:
+        constants.set(f"small_allreduce_size_{suffix}", int(cutoff))
+    return int(cutoff), results

@@ -79,10 +79,15 @@ def run_one_config(
     warmup: int = 10,
     timed: int = 10,
     root: int = 0,
+    route_override: bool = True,
 ) -> BenchResult:
     """One (op, size, backend, mode) cell of the config matrix
     (``tester.runOneConfig``). Correctness is always checked on the first
-    run; benchmark mode adds the timed loop."""
+    run; benchmark mode adds the timed loop. ``route_override=False`` pins
+    the exact backend (disabling the small-size latency rerouting) — needed
+    by the autotuner, which measures each path on its own."""
+    from ..collectives import eager
+
     p = comm.size
     x = jnp.tile(
         jnp.arange(p, dtype=jnp.float32)[:, None], (1, max(1, nelem))
@@ -92,6 +97,15 @@ def run_one_config(
         ns = getattr(ns, backend) if backend != "selector" else ns
 
     def call():
+        if not route_override and backend in ("xla", "ring", "pallas"):
+            kw = dict(backend=backend, route_small=False)
+            if op in ("broadcast", "reduce"):
+                kw["root"] = root
+            if op == "sendreceive":
+                kw.update(src=0, dst=p - 1)
+            if mode == "async":
+                return eager.run_async(op, x, comm, **kw).wait()
+            return eager.run(op, x, comm, **kw)
         if op == "allreduce":
             r = ns.allreduce_tensor(x, comm=comm)
         elif op == "broadcast":
