@@ -1,0 +1,96 @@
+"""Multi-controller smoke test: two REAL processes on localhost.
+
+The analog of the reference's multi-node runs (``scripts/test_cpu.sh`` with
+HOSTFILE): ``start(coordinator_address=...)`` initialises distributed JAX,
+the global communicator spans both processes' devices, the per-node
+communicator level reports 2 nodes, and a cross-process eager allreduce
+produces the closed-form value on every process.
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+_REPO = Path(__file__).resolve().parent.parent
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.runtime_state import local_ranks
+
+    mpi.start(
+        coordinator_address=f"localhost:{{port}}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    comm = mpi.current_communicator()
+    p = comm.size
+    assert p == 2 * nproc, p
+    assert mpi.num_processes() == nproc
+    assert comm.num_nodes() == nproc
+    assert local_ranks() == [2 * pid, 2 * pid + 1], local_ranks()
+    assert mpi.rank() == 2 * pid
+
+    mesh = comm.flat_mesh("mpi")
+    arr = jax.make_array_from_callback(
+        (p, 16),
+        NamedSharding(mesh, P("mpi")),
+        lambda idx: np.full(
+            (1, 16), float(idx[0].start or 0), np.float32
+        ),
+    )
+    out = mpi.allreduce_tensor(arr)
+    local = np.asarray(out.addressable_shards[0].data)
+    assert (local == p * (p - 1) / 2).all(), local
+    mpi.barrier()
+    mpi.stop()
+    print(f"proc {{pid}} OK")
+    """
+).format(repo=str(_REPO))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_allreduce(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+        assert f"proc {i} OK" in out

@@ -139,7 +139,9 @@ def test_autotune_allreduce_cutoff():
     assert len(results) == 3
     for n, xla_us, ring_us in results:
         assert xla_us > 0 and ring_us > 0
-    suffix = "tpu" if comm.devices[0].platform != "cpu" else "cpu"
+    from torchmpi_tpu.constants import platform_suffix
+
+    suffix = platform_suffix(comm.devices[0].platform)
     assert constants.get(f"small_allreduce_size_{suffix}") == cutoff
 
 

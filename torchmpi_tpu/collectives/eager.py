@@ -188,7 +188,7 @@ def op_route(op: str, nelem: int, platform: str, requested: str = "ring") -> str
     """Size-based latency/bandwidth routing (reference
     ``collectives.cpp:296-301``): below the cutoff use the fused XLA path,
     above it the requested bandwidth backend (ring or pallas)."""
-    suffix = "tpu" if platform != "cpu" else "cpu"
+    suffix = constants.platform_suffix(platform)
     if op == "allreduce":
         cutoff = constants.get(f"small_allreduce_size_{suffix}")
     elif op == "broadcast":
@@ -234,7 +234,7 @@ def run(
         return run_hierarchical_allreduce(x, comm, impl="ring")
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
     if effective == "ring" and op == "broadcast":
-        suffix = "tpu" if platform != "cpu" else "cpu"
+        suffix = constants.platform_suffix(platform)
         cutoff = constants.get(f"broadcast_size_tree_based_{suffix}")
         block_bytes = _nelem_per_rank(x) * jnp.result_type(x).itemsize
         extra = extra + (("tree" if block_bytes <= cutoff else "pipeline"),)

@@ -120,6 +120,12 @@ def register_listener(fn: Callable[[str, Any], None]) -> None:
         fn(name, value)
 
 
+def platform_suffix(platform: str) -> str:
+    """Map a jax platform string to the cutoff-constant suffix (the
+    reference's CPU/GPU constant pairs; any accelerator takes 'tpu')."""
+    return "cpu" if platform == "cpu" else "tpu"
+
+
 def get(name: str) -> Any:
     if name not in _FIELD_NAMES:
         raise KeyError(f"unknown constant: {name}")

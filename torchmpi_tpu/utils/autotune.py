@@ -36,8 +36,7 @@ def tune_allreduce_cutoff(
         raise constants.FrozenConstantsError(
             "constants are frozen; call with apply=False to only measure"
         )
-    platform = comm.devices[0].platform
-    suffix = "tpu" if platform != "cpu" else "cpu"
+    suffix = constants.platform_suffix(comm.devices[0].platform)
 
     results = []
     crossover = None

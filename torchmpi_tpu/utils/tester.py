@@ -92,12 +92,13 @@ def run_one_config(
     x = jnp.tile(
         jnp.arange(p, dtype=jnp.float32)[:, None], (1, max(1, nelem))
     )
+    pinned = not route_override and backend in ("xla", "ring", "pallas")
     ns = collectives.async_ if mode == "async" else collectives
-    if backend:
+    if backend and not pinned:
         ns = getattr(ns, backend) if backend != "selector" else ns
 
     def call():
-        if not route_override and backend in ("xla", "ring", "pallas"):
+        if pinned:
             kw = dict(backend=backend, route_small=False)
             if op in ("broadcast", "reduce"):
                 kw["root"] = root
