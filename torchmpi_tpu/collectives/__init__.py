@@ -45,6 +45,18 @@ def _dispatch(op, x, comm, mode, backend=None, **kw):
                 op, platform, multinode=comm.num_nodes() > 1, mode=mode
             )
             cache[(op, mode)] = backend
+        if backend in ("ring", "pallas"):
+            # The selector decides xla-vs-custom-ring; which custom ring
+            # implements it is the ring_implementation constant (read per
+            # call — it is mutable until freeze):
+            from .. import constants
+            from .selector import backend_availability
+
+            impl = constants.get("ring_implementation")
+            if impl == "pallas" and backend_availability().get("pallas"):
+                backend = "pallas"
+            elif impl == "ppermute":
+                backend = "ring"
     if mode == "sync":
         return eager.run(op, x, comm, backend=backend, **kw)
     return eager.run_async(op, x, comm, backend=backend, **kw)

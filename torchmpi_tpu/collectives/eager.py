@@ -103,7 +103,10 @@ def _compile(
 ):
     """Fetch-or-build the jitted executable for this (op, comm, aval)."""
     cache = _resource_cache(comm)
-    key = (op, backend, aval, static)
+    donate = constants.get("donate_eager_buffers")
+    # donate participates in the key: toggling the constant after first use
+    # must not silently keep the old executable's aliasing behavior.
+    key = (op, backend, aval, static, donate)
     fn = cache.get(key)
     if fn is None:
         mesh = _flat_mesh(comm)
@@ -113,7 +116,6 @@ def _compile(
         shmapped = jax.shard_map(
             kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
         )
-        donate = constants.get("donate_eager_buffers")
         fn = jax.jit(shmapped, donate_argnums=(0,) if donate else ())
         cache[key] = fn
     return fn
@@ -132,13 +134,53 @@ def _nelem_per_rank(x) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _kernels(op: str, backend: str, root: int, extra: Tuple):
+def ring_tuning(platform: str) -> Tuple[int, int, int]:
+    """(min_bytes, max_bytes, num_buffers) for the platform's custom rings —
+    the reference's kMin/kMaxBufferSize + kNumBuffersPerCollective knobs
+    (``lib/constants.cpp:142-150``), capped by
+    ``max_num_buffers_per_collective`` (``lib/constants.h:77-78``)."""
+    suffix = constants.platform_suffix(platform)
+    nb = min(
+        constants.get(f"num_buffers_per_collective_{suffix}"),
+        constants.get("max_num_buffers_per_collective"),
+    )
+    return (
+        constants.get(f"min_buffer_size_{suffix}"),
+        constants.get(f"max_buffer_size_{suffix}"),
+        nb,
+    )
+
+
+def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ()):
     """Return a kernel fn(block) for the given op/backend.
 
     For ``backend='ring'`` broadcasts, ``extra`` carries the tree-vs-pipeline
     decision (made in :func:`run` from the platform-appropriate constant, so
     it participates in the executable cache key — ``collectives.cpp:58-64``'s
-    4MB switch)."""
+    4MB switch) plus the pipelined chunk count; ``tuning`` carries
+    (min_bytes, max_bytes, num_buffers) for byte-bounded ring chunking."""
+    minb, maxb, nbuf = tuning if tuning else (None, None, 1)
+
+    def _ring_allreduce(b):
+        return prim.ring_allreduce(
+            b, _AXIS,
+            max_bytes_per_step=maxb, min_bytes_per_step=minb,
+            num_buffers=nbuf,
+        )
+
+    def _ring_reduce(b):
+        return prim.ring_reduce(
+            b, root, _AXIS,
+            max_bytes_per_step=maxb, min_bytes_per_step=minb,
+            num_buffers=nbuf,
+        )
+
+    def _ring_bcast(b):
+        if "tree" in extra:
+            return prim.tree_broadcast(b, root, _AXIS)
+        k = next((e[1] for e in extra if isinstance(e, tuple) and e[0] == "chunks"), None)
+        return prim.ring_broadcast(b, root, _AXIS, num_chunks=k)
+
     if backend == "xla":
         table = {
             "allreduce": lambda b: prim.allreduce(b, _AXIS),
@@ -148,15 +190,10 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple):
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
     elif backend == "ring":
-        def _ring_bcast(b):
-            if "tree" in extra:
-                return prim.tree_broadcast(b, root, _AXIS)
-            return prim.ring_broadcast(b, root, _AXIS)
-
         table = {
-            "allreduce": lambda b: prim.ring_allreduce(b, _AXIS),
+            "allreduce": _ring_allreduce,
             "broadcast": _ring_bcast,
-            "reduce": lambda b: prim.ring_reduce(b, root, _AXIS),
+            "reduce": _ring_reduce,
             "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
@@ -165,15 +202,10 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple):
         # ring (the reference similarly mixed transports per collective).
         from ..ops.ring_kernels import ring_allreduce_pallas
 
-        def _pallas_bcast(b):
-            if "tree" in extra:
-                return prim.tree_broadcast(b, root, _AXIS)
-            return prim.ring_broadcast(b, root, _AXIS)
-
         table = {
             "allreduce": lambda b: ring_allreduce_pallas(b, _AXIS),
-            "broadcast": _pallas_bcast,
-            "reduce": lambda b: prim.ring_reduce(b, root, _AXIS),
+            "broadcast": _ring_bcast,
+            "reduce": _ring_reduce,
             "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
@@ -230,23 +262,38 @@ def run(
         and comm.has_intra_collective
     ):
         # two-level ring composition on hierarchical cartesian comms
-        # (collectives_cuda.cpp:501-581)
-        return run_hierarchical_allreduce(x, comm, impl="ring")
+        # (collectives_cuda.cpp:501-581); staged-vs-direct inter transport
+        # selected by use_staged_collectives (kUseStagedCollectives,
+        # detail/collectives_cuda.cpp:877-899)
+        impl = "staged" if constants.get("use_staged_collectives") else "ring"
+        return run_hierarchical_allreduce(x, comm, impl=impl)
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
-    if effective == "ring" and op == "broadcast":
+    tuning: Tuple = ()
+    if effective in ("ring", "pallas"):
+        tuning = ring_tuning(platform)
+    if effective in ("ring", "pallas") and op == "broadcast":
         suffix = constants.platform_suffix(platform)
         cutoff = constants.get(f"broadcast_size_tree_based_{suffix}")
         block_bytes = _nelem_per_rank(x) * jnp.result_type(x).itemsize
-        extra = extra + (("tree" if block_bytes <= cutoff else "pipeline"),)
+        if block_bytes <= cutoff:
+            extra = extra + ("tree",)
+        else:
+            # pipelined chunk count from the buffer-size bounds: every
+            # chunk <= max_buffer_size, and no smaller than min_buffer_size
+            # (constants.cpp:142-150's kMin/kMaxBufferSize pipelining).
+            minb, maxb, _ = tuning
+            k = max(1, -(-block_bytes // max(1, maxb)))
+            k = min(k, max(1, block_bytes // max(1, minb)))
+            extra = extra + ("pipeline", ("chunks", int(k)))
     aval = (tuple(x.shape), jnp.result_type(x))
-    static = (root,) + extra
+    static = (root,) + extra + (tuning,)
     fn = _compile(
         comm,
         op,
         effective,
         aval,
         static,
-        lambda: _kernels(op, effective, root, extra),
+        lambda: _kernels(op, effective, root, extra, tuning),
     )
     # Place the input on the communicator's devices (no-op if already there).
     sharding = _rank_sharding(comm, x.ndim)
@@ -263,9 +310,17 @@ def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
     drains it, matching ``resources.cpp:463-481``."""
     from ..runtime.handles import handles
 
+    # Backpressure: bound the number of unwaited async collectives
+    # (kNumAsyncCollectivesInFlight, lib/constants.cpp:152-155) — when the
+    # table is full, the oldest outstanding handle is drained first, the
+    # analog of the reference's bounded future queues blocking enqueue.
+    limit = constants.get("num_async_collectives_in_flight")
+    while handles.outstanding_kind("collective") >= limit:
+        if not handles.wait_oldest("collective"):
+            break
     out = run(op, x, comm, **kw)
     h = SyncHandle(arrays=out)
-    handles.register(h)
+    handles.register(h, kind="collective")
     return h
 
 
@@ -288,8 +343,15 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
             "hierarchical allreduce needs a cartesian communicator with "
             "multiple intra groups of size > 1"
         )
+    if impl == "staged":
+        return _run_staged_hierarchical_allreduce(x, comm)
     cache = _resource_cache(comm)
-    key = ("hier_allreduce", impl, tuple(x.shape), jnp.result_type(x))
+    donate = constants.get("donate_eager_buffers")
+    tuning = ring_tuning(comm._devices[0].platform) if impl == "ring" else ()
+    key = (
+        "hier_allreduce", impl, tuple(x.shape), jnp.result_type(x), donate,
+        tuning,
+    )
     fn = cache.get(key)
     if fn is None:
         # group-major permutation: stacked axis0 (global rank order) ->
@@ -301,9 +363,19 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
         spec = P(("inter", "intra"), *([None] * (x.ndim - 1)))
 
         if impl == "ring":
+            minb, maxb, nbuf = tuning
+
             def kernel(b):
-                b = prim.ring_allreduce(b, "intra")
-                return prim.ring_allreduce(b, "inter")
+                b = prim.ring_allreduce(
+                    b, "intra",
+                    max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                    num_buffers=nbuf,
+                )
+                return prim.ring_allreduce(
+                    b, "inter",
+                    max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                    num_buffers=nbuf,
+                )
         else:
             def kernel(b):
                 return jax.lax.psum(jax.lax.psum(b, "intra"), "inter")
@@ -316,10 +388,62 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
         def run_fn(a):
             return jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
 
-        donate = constants.get("donate_eager_buffers")
         fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
         cache[key] = fn
     return fn(x)
+
+
+def _run_staged_hierarchical_allreduce(x, comm: Communicator):
+    """Host-staged cross-group allreduce — the TPU analog of
+    ``allreducep2pCrossNodesViaCPU`` (staged-via-pinned-CPU,
+    ``detail/collectives_cuda.cpp:390-683``), selected by
+    ``use_staged_collectives``:
+
+    1. device: ring-allreduce within each intra group (ICI-local);
+    2. host: fetch one representative group-sum per group, reduce across
+       groups in host memory (the DCN-staged hop);
+    3. device: push the global total back to every rank.
+
+    The staged hop trades device-collective bandwidth for not needing any
+    inter-group device link — exactly the reference's rationale when GDR
+    was unavailable.
+    """
+    cache = _resource_cache(comm)
+    tuning = ring_tuning(comm._devices[0].platform)
+    key = ("staged_allreduce", tuple(x.shape), jnp.result_type(x), tuning)
+    entry = cache.get(key)
+    if entry is None:
+        perm = np.concatenate(comm._groups).astype(np.int32)
+        inv = np.argsort(perm).astype(np.int32)
+        mesh = comm.mesh
+        spec = P(("inter", "intra"), *([None] * (x.ndim - 1)))
+        minb, maxb, nbuf = tuning
+
+        def intra_kernel(b):
+            return prim.ring_allreduce(
+                b, "intra",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf,
+            )
+
+        shmapped = jax.shard_map(
+            intra_kernel, mesh=mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+        perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
+        intra_fn = jax.jit(
+            lambda a: jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
+        )
+        reps = np.asarray([g[0] for g in comm._groups], np.int32)
+        entry = (intra_fn, reps)
+        cache[key] = entry
+    intra_fn, reps = entry
+    reduced = intra_fn(x)  # every rank holds its group's sum
+    # host-staged inter reduction
+    host = np.asarray(jax.device_get(reduced[np.asarray(reps)]))
+    total = host.sum(axis=0).astype(host.dtype)
+    stacked = np.broadcast_to(total, (comm.size,) + total.shape)
+    return jax.device_put(stacked, _rank_sharding(comm, x.ndim))
 
 
 def run_group_broadcast(x, comm: Communicator, root: int = 0):

@@ -30,6 +30,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # ---------------------------------------------------------------------------
@@ -112,7 +113,50 @@ def _flatten_pad(x, p: int):
     return flat, n, chunk
 
 
-def ring_allreduce(x, axis: str = "mpi", axis_size: Optional[int] = None):
+def _ring_phases(chunks, axis: str, p: int, r, perm, nb: int):
+    """Run the reduce-scatter + all-gather ring phases in lockstep over
+    ``nb`` independent segments ``chunks[nb, p, chunk]``. Each ring step
+    issues ``nb`` independent ppermutes (one per in-flight segment), which
+    XLA's scheduler may overlap — the in-flight-buffers semantics of the
+    reference's ``kNumBuffersPerCollectiveCPU/GPU`` pipelining
+    (``lib/detail/collectives.cpp:128-326``)."""
+
+    def rs_step(s, ch):
+        # Send chunk (r - s) mod p rightward; add incoming (r - s - 1) mod p.
+        send_idx = (r - s) % p
+        recv_idx = (r - s - 1) % p
+        outs = []
+        for j in range(nb):
+            buf = lax.dynamic_index_in_dim(ch[j], send_idx, keepdims=False)
+            recv = lax.ppermute(buf, axis, perm)
+            upd = lax.dynamic_index_in_dim(ch[j], recv_idx, keepdims=False) + recv
+            outs.append(lax.dynamic_update_index_in_dim(ch[j], upd, recv_idx, 0))
+        return jnp.stack(outs)
+
+    chunks = lax.fori_loop(0, p - 1, rs_step, chunks)
+
+    def ag_step(s, ch):
+        # After reduce-scatter, rank r owns fully-reduced chunk (r + 1) mod p.
+        send_idx = (r + 1 - s) % p
+        recv_idx = (r - s) % p
+        outs = []
+        for j in range(nb):
+            buf = lax.dynamic_index_in_dim(ch[j], send_idx, keepdims=False)
+            recv = lax.ppermute(buf, axis, perm)
+            outs.append(lax.dynamic_update_index_in_dim(ch[j], recv, recv_idx, 0))
+        return jnp.stack(outs)
+
+    return lax.fori_loop(0, p - 1, ag_step, chunks)
+
+
+def ring_allreduce(
+    x,
+    axis: str = "mpi",
+    axis_size: Optional[int] = None,
+    max_bytes_per_step: Optional[int] = None,
+    min_bytes_per_step: Optional[int] = None,
+    num_buffers: int = 1,
+):
     """Chunked ring allreduce: (p-1) reduce-scatter steps then (p-1)
     all-gather steps, the schedule memoized by the reference as a "plan"
     (``lib/resources.cpp:582-672``, algorithm doc ``lib/detail/README.md``).
@@ -122,36 +166,50 @@ def ring_allreduce(x, axis: str = "mpi", axis_size: Optional[int] = None):
     ``ppermute`` is a one-hop ICI transfer, so total bytes moved per rank is
     ``2 n (p-1)/p`` — the bus-bandwidth-optimal volume the baseline's
     analytic model assumes.
+
+    Byte-bounded chunking (``lib/constants.cpp:142-150``,
+    ``lib/detail/collectives.cpp:139-176``): when the per-step message
+    (``n/p`` elements) would exceed ``max_bytes_per_step``, the buffer is cut
+    into segments so every ppermute moves at most that many bytes (and at
+    least ``min_bytes_per_step`` where possible); ``num_buffers`` segments
+    travel the ring concurrently (pipelining depth ≙
+    ``kNumBuffersPerCollective``), waves of segments are scanned
+    sequentially.
     """
     p = axis_size or lax.axis_size(axis)
     if p == 1:
         return x
-    flat, n, chunk = _flatten_pad(x, p)
-    chunks = flat.reshape(p, chunk)
     r = lax.axis_index(axis)
     perm = [(i, (i + 1) % p) for i in range(p)]
+    itemsize = jnp.dtype(x.dtype).itemsize
+    n = int(np.prod(x.shape)) if x.shape else 1
+    chunk = -(-n // p)
 
-    def rs_step(s, ch):
-        # Send chunk (r - s) mod p rightward; add incoming (r - s - 1) mod p.
-        send_idx = (r - s) % p
-        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
-        recv = lax.ppermute(buf, axis, perm)
-        recv_idx = (r - s - 1) % p
-        updated = lax.dynamic_index_in_dim(ch, recv_idx, keepdims=False) + recv
-        return lax.dynamic_update_index_in_dim(ch, updated, recv_idx, 0)
+    if max_bytes_per_step is None or chunk * itemsize <= max_bytes_per_step:
+        flat, n, chunk = _flatten_pad(x, p)
+        chunks = _ring_phases(flat.reshape(1, p, chunk), axis, p, r, perm, 1)
+        return chunks.reshape(-1)[:n].reshape(x.shape)
 
-    chunks = lax.fori_loop(0, p - 1, rs_step, chunks)
+    # Segmented path: per-step message size in [min, max] bytes.
+    seg_chunk = max(1, int(max_bytes_per_step) // itemsize)
+    if min_bytes_per_step:
+        floor = -(-int(min_bytes_per_step) // itemsize)
+        seg_chunk = max(seg_chunk, min(chunk, floor))
+    seg = seg_chunk * p
+    nseg = -(-n // seg)
+    nb = max(1, min(int(num_buffers), nseg))
+    nwave = -(-nseg // nb)
+    total = nwave * nb * seg
+    flat = x.reshape(-1)
+    if total > n:
+        flat = jnp.concatenate([flat, jnp.zeros((total - n,), flat.dtype)])
+    waves = flat.reshape(nwave, nb, p, seg_chunk)
 
-    def ag_step(s, ch):
-        # After reduce-scatter, rank r owns fully-reduced chunk (r + 1) mod p.
-        send_idx = (r + 1 - s) % p
-        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
-        recv = lax.ppermute(buf, axis, perm)
-        recv_idx = (r - s) % p
-        return lax.dynamic_update_index_in_dim(ch, recv, recv_idx, 0)
+    def run_wave(carry, wave):
+        return carry, _ring_phases(wave, axis, p, r, perm, nb)
 
-    chunks = lax.fori_loop(0, p - 1, ag_step, chunks)
-    return chunks.reshape(-1)[:n].reshape(x.shape)
+    _, out = lax.scan(run_wave, 0, waves)
+    return out.reshape(-1)[:n].reshape(x.shape)
 
 
 def ring_broadcast(
@@ -220,10 +278,25 @@ def tree_broadcast(x, root: int = 0, axis: str = "mpi", axis_size: Optional[int]
     return x
 
 
-def ring_reduce(x, root: int = 0, axis: str = "mpi", axis_size: Optional[int] = None):
+def ring_reduce(
+    x,
+    root: int = 0,
+    axis: str = "mpi",
+    axis_size: Optional[int] = None,
+    max_bytes_per_step: Optional[int] = None,
+    min_bytes_per_step: Optional[int] = None,
+    num_buffers: int = 1,
+):
     """Reduce-to-root as ring reduce-scatter + gather-to-root; implemented as
     ring_allreduce masked to root (the reference reduces via the same plan)."""
-    total = ring_allreduce(x, axis=axis, axis_size=axis_size)
+    total = ring_allreduce(
+        x,
+        axis=axis,
+        axis_size=axis_size,
+        max_bytes_per_step=max_bytes_per_step,
+        min_bytes_per_step=min_bytes_per_step,
+        num_buffers=num_buffers,
+    )
     idx = lax.axis_index(axis)
     return jnp.where(idx == root, total, x)
 

@@ -50,7 +50,26 @@ def _fn_key(fn) -> Any:
     if code is None:
         return fn
     cells = getattr(fn, "__closure__", None) or ()
-    return (code, tuple(id(c.cell_contents) for c in cells))
+    # __self__ distinguishes bound methods of different instances (their
+    # __code__/__closure__ proxy to the one shared class function);
+    # __defaults__ distinguishes def f(x, m=model_a) from m=model_b.
+    return (
+        code,
+        id(getattr(fn, "__self__", None)),
+        tuple(id(d) for d in (getattr(fn, "__defaults__", None) or ())),
+        tuple(id(c.cell_contents) for c in cells),
+    )
+
+
+def _array_fingerprint(a) -> tuple:
+    """Cheap content fingerprint (shape, dtype, sampled-bytes hash) used to
+    detect in-place mutation of cached eval arrays without hashing the
+    whole buffer."""
+    arr = np.asarray(a)
+    if arr.size == 0:
+        return (arr.shape, arr.dtype.str, 0)
+    sample = arr[:: max(1, len(arr) // 16)]
+    return (arr.shape, arr.dtype.str, hash(np.ascontiguousarray(sample).tobytes()))
 
 
 class AllReduceSGDEngine:
@@ -138,7 +157,7 @@ class AllReduceSGDEngine:
         self._bcast_fn = self._build_broadcast()
         self._epoch_fns: Dict[tuple, Callable] = {}
         self._eval_fns: Dict[Any, Callable] = {}
-        self._eval_data: Optional[tuple] = None
+        self._eval_data: Dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------------
     def _step_core(self, params, opt_state, model_state, batch):
@@ -481,15 +500,21 @@ class AllReduceSGDEngine:
         p = self.comm.size
         n = (len(x) // p) * p
         # Stage-once cache: per-epoch evaluation on the same arrays must not
-        # re-cross the host tunnel every call.
-        cached = self._eval_data
-        if cached is not None and cached[0] is x and cached[1] is y:
-            xd, yd = cached[2], cached[3]
+        # re-cross the host tunnel every call. Multi-slot (train/test sets
+        # alternate) and fingerprinted: in-place mutation of a cached array
+        # restages instead of returning stale results.
+        dkey = (id(x), id(y))
+        fp = (_array_fingerprint(x), _array_fingerprint(y))
+        cached = self._eval_data.get(dkey)
+        if cached is not None and cached[0] == fp:
+            xd, yd = cached[1], cached[2]
         else:
-            xh = np.asarray(x[:n])
-            xd = jax.device_put(xh, self.batch_sharding)
+            xd = jax.device_put(np.asarray(x[:n]), self.batch_sharding)
             yd = jax.device_put(np.asarray(y[:n]), self.batch_sharding)
-            self._eval_data = (x, y, xd, yd)
+            if len(self._eval_data) >= 4:  # bound staged HBM
+                self._eval_data.pop(next(iter(self._eval_data)))
+            # keep x/y refs so the ids stay unique while cached
+            self._eval_data[dkey] = (fp, xd, yd, x, y)
         has_state = self.model_state is not None
         key = (_fn_key(apply_fn), _fn_key(metric), has_state)
         fn = self._eval_fns.get(key)

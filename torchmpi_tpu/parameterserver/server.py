@@ -54,6 +54,33 @@ from .rules import UPDATE_RULES
 
 _POLL_INTERVAL_S = 100e-6  # the reference server's 100us scan cadence
 
+# Bounded in-flight client ops (kNumAsyncParameterServersInFlight,
+# lib/constants.cpp:152-155): enqueue blocks on the oldest op when full.
+_inflight_lock = threading.Lock()
+_inflight: deque = deque()
+
+
+def _submit_bounded(fn) -> Future:
+    limit = constants.get("num_async_parameterservers_in_flight")
+    with _inflight_lock:
+        while _inflight and _inflight[0].done():
+            _inflight.popleft()
+        while len(_inflight) >= limit:
+            oldest = _inflight.popleft()
+            _inflight_lock.release()
+            try:
+                # Drain only: a failed older op's exception belongs to ITS
+                # handle (Future.result re-raises on every call), not to
+                # this unrelated enqueue.
+                oldest.exception()
+            finally:
+                _inflight_lock.acquire()
+            while _inflight and _inflight[0].done():
+                _inflight.popleft()
+        f = parameterserver_pool.submit(fn)
+        _inflight.append(f)
+    return f
+
 
 def shard_range(n: int, size: int, rank: int) -> Tuple[int, int]:
     """Uniform shard [start, end) of an n-element tensor for ``rank`` of
@@ -357,6 +384,12 @@ class ParameterServer:
             )
         if scale is not None:
             flat = flat * self.dtype.type(scale)
+        elif isinstance(values, np.ndarray) and np.may_share_memory(flat, values):
+            # Own the buffer *synchronously*: the per-shard copies happen on
+            # the pool thread, so a caller mutating its array right after
+            # send() returns would otherwise race the async send (MPI-style
+            # "don't touch until complete" is NOT this API's contract).
+            flat = flat.copy()
 
         inst = self._inst
 
@@ -386,7 +419,7 @@ class ParameterServer:
                         "mismatched collective ordering)"
                     )
 
-        return SyncHandle(future=parameterserver_pool.submit(do_send))
+        return SyncHandle(future=_submit_bounded(do_send))
 
     def receive(self, client: int = 0) -> SyncHandle:
         """Fetch the full tensor: trigger every server, assemble shards
@@ -419,7 +452,7 @@ class ParameterServer:
                     ) from None
             return out.reshape(shape)
 
-        return SyncHandle(future=parameterserver_pool.submit(do_receive))
+        return SyncHandle(future=_submit_bounded(do_receive))
 
     def free(self) -> None:
         """Free the instance (barrier-wrapped collective in the reference,

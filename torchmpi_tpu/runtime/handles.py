@@ -99,24 +99,47 @@ class _HandleTable:
     def __init__(self):
         self._lock = threading.Lock()
         self._handles: Dict[int, SyncHandle] = {}
+        self._kinds: Dict[int, str] = {}
         self._next = 0
 
-    def register(self, handle: SyncHandle) -> int:
+    def register(self, handle: SyncHandle, kind: str = "") -> int:
         with self._lock:
             idx = self._next
             self._next += 1
             self._handles[idx] = handle
+            if kind:
+                self._kinds[idx] = kind
             handle._table_index = idx
             return idx
+
+    def outstanding_kind(self, kind: str) -> int:
+        """Count unwaited handles registered under ``kind`` (backpressure
+        accounting for the num_async_*_in_flight bounds)."""
+        with self._lock:
+            return sum(1 for i in self._handles if self._kinds.get(i) == kind)
+
+    def wait_oldest(self, kind: str) -> bool:
+        """Drain the oldest outstanding handle of ``kind``; False if none."""
+        with self._lock:
+            idxs = sorted(i for i in self._handles if self._kinds.get(i) == kind)
+            if not idxs:
+                return False
+            handle = self._handles.pop(idxs[0], None)
+            self._kinds.pop(idxs[0], None)
+        if handle is not None:
+            handle.wait()
+        return True
 
     def _discard(self, idx: int) -> None:
         """Drop a handle that completed via a direct wait() call."""
         with self._lock:
             self._handles.pop(idx, None)
+            self._kinds.pop(idx, None)
 
     def wait_index(self, idx: int) -> Any:
         with self._lock:
             handle = self._handles.pop(idx, None)
+            self._kinds.pop(idx, None)
         if handle is None:
             return None  # already waited: no-op, as in the reference
         return handle.wait()
@@ -126,6 +149,7 @@ class _HandleTable:
         with self._lock:
             pending = list(self._handles.values())
             self._handles.clear()
+            self._kinds.clear()
         for h in pending:
             h.wait()
 
