@@ -160,6 +160,213 @@ def test_pallas_ring_vmem_segmentation():
         rk._VMEM_BUDGET_BYTES = old
 
 
+@pytest.mark.parametrize(
+    "dtype", [jnp.int32, jnp.bfloat16, jnp.int8, jnp.float16, jnp.int16]
+)
+def test_pallas_ring_dtype_preserving(dtype):
+    """Round-1 regression: the kernel cast everything through f32, silently
+    corrupting int32 sums >= 2^24. Every supported dtype must round-trip
+    exactly (ints) or to dtype precision (floats)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    p = 4
+    mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+    if jnp.dtype(dtype).kind in "iu":
+        # values whose sum is NOT representable in f24 mantissa steps
+        base = 1 << 24 if jnp.dtype(dtype).itemsize >= 4 else 13
+        x = np.arange(p * 300, dtype=np.int64).reshape(p, 300) % 97 + base
+        x = x.astype(dtype)
+        expect = x.astype(np.int64).sum(axis=0).astype(dtype)
+    else:
+        x = np.random.RandomState(5).randn(p, 300).astype(dtype)
+        expect = x.sum(axis=0).astype(dtype)
+    f = jax.jit(
+        jax.shard_map(
+            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=p, interpret=True),
+            mesh=mesh,
+            in_specs=P("mpi"),
+            out_specs=P("mpi"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(jnp.asarray(x)))
+    assert out.dtype == np.asarray(expect).dtype
+    if jnp.dtype(dtype).kind in "iu":
+        np.testing.assert_array_equal(out, np.tile(expect, (p, 1)))
+    else:
+        np.testing.assert_allclose(
+            out.astype(np.float32),
+            np.tile(expect.astype(np.float32), (p, 1)),
+            rtol=3e-2 if dtype in (jnp.bfloat16, jnp.float16) else 2e-5,
+        )
+
+
+@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("root", [0, 1])
+@pytest.mark.parametrize("k", [None, 4])
+def test_pallas_ring_broadcast_interpret(p, root, k):
+    """Pipelined RDMA broadcast: every device receives the root's block."""
+    from torchmpi_tpu.ops.ring_kernels import ring_broadcast_pallas
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    root = root % p
+    mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+    rng = np.random.RandomState(p * 7 + root)
+    x = rng.randn(p, 1500).astype(np.float32)
+    f = jax.jit(
+        jax.shard_map(
+            lambda b: ring_broadcast_pallas(
+                b, root, "mpi", axis_size=p, num_chunks=k, interpret=True
+            ),
+            mesh=mesh,
+            in_specs=P("mpi"),
+            out_specs=P("mpi"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(x))
+    np.testing.assert_array_equal(out, np.tile(x[root], (p, 1)))
+
+
+@pytest.mark.parametrize("p", [2, 4, 8])
+def test_pallas_reduce_scatter_interpret(p):
+    """psum_scatter semantics: device r gets the sum of every device's
+    segment r."""
+    from torchmpi_tpu.ops.ring_kernels import ring_reduce_scatter_pallas
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+    rng = np.random.RandomState(p)
+    seg = 40
+    # global input: [p, p*seg]; device r's block is row r
+    x = rng.randn(p, p * seg).astype(np.float32)
+    f = jax.jit(
+        jax.shard_map(
+            lambda b: ring_reduce_scatter_pallas(
+                b.reshape(p * seg), "mpi", axis_size=p, interpret=True
+            ),
+            mesh=mesh,
+            in_specs=P("mpi"),
+            out_specs=P("mpi"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(x)).reshape(p, seg)
+    summed = x.sum(axis=0).reshape(p, seg)  # segment r = summed[r]
+    np.testing.assert_allclose(out, summed, rtol=2e-5, atol=1e-5)
+    # parity with lax.psum_scatter
+    ps = jax.jit(
+        jax.shard_map(
+            lambda b: jax.lax.psum_scatter(
+                b.reshape(p * seg), "mpi", scatter_dimension=0, tiled=True
+            ),
+            mesh=mesh,
+            in_specs=P("mpi"),
+            out_specs=P("mpi"),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_allclose(
+        out, np.asarray(ps(x)).reshape(p, seg), rtol=2e-5, atol=1e-5
+    )
+
+
+def test_pallas_reduce_scatter_rejects_indivisible():
+    from torchmpi_tpu.ops.ring_kernels import ring_reduce_scatter_pallas
+
+    p = 4
+    mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(
+            jax.shard_map(
+                lambda b: ring_reduce_scatter_pallas(
+                    b.reshape(-1), "mpi", axis_size=p, interpret=True
+                ),
+                mesh=mesh,
+                in_specs=P("mpi"),
+                out_specs=P("mpi"),
+                check_vma=False,
+            )
+        )(np.zeros((p, 7), np.float32))
+
+
+def test_pallas_broadcast_vmem_segmentation_and_bitcast():
+    """Broadcasts beyond the VMEM budget run as sequential segments; non-
+    native dtypes ride losslessly as a byte view (here: int64)."""
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    p = 4
+    old = rk._VMEM_BUDGET_BYTES
+    rk._VMEM_BUDGET_BYTES = 64 * 1024
+    try:
+        mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+        n = 3 * 8 * 128 * 8 + 11  # several tiny-budget segments
+        # uint32 is not kernel-native: rides as a lossless byte view
+        x = (
+            np.random.RandomState(4)
+            .randint(0, 1 << 31, (p, n))
+            .astype(np.uint32)
+        )
+        x[:, 0] = 0xDEADBEEF  # not representable in f32
+        f = jax.jit(
+            jax.shard_map(
+                lambda b: rk.ring_broadcast_pallas(
+                    b, 2, "mpi", axis_size=p, interpret=True
+                ),
+                mesh=mesh,
+                in_specs=P("mpi"),
+                out_specs=P("mpi"),
+                check_vma=False,
+            )
+        )
+        out = np.asarray(f(x))
+        np.testing.assert_array_equal(out, np.tile(x[2], (p, 1)))
+    finally:
+        rk._VMEM_BUDGET_BYTES = old
+
+
+def test_pallas_reduce_scatter_vmem_segmentation():
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    p = 4
+    old = rk._VMEM_BUDGET_BYTES
+    rk._VMEM_BUDGET_BYTES = 64 * 1024
+    try:
+        mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+        seg = 8 * 128 * 6  # rows beyond the tiny budget
+        x = np.random.RandomState(6).randn(p, p * seg).astype(np.float32)
+        f = jax.jit(
+            jax.shard_map(
+                lambda b: rk.ring_reduce_scatter_pallas(
+                    b.reshape(-1), "mpi", axis_size=p, interpret=True
+                ),
+                mesh=mesh,
+                in_specs=P("mpi"),
+                out_specs=P("mpi"),
+                check_vma=False,
+            )
+        )
+        out = np.asarray(f(x)).reshape(p, seg)
+        np.testing.assert_allclose(
+            out, x.sum(axis=0).reshape(p, seg), rtol=2e-5, atol=1e-5
+        )
+    finally:
+        rk._VMEM_BUDGET_BYTES = old
+
+
+def test_pallas_reduction_rejects_lossy_dtype():
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    with pytest.raises(ValueError, match="not supported"):
+        rk._carrier_dtype(jnp.uint32)
+
+
 def test_eager_pallas_backend_dispatch():
     """backend='pallas' flows through the eager dispatch to the RDMA kernel
     (forced interpret so it runs on the CPU mesh)."""
@@ -177,6 +384,69 @@ def test_eager_pallas_backend_dispatch():
         out = np.asarray(eager.run("allreduce", x, mpi.current_communicator(),
                                    backend="pallas"))
         np.testing.assert_array_equal(out, p * (p - 1) / 2)
+    finally:
+        rk._FORCE_INTERPRET = False
+        mpi.stop()
+
+
+def test_eager_pallas_broadcast_dispatch():
+    """backend='pallas' broadcast takes the RDMA pipelined kernel above the
+    tree cutoff (forced interpret)."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    mpi.start()
+    rk._FORCE_INTERPRET = True
+    try:
+        mpi.constants.set("small_broadcast_size_cpu", 1)
+        mpi.constants.set("broadcast_size_tree_based_cpu", 64)  # pipeline
+        p = mpi.size()
+        comm = mpi.current_communicator()
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(p, 3000).astype(np.float32))
+        from torchmpi_tpu.collectives import eager
+
+        out = np.asarray(
+            eager.run("broadcast", x, comm, backend="pallas", root=1 % p)
+        )
+        np.testing.assert_array_equal(
+            out, np.tile(np.asarray(x)[1 % p], (p, 1))
+        )
+    finally:
+        rk._FORCE_INTERPRET = False
+        mpi.stop()
+
+
+def test_eager_pallas_dtype_fallback():
+    """Unsupported dtypes through backend='pallas' silently fall back to the
+    ppermute ring and stay exact (the round-1 int corruption regression)."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.collectives import eager
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    mpi.start()
+    rk._FORCE_INTERPRET = True
+    try:
+        mpi.constants.set("small_allreduce_size_cpu", 1)
+        mpi.constants.set("use_hierarchical_collectives", False)
+        p = mpi.size()
+        comm = mpi.current_communicator()
+        # int32 IS supported natively now: values >= 2^24 stay exact
+        big = 1 << 24
+        x = jnp.full((p, 700), big, jnp.int32)
+        out = np.asarray(eager.run("allreduce", x, comm, backend="pallas"))
+        np.testing.assert_array_equal(out, np.int64(big) * p)
+        # uint32 is NOT in the native set and has no lossless carrier ->
+        # must have routed through the ppermute ring, still exact
+        assert not rk.supports_dtype(jnp.uint32)
+        xu = jnp.full((p, 700), 3, jnp.uint32)
+        outu = np.asarray(eager.run("allreduce", xu, comm, backend="pallas"))
+        np.testing.assert_array_equal(outu, 3 * p)
+        keys = [
+            k for k in comm._collective_resources
+            if k[0] == "allreduce" and k[1] == "ring"
+        ]
+        assert keys, "uint32 did not fall back to the ppermute ring"
     finally:
         rk._FORCE_INTERPRET = False
         mpi.stop()

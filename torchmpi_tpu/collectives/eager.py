@@ -175,11 +175,22 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             num_buffers=nbuf,
         )
 
-    def _ring_bcast(b):
-        if "tree" in extra:
-            return prim.tree_broadcast(b, root, _AXIS)
-        k = next((e[1] for e in extra if isinstance(e, tuple) and e[0] == "chunks"), None)
-        return prim.ring_broadcast(b, root, _AXIS, num_chunks=k)
+    def _bcast_builder(pipeline_fn):
+        # shared tree-vs-pipeline routing for the custom-ring broadcasts;
+        # extra carries the decision + the ('chunks', k) pipelining depth
+        def bcast(b):
+            if "tree" in extra:
+                return prim.tree_broadcast(b, root, _AXIS)
+            k = next(
+                (e[1] for e in extra if isinstance(e, tuple) and e[0] == "chunks"),
+                None,
+            )
+            return pipeline_fn(b, k)
+        return bcast
+
+    _ring_bcast = _bcast_builder(
+        lambda b, k: prim.ring_broadcast(b, root, _AXIS, num_chunks=k)
+    )
 
     if backend == "xla":
         table = {
@@ -198,13 +209,21 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
     elif backend == "pallas":
-        # Pallas ICI-RDMA ring for allreduce; other ops take the ppermute
-        # ring (the reference similarly mixed transports per collective).
-        from ..ops.ring_kernels import ring_allreduce_pallas
+        # Pallas ICI-RDMA rings for allreduce + pipelined broadcast; the
+        # remaining ops take the ppermute ring (the reference similarly
+        # mixed transports per collective).
+        from ..ops.ring_kernels import (
+            ring_allreduce_pallas,
+            ring_broadcast_pallas,
+        )
+
+        _pallas_bcast = _bcast_builder(
+            lambda b, k: ring_broadcast_pallas(b, root, _AXIS, num_chunks=k)
+        )
 
         table = {
             "allreduce": lambda b: ring_allreduce_pallas(b, _AXIS),
-            "broadcast": _ring_bcast,
+            "broadcast": _pallas_bcast,
             "reduce": _ring_reduce,
             "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
@@ -253,6 +272,16 @@ def run(
     effective = backend
     if backend in ("ring", "pallas") and route_small:
         effective = op_route(op, _nelem_per_rank(x), platform, backend)
+    if effective == "pallas" and op in ("allreduce", "reduce"):
+        from ..ops import ring_kernels
+
+        # dtype gate for REDUCTIONS: the pallas ring must preserve the
+        # dtype exactly (round-1 silently corrupted int32 >= 2^24 via an
+        # f32 cast); unsupported dtypes take the ppermute ring instead.
+        # Data-movement ops (broadcast) carry any dtype losslessly as a
+        # byte view and need no gate.
+        if not ring_kernels.supports_dtype(jnp.result_type(x)):
+            effective = "ring"
     if (
         op == "allreduce"
         and effective == "ring"

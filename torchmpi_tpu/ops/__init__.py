@@ -1,1 +1,20 @@
 """Pallas TPU kernels: fused reduction, ring collectives over ICI RDMA."""
+
+from .reduce_kernel import accumulate, scale_accumulate
+from .ring_kernels import (
+    available,
+    ring_allreduce_pallas,
+    ring_broadcast_pallas,
+    ring_reduce_scatter_pallas,
+    supports_dtype,
+)
+
+__all__ = [
+    "accumulate",
+    "scale_accumulate",
+    "available",
+    "ring_allreduce_pallas",
+    "ring_broadcast_pallas",
+    "ring_reduce_scatter_pallas",
+    "supports_dtype",
+]
