@@ -1,0 +1,130 @@
+"""Hierarchical composition parity per collective on 2-level communicators.
+
+Reference: every p2p/NCCL collective routes through the hierarchical
+dispatcher (intra x inter composition with the cartesian shortcut and the
+non-cartesian trailing intra broadcast, ``collectives_cuda.cpp:501-581,
+1057-1141``). Each op's 2-level result must equal the flat collective.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.collectives.eager import (
+    CollectiveArgumentError,
+    run_hierarchical_collective,
+    run_tree_hierarchical_allreduce,
+)
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+
+
+def _2level():
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks for a 2-level topology")
+    mpi.push_communicator(lambda r: str(r % 2), name="h2l")
+    comm = mpi.current_communicator()
+    assert comm.cartesian
+    return p, comm
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_hierarchical_broadcast_matches_flat(root):
+    p, comm = _2level()
+    rng = np.random.RandomState(root)
+    x = jnp.asarray(rng.randn(p, 300).astype(np.float32))
+    out = np.asarray(run_hierarchical_collective("broadcast", x, comm, root=root))
+    np.testing.assert_array_equal(out, np.tile(np.asarray(x)[root], (p, 1)))
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_hierarchical_reduce_matches_flat(root):
+    p, comm = _2level()
+    rng = np.random.RandomState(root + 10)
+    x = jnp.asarray(rng.randn(p, 257).astype(np.float32))
+    out = np.asarray(run_hierarchical_collective("reduce", x, comm, root=root))
+    expect = np.asarray(x).copy()
+    expect[root] = np.asarray(x).sum(axis=0)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-6)
+
+
+def test_hierarchical_allgather_matches_flat():
+    p, comm = _2level()
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(p, 40).astype(np.float32))
+    out = np.asarray(run_hierarchical_collective("allgather", x, comm))
+    # every rank's block = concat of all ranks' blocks in GLOBAL rank order
+    expect = np.tile(np.asarray(x).reshape(1, -1), (p, 1))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_hierarchical_collective_routed_from_dispatch():
+    """Above the cutoffs, the ring backend routes broadcast/allgather
+    through the hierarchical path on cartesian 2-level comms."""
+    p, comm = _2level()
+    mpi.constants.set("small_broadcast_size_cpu", 1)
+    x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, 600))
+    out = np.asarray(mpi.ring.broadcast_tensor(x, root=1, comm=comm))
+    np.testing.assert_array_equal(out, 1)
+    assert any(
+        k[0] == "hier" and k[1] == "broadcast"
+        for k in comm._collective_resources
+    ), "hierarchical broadcast path not taken"
+    out = np.asarray(mpi.ring.allgather_tensor(x[:, :8], comm=comm))
+    assert any(
+        k[0] == "hier" and k[1] == "allgather"
+        for k in comm._collective_resources
+    ), "hierarchical allgather path not taken"
+
+
+def test_tree_hierarchical_allreduce_ragged():
+    """Non-cartesian (ragged) comms take grouped psums + the trailing
+    intra broadcast; result matches the flat sum exactly."""
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks")
+    # ragged: group 0 gets 1 member, group 1 the rest
+    keys = ["a" if r == 0 else "b" for r in range(p)]
+    mpi.push_communicator(lambda r: keys[r], name="ragged-h")
+    comm = mpi.current_communicator()
+    assert not comm.cartesian and comm.has_inter_collective
+    x = jnp.tile(jnp.arange(p, dtype=jnp.int32)[:, None], (1, 123))
+    out = np.asarray(run_tree_hierarchical_allreduce(x, comm))
+    np.testing.assert_array_equal(out, p * (p - 1) // 2)
+
+
+def test_tree_hierarchical_routed_from_dispatch():
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks")
+    keys = ["a" if r == 0 else "b" for r in range(p)]
+    mpi.push_communicator(lambda r: keys[r], name="ragged-h2")
+    comm = mpi.current_communicator()
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, 700))
+    out = np.asarray(mpi.ring.allreduce_tensor(x, comm=comm))
+    np.testing.assert_array_equal(out, p * (p - 1) / 2)
+    assert any(
+        k[0] == "tree_hier_allreduce" for k in comm._collective_resources
+    ), "tree hierarchical path not taken"
+
+
+def test_hierarchical_collective_rejects_flat_comm():
+    x = jnp.zeros((mpi.size(), 8), jnp.float32)
+    with pytest.raises(CollectiveArgumentError):
+        run_hierarchical_collective("broadcast", x, mpi.stack().at(0))
+
+
+def test_hierarchical_reduce_int_exact():
+    p, comm = _2level()
+    x = jnp.tile(jnp.arange(p, dtype=jnp.int32)[:, None], (1, 99)) + (1 << 24)
+    out = np.asarray(run_hierarchical_collective("reduce", x, comm, root=1))
+    expect = np.asarray(x).copy()
+    expect[1] = np.asarray(x).astype(np.int64).sum(axis=0).astype(np.int32)
+    np.testing.assert_array_equal(out, expect)

@@ -276,6 +276,8 @@ def test_pallas_reduce_scatter_interpret(p):
 def test_pallas_reduce_scatter_rejects_indivisible():
     from torchmpi_tpu.ops.ring_kernels import ring_reduce_scatter_pallas
 
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
     p = 4
     mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
     with pytest.raises(ValueError, match="divisible"):
@@ -358,6 +360,30 @@ def test_pallas_reduce_scatter_vmem_segmentation():
         )
     finally:
         rk._VMEM_BUDGET_BYTES = old
+
+
+def test_pallas_broadcast_bool_rides_as_uint8():
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    p = 4
+    mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+    x = np.random.RandomState(8).rand(p, 600) > 0.5
+    f = jax.jit(
+        jax.shard_map(
+            lambda b: rk.ring_broadcast_pallas(
+                b, 1, "mpi", axis_size=p, interpret=True
+            ),
+            mesh=mesh,
+            in_specs=P("mpi"),
+            out_specs=P("mpi"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(x))
+    assert out.dtype == np.bool_
+    np.testing.assert_array_equal(out, np.tile(x[1], (p, 1)))
 
 
 def test_pallas_reduction_rejects_lossy_dtype():

@@ -37,6 +37,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import constants
@@ -272,30 +273,40 @@ def run(
     effective = backend
     if backend in ("ring", "pallas") and route_small:
         effective = op_route(op, _nelem_per_rank(x), platform, backend)
-    if effective == "pallas" and op in ("allreduce", "reduce"):
+    if effective == "pallas":
         from ..ops import ring_kernels
 
-        # dtype gate for REDUCTIONS: the pallas ring must preserve the
-        # dtype exactly (round-1 silently corrupted int32 >= 2^24 via an
-        # f32 cast); unsupported dtypes take the ppermute ring instead.
-        # Data-movement ops (broadcast) carry any dtype losslessly as a
-        # byte view and need no gate.
-        if not ring_kernels.supports_dtype(jnp.result_type(x)):
+        dt = jnp.result_type(x)
+        # dtype gates: REDUCTIONS must preserve the dtype exactly (round-1
+        # silently corrupted int32 >= 2^24 via an f32 cast) — unsupported
+        # dtypes take the ppermute ring. Data-movement ops carry any real
+        # dtype losslessly as a byte view; only complex must fall back.
+        if op in ("allreduce", "reduce"):
+            if not ring_kernels.supports_dtype(dt):
+                effective = "ring"
+        elif jnp.dtype(dt).kind == "c":
             effective = "ring"
-    if (
-        op == "allreduce"
-        and effective == "ring"
+    hier = (
+        effective in ("ring", "pallas")
         and constants.get("use_hierarchical_collectives")
-        and comm.cartesian
         and comm.has_inter_collective
         and comm.has_intra_collective
-    ):
-        # two-level ring composition on hierarchical cartesian comms
-        # (collectives_cuda.cpp:501-581); staged-vs-direct inter transport
-        # selected by use_staged_collectives (kUseStagedCollectives,
-        # detail/collectives_cuda.cpp:877-899)
-        impl = "staged" if constants.get("use_staged_collectives") else "ring"
-        return run_hierarchical_allreduce(x, comm, impl=impl)
+    )
+    if hier and comm.cartesian:
+        # two-level composition on hierarchical cartesian comms
+        # (collectives_cuda.cpp:501-581,1057-1141); staged-vs-direct inter
+        # transport selected by use_staged_collectives
+        # (kUseStagedCollectives, detail/collectives_cuda.cpp:877-899)
+        if op == "allreduce":
+            impl = "staged" if constants.get("use_staged_collectives") else "ring"
+            return run_hierarchical_allreduce(x, comm, impl=impl)
+        if op in ("broadcast", "reduce", "allgather"):
+            return run_hierarchical_collective(op, x, comm, root=root)
+    elif hier and op == "allreduce":
+        # non-cartesian (ragged/tree) comms: grouped reduce + roots
+        # exchange + the trailing intra broadcast
+        # (collectives_cuda.cpp:569-579)
+        return run_tree_hierarchical_allreduce(x, comm)
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
     tuning: Tuple = ()
     if effective in ("ring", "pallas"):
@@ -374,52 +385,32 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
         )
     if impl == "staged":
         return _run_staged_hierarchical_allreduce(x, comm)
-    cache = _resource_cache(comm)
     donate = constants.get("donate_eager_buffers")
     tuning = ring_tuning(comm._devices[0].platform) if impl == "ring" else ()
     key = (
         "hier_allreduce", impl, tuple(x.shape), jnp.result_type(x), donate,
         tuning,
     )
-    fn = cache.get(key)
-    if fn is None:
-        # group-major permutation: stacked axis0 (global rank order) ->
-        # mesh order. Communicator._groups is already group-major with
-        # members in intra-rank order — the exact mesh layout.
-        perm = np.concatenate(comm._groups).astype(np.int32)
-        inv = np.argsort(perm).astype(np.int32)
-        mesh = comm.mesh  # 2D (inter, intra)
-        spec = P(("inter", "intra"), *([None] * (x.ndim - 1)))
 
-        if impl == "ring":
-            minb, maxb, nbuf = tuning
+    if impl == "ring":
+        minb, maxb, nbuf = tuning
 
-            def kernel(b):
-                b = prim.ring_allreduce(
-                    b, "intra",
-                    max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                    num_buffers=nbuf,
-                )
-                return prim.ring_allreduce(
-                    b, "inter",
-                    max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                    num_buffers=nbuf,
-                )
-        else:
-            def kernel(b):
-                return jax.lax.psum(jax.lax.psum(b, "intra"), "inter")
+        def kernel(b):
+            b = prim.ring_allreduce(
+                b, "intra",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf,
+            )
+            return prim.ring_allreduce(
+                b, "inter",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf,
+            )
+    else:
+        def kernel(b):
+            return jax.lax.psum(jax.lax.psum(b, "intra"), "inter")
 
-        shmapped = jax.shard_map(
-            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
-        )
-        perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
-
-        def run_fn(a):
-            return jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
-
-        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
-        cache[key] = fn
-    return fn(x)
+    return _hier_compile(comm, key, x.ndim, donate, kernel)(x)
 
 
 def _run_staged_hierarchical_allreduce(x, comm: Communicator):
@@ -473,6 +464,202 @@ def _run_staged_hierarchical_allreduce(x, comm: Communicator):
     total = host.sum(axis=0).astype(host.dtype)
     stacked = np.broadcast_to(total, (comm.size,) + total.shape)
     return jax.device_put(stacked, _rank_sharding(comm, x.ndim))
+
+
+def _hier_compile(comm: Communicator, key, ndim: int, donate: bool, kernel,
+                  post=None):
+    """Shared scaffolding for 2-level (cartesian) compositions: permute the
+    rank-stacked rows into group-major mesh order, shard_map ``kernel`` over
+    the (inter, intra) mesh, permute back (+ optional ``post(out, inv)``),
+    jit with donation, memoize under ``key``."""
+    cache = _resource_cache(comm)
+    fn = cache.get(key)
+    if fn is None:
+        perm = np.concatenate(comm._groups).astype(np.int32)
+        inv = np.argsort(perm).astype(np.int32)
+        mesh = comm.mesh  # 2D (inter, intra)
+        spec = P(("inter", "intra"), *([None] * (ndim - 1)))
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        )
+        perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
+
+        def run_fn(a):
+            out = jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
+            return out if post is None else post(out, inv_j)
+
+        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
+        cache[key] = fn
+    return fn
+
+
+def run_hierarchical_collective(op: str, x, comm: Communicator, root: int = 0):
+    """Two-level composition of broadcast/reduce/allgather on a cartesian
+    communicator, routed like the hierarchical allreduce — the reference's
+    per-collective hierarchical dispatch (``collectives_cuda.cpp:501-581,
+    1057-1141``):
+
+    - broadcast: inter-level ring/tree broadcast from the root's group
+      within every intra row, then intra broadcast from the root's intra
+      rank (every rank ends with the root's block).
+    - reduce: intra ring-reduce to the root's intra rank, inter ring-reduce
+      to the root's group; non-root ranks keep their input (this API's
+      defined MPI_Reduce behavior).
+    - allgather: intra all-gather then inter all-gather along the last dim,
+      with the concatenation re-ordered from mesh (group-major) order to
+      global rank order.
+    """
+    x = jnp.asarray(x)
+    _check_rank_stacked(x, comm)
+    if not (comm.cartesian and comm.has_inter_collective and comm.has_intra_collective):
+        raise CollectiveArgumentError(
+            "hierarchical collectives need a cartesian communicator with "
+            "multiple intra groups of size > 1"
+        )
+    if op in ("broadcast", "reduce") and not 0 <= root < comm.size:
+        raise CollectiveArgumentError(f"root {root} out of range")
+    donate = constants.get("donate_eager_buffers")
+    platform = comm._devices[0].platform
+    tuning = ring_tuning(platform)
+    minb, maxb, nbuf = tuning
+    tree = False
+    if op == "broadcast":
+        suffix = constants.platform_suffix(platform)
+        block_bytes = _nelem_per_rank(x) * jnp.result_type(x).itemsize
+        tree = block_bytes <= constants.get(
+            f"broadcast_size_tree_based_{suffix}"
+        )
+    key = (
+        "hier", op, root, tuple(x.shape), jnp.result_type(x), donate, tuning,
+        tree,
+    )
+    g0 = next(gi for gi, g in enumerate(comm._groups) if root in g)
+    i0 = comm.member(root).intra_rank
+
+    def bcast_axis(b, r, axis):
+        if tree:
+            return prim.tree_broadcast(b, r, axis)
+        return prim.ring_broadcast(b, r, axis)
+
+    if op == "broadcast":
+        def kernel(b):
+            # inter phase within every intra row, then intra phase
+            b = bcast_axis(b, g0, "inter")
+            return bcast_axis(b, i0, "intra")
+        post = None
+    elif op == "reduce":
+        def kernel(b):
+            y = prim.ring_reduce(
+                b, i0, "intra",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf,
+            )
+            z = prim.ring_reduce(
+                y, g0, "inter",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf,
+            )
+            is_root = (lax.axis_index("inter") == g0) & (
+                lax.axis_index("intra") == i0
+            )
+            return jnp.where(is_root, z, b)
+        post = None
+    else:  # allgather
+        def kernel(b):
+            b = prim.ring_allgather(b, "intra", dim=-1)
+            return prim.ring_allgather(b, "inter", dim=-1)
+
+        p, d = comm.size, int(x.shape[-1])
+
+        def post(out, inv_j):
+            # concat blocks arrive in mesh (group-major) order: put them
+            # in global rank order along the gathered dim
+            blocks = out.reshape(out.shape[:-1] + (p, d))
+            return jnp.take(blocks, inv_j, axis=-2).reshape(out.shape)
+
+    return _hier_compile(comm, key, x.ndim, donate, kernel, post)(x)
+
+
+def _binomial_reduce_steps(groups, p: int):
+    """Static (perm, recv_mask) schedule per step of a binomial reduction to
+    each group's first member: member j at span s receives from j+span when
+    j % 2span == 0. ``log2(max group)`` steps; every value accumulated
+    exactly once."""
+    steps = []
+    span = 1
+    while True:
+        perm = []
+        mask = np.zeros((p,), bool)
+        for g in groups:
+            for j in range(0, len(g), 2 * span):
+                if j + span < len(g):
+                    perm.append((g[j + span], g[j]))
+                    mask[g[j]] = True
+        if not perm:
+            break
+        steps.append((perm, mask))
+        span *= 2
+    return steps
+
+
+def run_tree_hierarchical_allreduce(x, comm: Communicator):
+    """Hierarchical allreduce on a NON-cartesian (ragged/tree) communicator
+    — the reference's non-cartesian path (intra reduce to group root, inter
+    exchange among roots, final intra broadcast,
+    ``collectives_cuda.cpp:546-581``).
+
+    TPU-native expression: statically-scheduled binomial ``ppermute``
+    reductions (ragged groups forbid XLA's ``axis_index_groups``, which
+    requires equal-size groups on TPU): reduce within each group to its
+    root, reduce across the roots to the global root, then a static
+    cross-device gather broadcasts the total — the trailing broadcast of
+    the reference, collapsed to one hop.
+    """
+    x = jnp.asarray(x)
+    _check_rank_stacked(x, comm)
+    if not (comm.has_inter_collective and comm.has_intra_collective):
+        raise CollectiveArgumentError(
+            "hierarchical allreduce needs a communicator with both levels"
+        )
+    cache = _resource_cache(comm)
+    donate = constants.get("donate_eager_buffers")
+    key = ("tree_hier_allreduce", tuple(x.shape), jnp.result_type(x), donate)
+    fn = cache.get(key)
+    if fn is None:
+        p = comm.size
+        groups = [list(map(int, g)) for g in comm._groups]
+        roots = [g[0] for g in groups]
+        schedule = _binomial_reduce_steps(groups, p) + _binomial_reduce_steps(
+            [roots], p
+        )
+        mesh = _flat_mesh(comm)
+        spec = _rank_spec(x.ndim)
+
+        def kernel(b):
+            for perm, mask in schedule:
+                recv = lax.ppermute(b, _AXIS, perm)  # non-targets get zeros
+                receives = jnp.take(
+                    jnp.asarray(mask), lax.axis_index(_AXIS)
+                )
+                b = jnp.where(receives, b + recv, b)
+            return b
+
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        )
+        sharding = _rank_sharding(comm, x.ndim)
+        # trailing broadcast: everyone reads the global root's total
+        idx = jnp.full((p,), roots[0], jnp.int32)
+
+        def run_fn(a):
+            y = shmapped(a)
+            return jax.lax.with_sharding_constraint(
+                jnp.take(y, idx, axis=0), sharding
+            )
+
+        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
+        cache[key] = fn
+    return fn(x)
 
 
 def run_group_broadcast(x, comm: Communicator, root: int = 0):

@@ -89,10 +89,18 @@ def _carrier_dtype(dtype):
 
 def _bitcast_to_bytes(flat):
     """Lossless byte view of any dtype (for data-movement kernels): returns
-    (int8 view, restore_fn)."""
-    d = flat.dtype
+    (int8 view, restore_fn). bool rides as uint8 (bitcast rejects it);
+    complex is rejected loudly (no TPU support)."""
+    d = jnp.dtype(flat.dtype)
     if d in _NATIVE_DTYPES:
         return flat, lambda out: out
+    if d == jnp.dtype(bool):
+        return flat.astype(jnp.uint8), lambda out: out.astype(bool)
+    if d.kind == "c":
+        raise ValueError(
+            "complex dtypes are not supported by the pallas ring; use the "
+            "ppermute ring backend instead"
+        )
     bits = jax.lax.bitcast_convert_type(flat, jnp.int8).reshape(-1)
     return bits, lambda out: jax.lax.bitcast_convert_type(
         out.reshape(-1, jnp.dtype(d).itemsize), d
