@@ -62,16 +62,81 @@ _WORKER = textwrap.dedent(
 ).format(repo=str(_REPO))
 
 
+_PS_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["TORCHMPI_TPU_PS_HOST"] = "localhost"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import parameterserver as ps
+    from torchmpi_tpu.runtime_state import local_ranks
+
+    mpi.start(
+        coordinator_address=f"localhost:{{port}}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    comm = mpi.current_communicator()
+    p = comm.size                      # 4 ranks over 2 processes
+    N, lr, steps, send_freq = 64, 0.1, 6, 2
+    init = np.linspace(0.0, 1.0, N).astype(np.float32)
+
+    # --- cross-process Downpour-style loop: each process drives its local
+    # clients; grads sent with 'add' scaled by -lr every send_freq steps
+    center = ps.ParameterServer(init, comm=comm)
+    inst = center._inst
+    assert sum(inst.is_local(r) for r in range(p)) == 2, "2 shards/process"
+
+    def grad_for(client, step):
+        rs = np.random.RandomState(97 * client + step)
+        return rs.randn(N).astype(np.float32)
+
+    for step in range(steps):
+        for client in local_ranks():
+            acc = sum(grad_for(client, s)
+                      for s in range(step, step + 1))  # one step's grad
+            if (step + 1) % send_freq == 0:
+                h = center.send(acc, rule="add", client=client, scale=-lr)
+                h.wait()
+    mpi.barrier()
+    got = center.receive(client=local_ranks()[0]).wait()
+
+    # --- single-process oracle of the same schedule
+    expect = init.copy()
+    for step in range(steps):
+        for client in range(p):
+            if (step + 1) % send_freq == 0:
+                expect += -lr * grad_for(client, step)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    # remote shard introspection crosses the transport
+    for r in range(p):
+        s, e = inst.ranges[r]
+        np.testing.assert_allclose(
+            center.shard_of(r), expect[s:e], rtol=1e-5, atol=1e-6
+        )
+    mpi.barrier()
+    center.free()
+    mpi.stop()
+    print(f"ps proc {{pid}} OK")
+    """
+).format(repo=str(_REPO))
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_allreduce(tmp_path):
+def _run_workers(tmp_path, source: str, ok_marker: str) -> None:
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(source)
     port = _free_port()
     procs = [
         subprocess.Popen(
@@ -93,4 +158,18 @@ def test_two_process_allreduce(tmp_path):
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
-        assert f"proc {i} OK" in out
+        assert ok_marker.format(pid=i) in out
+
+
+@pytest.mark.slow
+def test_two_process_allreduce(tmp_path):
+    _run_workers(tmp_path, _WORKER, "proc {pid} OK")
+
+
+@pytest.mark.slow
+def test_two_process_parameterserver_downpour(tmp_path):
+    """Cross-process PS over the socket transport: a Downpour-style
+    schedule driven from two controller processes must produce the same
+    center as the single-process oracle (the reference's whole point,
+    parameterserver.cpp:309-400)."""
+    _run_workers(tmp_path, _PS_WORKER, "ps proc {pid} OK")

@@ -152,6 +152,23 @@ def ring_tuning(platform: str) -> Tuple[int, int, int]:
     )
 
 
+def broadcast_plan(nelem: int, dtype, platform: str) -> Tuple[bool, int]:
+    """(use_tree, pipeline_chunks) for a broadcast of ``nelem`` elements:
+    tree below broadcast_size_tree_based (collectives.cpp:58-64's 4MB
+    switch); above it, the pipelined chunk count from the buffer-size
+    bounds — every chunk <= max_buffer_size and no smaller than
+    min_buffer_size (constants.cpp:142-150). One source of truth for the
+    flat AND hierarchical routes."""
+    suffix = constants.platform_suffix(platform)
+    block_bytes = nelem * jnp.dtype(dtype).itemsize
+    if block_bytes <= constants.get(f"broadcast_size_tree_based_{suffix}"):
+        return True, 1
+    minb, maxb, _ = ring_tuning(platform)
+    k = max(1, -(-block_bytes // max(1, maxb)))
+    k = min(k, max(1, block_bytes // max(1, minb)))
+    return False, int(k)
+
+
 def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ()):
     """Return a kernel fn(block) for the given op/backend.
 
@@ -288,6 +305,9 @@ def run(
             effective = "ring"
     hier = (
         effective in ("ring", "pallas")
+        # route_small=False pins the EXACT backend (tester/autotuner
+        # contract: each path measured on its own) — no hier rerouting
+        and route_small
         and constants.get("use_hierarchical_collectives")
         and comm.has_inter_collective
         and comm.has_intra_collective
@@ -312,19 +332,8 @@ def run(
     if effective in ("ring", "pallas"):
         tuning = ring_tuning(platform)
     if effective in ("ring", "pallas") and op == "broadcast":
-        suffix = constants.platform_suffix(platform)
-        cutoff = constants.get(f"broadcast_size_tree_based_{suffix}")
-        block_bytes = _nelem_per_rank(x) * jnp.result_type(x).itemsize
-        if block_bytes <= cutoff:
-            extra = extra + ("tree",)
-        else:
-            # pipelined chunk count from the buffer-size bounds: every
-            # chunk <= max_buffer_size, and no smaller than min_buffer_size
-            # (constants.cpp:142-150's kMin/kMaxBufferSize pipelining).
-            minb, maxb, _ = tuning
-            k = max(1, -(-block_bytes // max(1, maxb)))
-            k = min(k, max(1, block_bytes // max(1, minb)))
-            extra = extra + ("pipeline", ("chunks", int(k)))
+        tree, k = broadcast_plan(_nelem_per_rank(x), jnp.result_type(x), platform)
+        extra = extra + (("tree",) if tree else ("pipeline", ("chunks", k)))
     aval = (tuple(x.shape), jnp.result_type(x))
     static = (root,) + extra + (tuning,)
     fn = _compile(
@@ -522,16 +531,14 @@ def run_hierarchical_collective(op: str, x, comm: Communicator, root: int = 0):
     platform = comm._devices[0].platform
     tuning = ring_tuning(platform)
     minb, maxb, nbuf = tuning
-    tree = False
+    tree, chunks = True, 1
     if op == "broadcast":
-        suffix = constants.platform_suffix(platform)
-        block_bytes = _nelem_per_rank(x) * jnp.result_type(x).itemsize
-        tree = block_bytes <= constants.get(
-            f"broadcast_size_tree_based_{suffix}"
+        tree, chunks = broadcast_plan(
+            _nelem_per_rank(x), jnp.result_type(x), platform
         )
     key = (
         "hier", op, root, tuple(x.shape), jnp.result_type(x), donate, tuning,
-        tree,
+        (tree, chunks),
     )
     g0 = next(gi for gi, g in enumerate(comm._groups) if root in g)
     i0 = comm.member(root).intra_rank
@@ -539,7 +546,7 @@ def run_hierarchical_collective(op: str, x, comm: Communicator, root: int = 0):
     def bcast_axis(b, r, axis):
         if tree:
             return prim.tree_broadcast(b, r, axis)
-        return prim.ring_broadcast(b, r, axis)
+        return prim.ring_broadcast(b, r, axis, num_chunks=chunks)
 
     if op == "broadcast":
         def kernel(b):

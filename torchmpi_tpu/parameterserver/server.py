@@ -99,6 +99,9 @@ class _Message:
     payload: Optional[np.ndarray] = None
     done: Optional[threading.Event] = None  # update: server-applied event
     reply: Optional[Future] = None  # trigger: fulfilled with shard copy
+    # set by the transport when the requester timed out: the server must
+    # NOT apply a message whose failure was already reported
+    cancelled: Optional[threading.Event] = None
 
 
 class _Instance:
@@ -111,37 +114,75 @@ class _Instance:
     numpy store is the portable fallback.
     """
 
-    def __init__(self, instance_id: int, full: np.ndarray, size: int):
+    def __init__(
+        self,
+        instance_id: int,
+        full: np.ndarray,
+        size: int,
+        owners: Optional[List[int]] = None,
+        my_proc: int = 0,
+    ):
         self.id = instance_id
         self.shape = full.shape
         self.dtype = full.dtype
         self.size = size
+        # cross-process sharding: rank r's shard lives in the process that
+        # owns rank r's device (the reference's per-process localShard_,
+        # parameterserver.cpp:253-275); single-controller = all local.
+        self.owners = owners if owners is not None else [my_proc] * size
+        self.my_proc = my_proc
         flat = full.reshape(-1)
         self.ranges: List[Tuple[int, int]] = []
         sizes = []
         for r in range(size):
             s, e = shard_range(flat.shape[0], size, r)
             self.ranges.append((s, e))
-            sizes.append(e - s)
+            # remote shards get zero-size local storage
+            sizes.append(e - s if self.owners[r] == my_proc else 0)
         self.native = None
         if constants.get("use_native_runtime"):
             try:
                 from ..runtime.native import NativeShardStore, available
 
                 if available():
-                    self.native = NativeShardStore(sizes, self.dtype, flat)
+                    # the native store partitions its init buffer by
+                    # cumsum(sizes): feed it only the LOCAL shards' data so
+                    # zero-sized remote entries don't shift the offsets
+                    local_init = np.concatenate(
+                        [
+                            flat[s:e]
+                            for r, (s, e) in enumerate(self.ranges)
+                            if self.owners[r] == my_proc
+                        ]
+                        or [flat[:0]]
+                    )
+                    self.native = NativeShardStore(sizes, self.dtype, local_init)
             except Exception:
                 self.native = None
         if self.native is None:
-            self._shards: List[np.ndarray] = [
-                flat[s:e].copy() for s, e in self.ranges
+            self._shards: List[Optional[np.ndarray]] = [
+                flat[s:e].copy() if self.owners[r] == my_proc else None
+                for r, (s, e) in enumerate(self.ranges)
             ]
         self.mailboxes: List[deque] = [deque() for _ in range(size)]
         self.locks = [threading.Lock() for _ in range(size)]
         self.freed = False
+        from .transport import instance_fingerprint
+
+        self.fingerprint = instance_fingerprint(
+            self.shape, self.dtype, size, self.owners
+        )
+
+    def is_local(self, r: int) -> bool:
+        return self.owners[r] == self.my_proc
 
     # --- storage backend dispatch ---
     def apply_rule(self, r: int, rule: str, payload) -> None:
+        if not self.is_local(r):
+            raise RuntimeError(
+                f"shard {r} is owned by process {self.owners[r]}, not this "
+                f"process ({self.my_proc})"
+            )
         if self.native is not None:
             from ..runtime.native import NativeShardStore
 
@@ -158,6 +199,11 @@ class _Instance:
             UPDATE_RULES[rule](self._shards[r], payload)
 
     def read_shard(self, r: int) -> np.ndarray:
+        if not self.is_local(r):
+            raise RuntimeError(
+                f"shard {r} is owned by process {self.owners[r]}, not this "
+                f"process ({self.my_proc})"
+            )
         if self.native is not None:
             return self.native.read(r)
         return self._shards[r].copy()
@@ -192,6 +238,15 @@ class _Instance:
                         break
                     msg = self.mailboxes[r].popleft()
                 worked = True
+                if msg.cancelled is not None and msg.cancelled.is_set():
+                    # requester already saw a failure for this message
+                    if msg.done:
+                        msg.done.set()
+                    if msg.reply is not None and not msg.reply.done():
+                        msg.reply.set_exception(
+                            RuntimeError("parameter-server request cancelled")
+                        )
+                    continue
                 if msg.kind == "update":
                     try:
                         if msg.rule not in UPDATE_RULES:
@@ -234,9 +289,20 @@ class _GlobalServer:
         self._terminate = threading.Event()
         self._ids = itertools.count()
 
-    def register(self, full: np.ndarray, size: int) -> _Instance:
+    def get_instance(self, inst_id: int) -> Optional[_Instance]:
+        """Lookup for the socket transport's listener."""
         with self._lock:
-            inst = _Instance(next(self._ids), full, size)
+            return self._instances.get(inst_id)
+
+    def register(
+        self,
+        full: np.ndarray,
+        size: int,
+        owners: Optional[List[int]] = None,
+        my_proc: int = 0,
+    ) -> _Instance:
+        with self._lock:
+            inst = _Instance(next(self._ids), full, size, owners, my_proc)
             self._instances[inst.id] = inst
             if self._thread is None or not self._thread.is_alive():
                 self._terminate.clear()
@@ -353,7 +419,26 @@ class ParameterServer:
         if full.dtype not in (np.float32, np.float64):
             # reference instantiates Float/Double only
             full = full.astype(np.float32)
-        self._inst = _server.register(full, comm.size)
+        import jax
+
+        my_proc = jax.process_index()
+        owners = [d.process_index for d in comm._devices]
+        self._transport = None
+        if any(o != my_proc for o in owners):
+            # cross-process PS: bootstrap the socket transport and barrier
+            # so every process has registered the instance before any
+            # traffic (the reference wraps PS init in barriers,
+            # parameterserver.cpp:677-745). Instance ids agree because all
+            # processes create parameter servers in the same collective
+            # order — the reference's standing ordering requirement.
+            from . import transport as _t
+            from jax.experimental import multihost_utils
+
+            self._transport = _t.ensure_transport()
+            self._inst = _server.register(full, comm.size, owners, my_proc)
+            multihost_utils.sync_global_devices("tm-ps-init")
+        else:
+            self._inst = _server.register(full, comm.size, owners, my_proc)
         self.shape = full.shape
         self.dtype = full.dtype
 
@@ -392,23 +477,33 @@ class ParameterServer:
             flat = flat.copy()
 
         inst = self._inst
+        transport = self._transport
 
         def do_send():
             events = []
             for r in range(inst.size):
                 s, e = inst.ranges[r]
-                ev = threading.Event()
-                inst.post(
-                    r,
-                    _Message(
-                        "update",
-                        client=client,
-                        rule=rule,
-                        payload=flat[s:e].copy(),
-                        done=ev,
-                    ),
-                )
-                events.append(ev)
+                if inst.is_local(r):
+                    ev = threading.Event()
+                    inst.post(
+                        r,
+                        _Message(
+                            "update",
+                            client=client,
+                            rule=rule,
+                            payload=flat[s:e].copy(),
+                            done=ev,
+                        ),
+                    )
+                    events.append(ev)
+                else:
+                    # remote shard: synchronous socket request, acked after
+                    # the peer APPLIED the rule (clientSend's Ssend
+                    # happens-before, parameterserver.cpp:339-347)
+                    transport.update(
+                        inst.owners[r], inst.id, r, client, rule, flat[s:e],
+                        fp=inst.fingerprint,
+                    )
             timeout = constants.get("deadlock_timeout_seconds") or None
             for ev in events:
                 if not ev.wait(timeout):
@@ -429,16 +524,27 @@ class ParameterServer:
             raise RuntimeError("parameter server already freed")
         inst = self._inst
         shape, dtype = self.shape, self.dtype
+        transport = self._transport
 
         def do_receive():
-            replies = []
-            for r in range(inst.size):
-                f: Future = Future()
-                inst.post(r, _Message("trigger", client=client, reply=f))
-                replies.append(f)
+            replies = {}
             out = np.empty((int(np.prod(shape)),), dtype)
+            for r in range(inst.size):
+                if inst.is_local(r):
+                    f: Future = Future()
+                    inst.post(r, _Message("trigger", client=client, reply=f))
+                    replies[r] = f
+                else:
+                    # remote shard: synchronous fetch over the transport
+                    # (clientReceive's trigger + Ssend-back,
+                    # parameterserver.cpp:356-400)
+                    s, e = inst.ranges[r]
+                    out[s:e] = transport.trigger(
+                        inst.owners[r], inst.id, r, client,
+                        fp=inst.fingerprint,
+                    )
             timeout = constants.get("deadlock_timeout_seconds") or None
-            for r, f in enumerate(replies):
+            for r, f in replies.items():
                 s, e = inst.ranges[r]
                 try:
                     out[s:e] = f.result(timeout)
@@ -456,7 +562,13 @@ class ParameterServer:
 
     def free(self) -> None:
         """Free the instance (barrier-wrapped collective in the reference,
-        ``parameterserver.cpp:735-745``)."""
+        ``parameterserver.cpp:735-745``). Cross-process: barrier BEFORE
+        unregistering so no peer frees while another's traffic is in
+        flight."""
+        if self._transport is not None and not self._inst.freed:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tm-ps-free")
         _server.unregister(self._inst)
 
     @property
@@ -468,8 +580,16 @@ class ParameterServer:
         free() on every backend (storage may be released natively)."""
         if self._inst.freed:
             raise RuntimeError("parameter server freed")
+        if not self._inst.is_local(rank) and self._transport is not None:
+            return self._transport.trigger(
+                self._inst.owners[rank], self._inst.id, rank, 0,
+                fp=self._inst.fingerprint,
+            )
         return self._inst.read_shard(rank)
 
 
 def free_all() -> None:
     _server.shutdown()
+    from . import transport as _t
+
+    _t.shutdown_transport()

@@ -1,0 +1,418 @@
+"""Cross-process parameter-server transport (sockets).
+
+The reference PS is inherently multi-process: clients ``Isend`` a rule name
+and ``Ssend`` shard slices to *remote* servers, whose polling thread
+``Iprobe``s per-instance tags (``lib/parameterserver.cpp:309-400,404-541``).
+The TPU rebuild's wire protocol is transport-abstracted (mailboxes); this
+module plugs a TCP transport into the same mailbox interface so a
+:class:`~torchmpi_tpu.parameterserver.ParameterServer` spans the processes
+of a multi-controller JAX job (``start(coordinator_address=...)``).
+
+Design:
+
+- every process runs one **listener** (accept loop + per-connection handler
+  threads) serving the shard ranks whose devices live in this process;
+- requests are length-prefixed binary frames (no pickle on the wire):
+  ``kind`` (UPDATE | TRIGGER), instance id, server rank, client, rule,
+  dtype, payload bytes — the tag-namespace parity of
+  ``instance * kSentinelTag + {rule, clientChunk, serverChunk, trigger}``
+  (``parameterserver.cpp:296-301``);
+- an UPDATE is acked only after the server thread *applied* the rule (the
+  Ssend happens-before guarantee, strengthened to applied — matching the
+  in-process transport); a TRIGGER replies with the shard bytes;
+- clients keep one pooled persistent connection per peer process;
+- addresses are exchanged once via ``multihost_utils.process_allgather``
+  (the runtime's coordination service), the analog of MPI's out-of-band
+  bootstrap.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants
+
+_MAGIC = 0x7E5B
+_KIND_UPDATE = 1
+_KIND_TRIGGER = 2
+_KIND_ACK = 3
+_KIND_SHARD = 4
+_KIND_ERROR = 5
+
+# frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
+#        fp u32, token u32, rule_len u16, dtype_len u16, payload_len u64
+#
+# - seq: per-(transport, client) monotone sequence for UPDATEs; the
+#   listener dedups on (inst, rank, client, seq) so a reconnect retry
+#   after a lost ACK cannot double-apply a non-idempotent rule.
+# - fp: instance fingerprint (shape/dtype/size/owners); catches
+#   process-local instance-id desync loudly instead of applying updates
+#   to the wrong tensor.
+# - token: optional shared secret (TORCHMPI_TPU_PS_TOKEN) so a stray
+#   network peer can't read or mutate parameters.
+_HEADER = struct.Struct(">HBIIIQIIHHQ")
+
+
+def _auth_token() -> int:
+    tok = os.environ.get("TORCHMPI_TPU_PS_TOKEN", "")
+    if not tok:
+        return 0
+    import zlib
+
+    return zlib.crc32(tok.encode()) & 0xFFFFFFFF
+
+
+def instance_fingerprint(shape, dtype, size: int, owners) -> int:
+    import zlib
+
+    desc = f"{tuple(shape)}|{np.dtype(dtype).str}|{size}|{tuple(owners)}"
+    return zlib.crc32(desc.encode()) & 0xFFFFFFFF
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed parameter-server connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(
+    sock: socket.socket,
+    kind: int,
+    inst: int = 0,
+    rank: int = 0,
+    client: int = 0,
+    seq: int = 0,
+    fp: int = 0,
+    rule: str = "",
+    dtype: str = "",
+    payload: bytes = b"",
+) -> None:
+    rule_b, dtype_b = rule.encode(), dtype.encode()
+    header = _HEADER.pack(
+        _MAGIC, kind, inst, rank, client, seq, fp, _auth_token(),
+        len(rule_b), len(dtype_b), len(payload),
+    )
+    sock.sendall(header + rule_b + dtype_b + payload)
+
+
+def _recv_frame(sock: socket.socket):
+    header = _recv_exact(sock, _HEADER.size)
+    magic, kind, inst, rank, client, seq, fp, token, rl, dl, pl = (
+        _HEADER.unpack(header)
+    )
+    if magic != _MAGIC:
+        raise ConnectionError(
+            f"bad parameter-server frame magic 0x{magic:x}"
+        )
+    if token != _auth_token():
+        raise ConnectionError("parameter-server frame failed authentication")
+    rule = _recv_exact(sock, rl).decode() if rl else ""
+    dtype = _recv_exact(sock, dl).decode() if dl else ""
+    payload = _recv_exact(sock, pl) if pl else b""
+    return kind, inst, rank, client, seq, fp, rule, dtype, payload
+
+
+class _Listener:
+    """Accept loop serving this process's shard ranks."""
+
+    def __init__(self, lookup_instance):
+        self._lookup = lookup_instance
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # bind the advertised interface when one is configured (defense
+        # in depth alongside the frame token); 0.0.0.0 otherwise so
+        # cluster peers on any fabric can reach us
+        bind_host = os.environ.get("TORCHMPI_TPU_PS_HOST", "0.0.0.0")
+        try:
+            self._sock.bind((bind_host, 0))
+        except OSError:
+            self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        # UPDATE dedup: last applied seq per (inst, rank, client) — a
+        # reconnect retry after a lost ACK must not double-apply
+        self._applied: Dict[Tuple[int, int, int], int] = {}
+        self._applied_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="tm-ps-listener", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="tm-ps-conn", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        import threading as _threading
+        from concurrent.futures import Future
+
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._stop.is_set():
+                kind, inst_id, rank, client, seq, fp, rule, dtype, payload = (
+                    _recv_frame(conn)
+                )
+                inst = self._lookup(inst_id)
+                if inst is None:
+                    _send_frame(
+                        conn, _KIND_ERROR,
+                        rule=f"unknown parameter-server instance {inst_id}",
+                    )
+                    continue
+                if fp and fp != inst.fingerprint:
+                    # instance-id desync (processes created PSs in
+                    # different orders): fail loudly, never apply to the
+                    # wrong tensor
+                    _send_frame(
+                        conn, _KIND_ERROR,
+                        rule=(
+                            f"instance {inst_id} fingerprint mismatch "
+                            "(parameter servers must be created in the "
+                            "same order on every process)"
+                        ),
+                    )
+                    continue
+                timeout = constants.get("deadlock_timeout_seconds") or None
+                from .server import _Message
+
+                if kind == _KIND_UPDATE:
+                    dkey = (inst_id, rank, client)
+                    with self._applied_lock:
+                        if seq and self._applied.get(dkey, 0) >= seq:
+                            # retry of an already-applied update: ack only
+                            _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
+                            continue
+                    values = np.frombuffer(payload, np.dtype(dtype))
+                    ev = _threading.Event()
+                    cancel = _threading.Event()
+                    inst.post(
+                        rank,
+                        _Message(
+                            "update", client=client, rule=rule,
+                            payload=values.copy(), done=ev, cancelled=cancel,
+                        ),
+                    )
+                    if not ev.wait(timeout):
+                        # withdraw the queued message so the shard does NOT
+                        # mutate after we reported failure (serve_once
+                        # skips cancelled messages)
+                        cancel.set()
+                        _send_frame(
+                            conn, _KIND_ERROR,
+                            rule="remote update apply timed out",
+                        )
+                        continue
+                    with self._applied_lock:
+                        if seq:
+                            self._applied[dkey] = seq
+                    _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
+                elif kind == _KIND_TRIGGER:
+                    f: Future = Future()
+                    inst.post(rank, _Message("trigger", client=client, reply=f))
+                    try:
+                        shard = f.result(timeout)
+                    except Exception as e:
+                        _send_frame(conn, _KIND_ERROR, rule=str(e))
+                        continue
+                    _send_frame(
+                        conn, _KIND_SHARD, inst=inst_id, rank=rank,
+                        dtype=shard.dtype.str, payload=shard.tobytes(),
+                    )
+                else:
+                    _send_frame(conn, _KIND_ERROR, rule=f"bad kind {kind}")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PeerPool:
+    """One persistent, lock-serialized connection per peer process."""
+
+    def __init__(self, addresses: Dict[int, Tuple[str, int]]):
+        self.addresses = addresses
+        self._conns: Dict[int, socket.socket] = {}
+        self._locks: Dict[int, threading.Lock] = {
+            p: threading.Lock() for p in addresses
+        }
+
+    def _connect(self, proc: int) -> socket.socket:
+        host, port = self.addresses[proc]
+        last_err: Optional[Exception] = None
+        for candidate in (host, "localhost"):
+            try:
+                sock = socket.create_connection((candidate, port), timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError as e:  # try localhost fallback (single-host test)
+                last_err = e
+        raise ConnectionError(
+            f"cannot reach parameter-server peer process {proc} at "
+            f"{host}:{port}: {last_err}"
+        )
+
+    def request(
+        self,
+        proc: int,
+        kind: int,
+        inst: int,
+        rank: int,
+        client: int,
+        seq: int = 0,
+        fp: int = 0,
+        rule: str = "",
+        payload_arr: Optional[np.ndarray] = None,
+    ):
+        """Synchronous request/response on the pooled connection. Safe to
+        retry on connection loss: UPDATEs carry ``seq`` so the peer dedups
+        a re-send whose original ACK was lost."""
+
+        def _do(sock):
+            if payload_arr is not None:
+                _send_frame(
+                    sock, kind, inst, rank, client, seq, fp, rule,
+                    payload_arr.dtype.str, payload_arr.tobytes(),
+                )
+            else:
+                _send_frame(sock, kind, inst, rank, client, seq, fp, rule)
+            return _recv_frame(sock)
+
+        with self._locks[proc]:
+            sock = self._conns.get(proc)
+            if sock is None:
+                sock = self._conns[proc] = self._connect(proc)
+            try:
+                rkind, _, _, _, _, _, rrule, rdtype, rpayload = _do(sock)
+            except (ConnectionError, OSError):
+                # one reconnect attempt (peer may have restarted its
+                # listener between requests)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                sock = self._conns[proc] = self._connect(proc)
+                rkind, _, _, _, _, _, rrule, rdtype, rpayload = _do(sock)
+        if rkind == _KIND_ERROR:
+            raise RuntimeError(f"parameter-server peer error: {rrule}")
+        if rkind == _KIND_SHARD:
+            return np.frombuffer(rpayload, np.dtype(rdtype)).copy()
+        return None  # ACK
+
+    def close(self):
+        for sock in self._conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+
+class Transport:
+    """Process-wide PS transport: listener + peer pool + address book."""
+
+    def __init__(self, lookup_instance):
+        import jax
+
+        self.process_index = jax.process_index()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self.listener = _Listener(lookup_instance)
+        host = os.environ.get("TORCHMPI_TPU_PS_HOST") or socket.gethostname()
+        addresses = self._exchange_addresses(host, self.listener.port)
+        self.pool = _PeerPool(addresses)
+
+    @staticmethod
+    def _exchange_addresses(host: str, port: int) -> Dict[int, Tuple[str, int]]:
+        import jax
+        from jax.experimental import multihost_utils
+
+        n = jax.process_count()
+        # fixed-width byte matrix: "host:port" padded to 256
+        me = f"{host}:{port}".encode()[:256].ljust(256, b"\0")
+        mine = np.frombuffer(me, np.uint8)
+        gathered = multihost_utils.process_allgather(mine)
+        out: Dict[int, Tuple[str, int]] = {}
+        for p in range(n):
+            s = bytes(gathered[p]).rstrip(b"\0").decode()
+            h, _, pt = s.rpartition(":")
+            out[p] = (h, int(pt))
+        return out
+
+    def update(
+        self, proc: int, inst: int, rank: int, client: int, rule: str,
+        payload: np.ndarray, fp: int = 0,
+    ) -> None:
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        self.pool.request(
+            proc, _KIND_UPDATE, inst, rank, client, seq=seq, fp=fp,
+            rule=rule, payload_arr=payload,
+        )
+
+    def trigger(
+        self, proc: int, inst: int, rank: int, client: int, fp: int = 0
+    ) -> np.ndarray:
+        return self.pool.request(
+            proc, _KIND_TRIGGER, inst, rank, client, fp=fp
+        )
+
+    def close(self):
+        self.pool.close()
+        self.listener.close()
+
+
+_transport: Optional[Transport] = None
+_transport_lock = threading.Lock()
+
+
+def ensure_transport() -> Transport:
+    """Bootstrap the process-wide transport on first cross-process PS use
+    (the reference bootstraps per-instance inside barriers,
+    ``parameterserver.cpp:677-745``)."""
+    global _transport
+    with _transport_lock:
+        if _transport is None:
+            from .server import _server
+
+            _transport = Transport(_server.get_instance)
+        return _transport
+
+
+def shutdown_transport() -> None:
+    global _transport
+    with _transport_lock:
+        if _transport is not None:
+            _transport.close()
+            _transport = None
