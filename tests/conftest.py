@@ -12,6 +12,16 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Isolate the autotuner persistence: a developer's ~/.cache tuning entry
+# must not silently change routing constants inside tests (start() loads
+# the cache by default).
+if "TORCHMPI_TPU_TUNING_CACHE" not in os.environ:
+    import tempfile
+
+    os.environ["TORCHMPI_TPU_TUNING_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="tm-test-tuning-"), "autotune.json"
+    )
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -98,10 +98,11 @@ _PS_WORKER = textwrap.dedent(
 
     for step in range(steps):
         for client in local_ranks():
-            acc = sum(grad_for(client, s)
-                      for s in range(step, step + 1))  # one step's grad
             if (step + 1) % send_freq == 0:
-                h = center.send(acc, rule="add", client=client, scale=-lr)
+                h = center.send(
+                    grad_for(client, step), rule="add", client=client,
+                    scale=-lr,
+                )
                 h.wait()
     mpi.barrier()
     got = center.receive(client=local_ranks()[0]).wait()

@@ -145,6 +145,82 @@ def test_autotune_allreduce_cutoff():
     assert constants.get(f"small_allreduce_size_{suffix}") == cutoff
 
 
+def test_autotune_broadcast_and_switch(tmp_path, monkeypatch):
+    """Broadcast cutoff + tree->pipeline switch + chunk size + ring impl
+    are all measured and set; results persist per (platform, world size)
+    and load_tuning re-applies them."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.constants import platform_suffix
+    from torchmpi_tpu.utils import autotune
+
+    monkeypatch.setenv(
+        "TORCHMPI_TPU_TUNING_CACHE", str(tmp_path / "tune.json")
+    )
+    comm = mpi.current_communicator()
+    suffix = platform_suffix(comm.devices[0].platform)
+
+    cutoff, res = autotune.tune_broadcast_cutoff(
+        comm, min_pow=8, max_pow=9, warmup=1, timed=2
+    )
+    assert constants.get(f"small_broadcast_size_{suffix}") == cutoff
+
+    switch, res = autotune.tune_tree_pipeline_switch(
+        comm, min_pow=9, max_pow=10, warmup=1, timed=2
+    )
+    assert constants.get(f"broadcast_size_tree_based_{suffix}") == switch
+    assert len(res) == 2 and all(t > 0 and q > 0 for _, t, q in res)
+
+    best, res = autotune.tune_chunk_size(
+        comm, nelem=4096, candidates=(1 << 12, 1 << 14), warmup=1, timed=2
+    )
+    assert best in (1 << 12, 1 << 14)
+    assert constants.get(f"max_buffer_size_{suffix}") == best
+    assert constants.get(f"min_buffer_size_{suffix}") == best // 8
+
+    impl, res = autotune.tune_ring_implementation(comm, nelem=4096)
+    assert impl == "ppermute"  # pallas unavailable on the CPU mesh
+    assert constants.get("ring_implementation") == impl
+
+    # persistence round-trip
+    path = autotune.save_tuning(comm)
+    assert path.exists()
+    constants.set(f"small_broadcast_size_{suffix}", 7)
+    entry = autotune.load_tuning(comm, apply=True)
+    assert entry is not None
+    assert constants.get(f"small_broadcast_size_{suffix}") == cutoff
+
+
+def test_autotune_load_ignores_other_worldsize(tmp_path, monkeypatch):
+    import json
+
+    from torchmpi_tpu.utils import autotune
+
+    cache = tmp_path / "tune.json"
+    cache.write_text(json.dumps({"cpu:999": {"ring_implementation": "pallas"}}))
+    monkeypatch.setenv("TORCHMPI_TPU_TUNING_CACHE", str(cache))
+    assert autotune.load_tuning(mpi.current_communicator()) is None
+
+
+def test_start_applies_persisted_tuning(tmp_path, monkeypatch):
+    """start() loads the tuning cache for the booted (platform, size)."""
+    import json
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.constants import platform_suffix
+
+    comm = mpi.current_communicator()
+    suffix = platform_suffix(comm.devices[0].platform)
+    key = f"{comm.devices[0].platform}:{comm.size}"
+    cache = tmp_path / "tune.json"
+    cache.write_text(
+        json.dumps({key: {f"small_allreduce_size_{suffix}": 12345}})
+    )
+    monkeypatch.setenv("TORCHMPI_TPU_TUNING_CACHE", str(cache))
+    mpi.stop()
+    mpi.start()
+    assert constants.get(f"small_allreduce_size_{suffix}") == 12345
+
+
 def test_vlog_and_timer(capsys):
     from torchmpi_tpu.utils import tracing
 

@@ -91,6 +91,33 @@ def shard_range(n: int, size: int, rank: int) -> Tuple[int, int]:
     return start, start + base + (1 if rank < extra else 0)
 
 
+class _CancelToken:
+    """Atomic cancel/apply handshake between a timed-out requester and the
+    server thread: exactly one of cancel() / begin_apply() wins."""
+
+    __slots__ = ("_lock", "_state")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "pending"
+
+    def cancel(self) -> bool:
+        """True iff the message will NOT be applied."""
+        with self._lock:
+            if self._state == "pending":
+                self._state = "cancelled"
+                return True
+            return False
+
+    def begin_apply(self) -> bool:
+        """True iff the server may apply (not cancelled)."""
+        with self._lock:
+            if self._state == "pending":
+                self._state = "applying"
+                return True
+            return False
+
+
 @dataclass
 class _Message:
     kind: str  # 'update' | 'trigger'
@@ -99,9 +126,10 @@ class _Message:
     payload: Optional[np.ndarray] = None
     done: Optional[threading.Event] = None  # update: server-applied event
     reply: Optional[Future] = None  # trigger: fulfilled with shard copy
-    # set by the transport when the requester timed out: the server must
-    # NOT apply a message whose failure was already reported
-    cancelled: Optional[threading.Event] = None
+    # cancel/apply handshake set by the transport for remote updates
+    cancelled: Optional[_CancelToken] = None
+    # apply failure message, readable after `done` is set
+    error: Optional[str] = None
 
 
 class _Instance:
@@ -238,7 +266,7 @@ class _Instance:
                         break
                     msg = self.mailboxes[r].popleft()
                 worked = True
-                if msg.cancelled is not None and msg.cancelled.is_set():
+                if msg.cancelled is not None and not msg.cancelled.begin_apply():
                     # requester already saw a failure for this message
                     if msg.done:
                         msg.done.set()
@@ -252,12 +280,14 @@ class _Instance:
                         if msg.rule not in UPDATE_RULES:
                             raise KeyError(f"unknown update rule {msg.rule!r}")
                         self.apply_rule(r, msg.rule, msg.payload)
-                    except Exception:
+                    except Exception as e:
                         # Never kill the (single, shared) server thread and
-                        # never strand the sender's completion event.
+                        # never strand the sender's completion event; the
+                        # failure is surfaced through msg.error.
                         import traceback
 
                         traceback.print_exc()
+                        msg.error = f"{type(e).__name__}: {e}"
                     finally:
                         if msg.done:
                             msg.done.set()
@@ -426,17 +456,21 @@ class ParameterServer:
         self._transport = None
         if any(o != my_proc for o in owners):
             # cross-process PS: bootstrap the socket transport and barrier
-            # so every process has registered the instance before any
+            # among the OWNER processes (not job-global: a PS on a sub-
+            # communicator must not require uninvolved processes to join)
+            # so every owner has registered the instance before any
             # traffic (the reference wraps PS init in barriers,
             # parameterserver.cpp:677-745). Instance ids agree because all
-            # processes create parameter servers in the same collective
-            # order — the reference's standing ordering requirement.
+            # owner processes create parameter servers in the same
+            # collective order — the reference's standing ordering
+            # requirement (fingerprint-validated on the wire).
             from . import transport as _t
-            from jax.experimental import multihost_utils
 
             self._transport = _t.ensure_transport()
             self._inst = _server.register(full, comm.size, owners, my_proc)
-            multihost_utils.sync_global_devices("tm-ps-init")
+            self._transport.barrier(
+                set(owners), f"ps-init-{self._inst.id}-{self._inst.fingerprint}"
+            )
         else:
             self._inst = _server.register(full, comm.size, owners, my_proc)
         self.shape = full.shape
@@ -481,31 +515,52 @@ class ParameterServer:
 
         def do_send():
             events = []
+            # remote shards grouped per peer: one fan-out thread per peer
+            # so requests to different processes overlap (the reference's
+            # Isend fan-out, parameterserver.cpp:309-353); requests to
+            # the SAME peer stay ordered on its pooled connection
+            by_proc: Dict[int, List[int]] = {}
             for r in range(inst.size):
                 s, e = inst.ranges[r]
                 if inst.is_local(r):
                     ev = threading.Event()
-                    inst.post(
-                        r,
-                        _Message(
-                            "update",
-                            client=client,
-                            rule=rule,
-                            payload=flat[s:e].copy(),
-                            done=ev,
-                        ),
+                    msg = _Message(
+                        "update",
+                        client=client,
+                        rule=rule,
+                        payload=flat[s:e].copy(),
+                        done=ev,
                     )
-                    events.append(ev)
+                    inst.post(r, msg)
+                    events.append((ev, msg))
                 else:
-                    # remote shard: synchronous socket request, acked after
-                    # the peer APPLIED the rule (clientSend's Ssend
-                    # happens-before, parameterserver.cpp:339-347)
-                    transport.update(
-                        inst.owners[r], inst.id, r, client, rule, flat[s:e],
-                        fp=inst.fingerprint,
-                    )
+                    by_proc.setdefault(inst.owners[r], []).append(r)
+
+            def send_to(proc, ranks, errs):
+                try:
+                    for r in ranks:
+                        s, e = inst.ranges[r]
+                        # acked after the peer APPLIED the rule
+                        # (clientSend's Ssend happens-before,
+                        # parameterserver.cpp:339-347)
+                        transport.update(
+                            proc, inst.id, r, client, rule, flat[s:e],
+                            fp=inst.fingerprint,
+                        )
+                except Exception as e:
+                    errs.append(e)
+
+            errs: List[Exception] = []
+            threads = [
+                threading.Thread(
+                    target=send_to, args=(proc, ranks, errs), daemon=True
+                )
+                for proc, ranks in by_proc.items()
+            ]
+            for t in threads:
+                t.start()
             timeout = constants.get("deadlock_timeout_seconds") or None
-            for ev in events:
+            for ev, msg in events:
                 if not ev.wait(timeout):
                     # the reference's spin-abort failure detector
                     raise RuntimeError(
@@ -513,6 +568,14 @@ class ParameterServer:
                         "(possible deadlock: server thread dead or "
                         "mismatched collective ordering)"
                     )
+                if msg.error is not None:
+                    raise RuntimeError(
+                        f"parameter-server update failed: {msg.error}"
+                    )
+            for t in threads:
+                t.join()
+            if errs:
+                raise errs[0]
 
         return SyncHandle(future=_submit_bounded(do_send))
 
@@ -529,20 +592,36 @@ class ParameterServer:
         def do_receive():
             replies = {}
             out = np.empty((int(np.prod(shape)),), dtype)
+            by_proc: Dict[int, List[int]] = {}
             for r in range(inst.size):
                 if inst.is_local(r):
                     f: Future = Future()
                     inst.post(r, _Message("trigger", client=client, reply=f))
                     replies[r] = f
                 else:
-                    # remote shard: synchronous fetch over the transport
-                    # (clientReceive's trigger + Ssend-back,
-                    # parameterserver.cpp:356-400)
-                    s, e = inst.ranges[r]
-                    out[s:e] = transport.trigger(
-                        inst.owners[r], inst.id, r, client,
-                        fp=inst.fingerprint,
-                    )
+                    by_proc.setdefault(inst.owners[r], []).append(r)
+
+            def fetch_from(proc, ranks, errs):
+                try:
+                    for r in ranks:
+                        # clientReceive's trigger + Ssend-back
+                        # (parameterserver.cpp:356-400)
+                        s, e = inst.ranges[r]
+                        out[s:e] = transport.trigger(
+                            proc, inst.id, r, client, fp=inst.fingerprint
+                        )
+                except Exception as e:
+                    errs.append(e)
+
+            errs: List[Exception] = []
+            threads = [
+                threading.Thread(
+                    target=fetch_from, args=(proc, ranks, errs), daemon=True
+                )
+                for proc, ranks in by_proc.items()
+            ]
+            for t in threads:
+                t.start()
             timeout = constants.get("deadlock_timeout_seconds") or None
             for r, f in replies.items():
                 s, e = inst.ranges[r]
@@ -556,6 +635,10 @@ class ParameterServer:
                         "(possible deadlock: server thread dead or "
                         "mismatched collective ordering)"
                     ) from None
+            for t in threads:
+                t.join()
+            if errs:
+                raise errs[0]
             return out.reshape(shape)
 
         return SyncHandle(future=_submit_bounded(do_receive))
@@ -566,9 +649,10 @@ class ParameterServer:
         unregistering so no peer frees while another's traffic is in
         flight."""
         if self._transport is not None and not self._inst.freed:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("tm-ps-free")
+            self._transport.barrier(
+                set(self._inst.owners),
+                f"ps-free-{self._inst.id}-{self._inst.fingerprint}",
+            )
         _server.unregister(self._inst)
 
     @property

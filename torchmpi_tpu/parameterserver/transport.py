@@ -44,6 +44,7 @@ _KIND_TRIGGER = 2
 _KIND_ACK = 3
 _KIND_SHARD = 4
 _KIND_ERROR = 5
+_KIND_BARRIER = 6
 
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
 #        fp u32, token u32, rule_len u16, dtype_len u16, payload_len u64
@@ -143,12 +144,29 @@ class _Listener:
         # reconnect retry after a lost ACK must not double-apply
         self._applied: Dict[Tuple[int, int, int], int] = {}
         self._applied_lock = threading.Lock()
+        # subset barrier bookkeeping: tag -> set of origin processes seen
+        self._barrier_seen: Dict[str, set] = {}
+        self._barrier_cv = threading.Condition()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="tm-ps-listener", daemon=True
         )
         self._accept_thread.start()
+
+    def barrier_arrived(self, tag: str, origin: int) -> None:
+        with self._barrier_cv:
+            self._barrier_seen.setdefault(tag, set()).add(origin)
+            self._barrier_cv.notify_all()
+
+    def barrier_wait(self, tag: str, expect: set, timeout=None) -> bool:
+        with self._barrier_cv:
+            ok = self._barrier_cv.wait_for(
+                lambda: expect <= self._barrier_seen.get(tag, set()), timeout
+            )
+            if ok:
+                self._barrier_seen.pop(tag, None)
+            return ok
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -173,6 +191,11 @@ class _Listener:
                 kind, inst_id, rank, client, seq, fp, rule, dtype, payload = (
                     _recv_frame(conn)
                 )
+                if kind == _KIND_BARRIER:
+                    # subset barrier: record (tag, origin) and ack receipt
+                    self.barrier_arrived(rule, client)
+                    _send_frame(conn, _KIND_ACK)
+                    continue
                 inst = self._lookup(inst_id)
                 if inst is None:
                     _send_frame(
@@ -205,22 +228,31 @@ class _Listener:
                             continue
                     values = np.frombuffer(payload, np.dtype(dtype))
                     ev = _threading.Event()
-                    cancel = _threading.Event()
-                    inst.post(
-                        rank,
-                        _Message(
-                            "update", client=client, rule=rule,
-                            payload=values.copy(), done=ev, cancelled=cancel,
-                        ),
+                    from .server import _CancelToken
+
+                    token = _CancelToken()
+                    msg = _Message(
+                        "update", client=client, rule=rule,
+                        payload=values.copy(), done=ev, cancelled=token,
                     )
+                    inst.post(rank, msg)
                     if not ev.wait(timeout):
-                        # withdraw the queued message so the shard does NOT
-                        # mutate after we reported failure (serve_once
-                        # skips cancelled messages)
-                        cancel.set()
+                        # atomically withdraw: if the server has not
+                        # STARTED applying, it never will (serve_once
+                        # CAS-checks the token) and the failure report is
+                        # exact; if it is mid-apply, wait for it to finish
+                        # and report the true outcome instead of lying.
+                        if token.cancel():
+                            _send_frame(
+                                conn, _KIND_ERROR,
+                                rule="remote update apply timed out",
+                            )
+                            continue
+                        ev.wait()  # apply in progress: it will complete
+                    if msg.error is not None:
                         _send_frame(
                             conn, _KIND_ERROR,
-                            rule="remote update apply timed out",
+                            rule=f"update apply failed: {msg.error}",
                         )
                         continue
                     with self._applied_lock:
@@ -289,14 +321,17 @@ class _PeerPool:
         inst: int,
         rank: int,
         client: int,
-        seq: int = 0,
+        seq_counter: Optional[List[int]] = None,
         fp: int = 0,
         rule: str = "",
         payload_arr: Optional[np.ndarray] = None,
     ):
         """Synchronous request/response on the pooled connection. Safe to
         retry on connection loss: UPDATEs carry ``seq`` so the peer dedups
-        a re-send whose original ACK was lost."""
+        a re-send whose original ACK was lost. ``seq_counter`` is a 1-cell
+        list incremented UNDER the per-peer lock — assignment order ==
+        wire order, so concurrent sends cannot be misdeduped as retries."""
+        seq = 0
 
         def _do(sock):
             if payload_arr is not None:
@@ -309,6 +344,9 @@ class _PeerPool:
             return _recv_frame(sock)
 
         with self._locks[proc]:
+            if seq_counter is not None:
+                seq_counter[0] += 1
+                seq = seq_counter[0]
             sock = self._conns.get(proc)
             if sock is None:
                 sock = self._conns[proc] = self._connect(proc)
@@ -345,8 +383,7 @@ class Transport:
         import jax
 
         self.process_index = jax.process_index()
-        self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._seq_counter = [0]  # incremented under the peer lock
         self.listener = _Listener(lookup_instance)
         host = os.environ.get("TORCHMPI_TPU_PS_HOST") or socket.gethostname()
         addresses = self._exchange_addresses(host, self.listener.port)
@@ -373,11 +410,9 @@ class Transport:
         self, proc: int, inst: int, rank: int, client: int, rule: str,
         payload: np.ndarray, fp: int = 0,
     ) -> None:
-        with self._seq_lock:
-            self._seq += 1
-            seq = self._seq
         self.pool.request(
-            proc, _KIND_UPDATE, inst, rank, client, seq=seq, fp=fp,
+            proc, _KIND_UPDATE, inst, rank, client,
+            seq_counter=self._seq_counter, fp=fp,
             rule=rule, payload_arr=payload,
         )
 
@@ -387,6 +422,24 @@ class Transport:
         return self.pool.request(
             proc, _KIND_TRIGGER, inst, rank, client, fp=fp
         )
+
+    def barrier(self, procs, tag: str, timeout=None) -> None:
+        """Barrier among the process subset ``procs`` (all must call with
+        the same tag): send a BARRIER frame to every peer, then wait until
+        one arrived from each. Replaces job-global sync for parameter
+        servers living on sub-communicators."""
+        procs = set(int(p) for p in procs)
+        me = self.process_index
+        for p in sorted(procs - {me}):
+            self.pool.request(
+                p, _KIND_BARRIER, 0, 0, me, rule=tag
+            )
+        expect = procs - {me}
+        if expect and not self.listener.barrier_wait(tag, expect, timeout):
+            raise RuntimeError(
+                f"parameter-server barrier {tag!r} timed out waiting for "
+                f"{sorted(expect)}"
+            )
 
     def close(self):
         self.pool.close()

@@ -41,6 +41,7 @@ def start(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    load_tuned_constants: bool = True,
 ) -> None:
     """Initialise the runtime (``MPI.start``, ``torchmpi/init.lua:31-100``).
 
@@ -88,13 +89,10 @@ def start(
             if process_id is not None:
                 kw["process_id"] = process_id
             jax.distributed.initialize(**kw)
+    prev_cartesian = constants.get("use_cartesian_communicator")
     with _lock:
         if _started:  # re-check: distributed init released the lock
             raise RuntimeError("torchmpi_tpu.start() called twice")
-        if with_cartesian_communicator is not None:
-            constants.set(
-                "use_cartesian_communicator", bool(with_cartesian_communicator)
-            )
         if devices is None:
             if with_tpu is None:
                 devices = jax.devices()
@@ -106,11 +104,27 @@ def start(
                     )
             else:
                 devices = jax.devices("cpu")
+        # set AFTER every earlier failure point so a failed start() never
+        # leaks the cartesian mode into a corrected retry; must still be
+        # set before the Communicator is constructed (init.lua:61-65)
+        if with_cartesian_communicator is not None:
+            constants.set(
+                "use_cartesian_communicator", bool(with_cartesian_communicator)
+            )
         root = Communicator(list(devices), name="global")
         _stack = CommunicatorStack(root)
         _started = True
 
     try:
+        if jax.process_count() > 1:
+            # Bootstrap the cross-process PS transport HERE, where every
+            # process participates (its address exchange is job-global);
+            # parameter servers on sub-communicators then only barrier
+            # among their own owner processes.
+            from .parameterserver.transport import ensure_transport
+
+            ensure_transport()
+
         if custom_communicator_init is not None:
             custom_communicator_init()
 
@@ -119,12 +133,29 @@ def start(
 
         if collective_communicator is not None:
             _stack.set_span(*collective_communicator)
+
+        if load_tuned_constants and not constants.constants_frozen():
+            # apply persisted autotuner results for this (platform, world
+            # size) — the measured routing constants survive restarts
+            # (c_api.h:93-95's autotuner, made durable)
+            try:
+                from .utils.autotune import load_tuning
+
+                load_tuning(comm=_stack.current, apply=True)
+            except Exception:
+                pass  # cache is best-effort; defaults are always safe
     except BaseException:
         # Roll back so a corrected retry of start() works instead of
-        # hitting 'called twice' on a half-initialized runtime.
+        # hitting 'called twice' on a half-initialized runtime — including
+        # the cartesian constant set earlier in this call.
         with _lock:
             _stack = None
             _started = False
+            if not constants.constants_frozen():
+                try:
+                    constants.set("use_cartesian_communicator", prev_cartesian)
+                except Exception:
+                    pass
         raise
 
 
