@@ -1,0 +1,33 @@
+"""End-to-end example-script runs (the reference's test strategy: the
+examples ARE the convergence tests, run by scripts/test_cpu.sh)."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+@pytest.mark.slow
+def test_resnet50_dp_e2e_example():
+    """BASELINE.json config #4 at test scale: the ResNet-50 data-parallel
+    example runs end-to-end on the virtual 8-mesh — synthetic ImageNet
+    pipeline, engine with batch-stats sync, device-resident epochs, eval."""
+    from examples.resnet_allreduce import main
+
+    state, acc = main(
+        [
+            "--model", "resnet50",
+            "--classes", "8",
+            "--image-size", "32",
+            "--train", "64",
+            "--test", "32",
+            "--per-rank-batch", "2",
+            "--epochs", "1",
+        ]
+    )
+    assert np.isfinite(state["losses"][0])
+    assert state["samples"] == 64
+    assert 0.0 <= acc <= 1.0

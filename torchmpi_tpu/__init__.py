@@ -21,6 +21,7 @@ Public API shape follows the reference (``torchmpi/init.lua``):
 from . import constants
 from .collectives import (
     allgather_tensor,
+    allgatherv_tensor,
     allreduce_scalar,
     allreduce_tensor,
     async_,
@@ -79,6 +80,7 @@ __all__ = [
     "reduce_tensor",
     "allreduce_tensor",
     "allgather_tensor",
+    "allgatherv_tensor",
     "sendreceive_tensor",
     "broadcast_scalar",
     "allreduce_scalar",

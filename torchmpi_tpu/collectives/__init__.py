@@ -83,6 +83,12 @@ def sendreceive_tensor(x, src, dst, comm=None):
     return _dispatch("sendreceive", x, comm, "sync", src=src, dst=dst)
 
 
+def allgatherv_tensor(blocks, comm=None, backend: str = "xla"):
+    """Variable-size allgather over ragged last-dim per-rank blocks
+    (reference ``Allgatherv``, ``lib/collectives.cpp:245-290``)."""
+    return eager.run_allgatherv(blocks, _current_comm(comm), backend=backend)
+
+
 class _BackendNS:
     """``mpi.p2p.*`` / ``mpi.nccl.*`` style per-backend namespaces."""
 
@@ -162,6 +168,7 @@ __all__ = [
     "reduce_tensor",
     "allreduce_tensor",
     "allgather_tensor",
+    "allgatherv_tensor",
     "sendreceive_tensor",
     "broadcast_scalar",
     "allreduce_scalar",

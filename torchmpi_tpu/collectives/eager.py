@@ -351,6 +351,90 @@ def run(
     return fn(x)
 
 
+def run_allgatherv(blocks, comm: Communicator, backend: str = "xla"):
+    """Variable-size allgather: per-rank blocks with RAGGED last dims are
+    concatenated along the last dimension on every rank — the reference's
+    size-exchange + ``MPI_Allgatherv`` + output realloc
+    (``lib/collectives.cpp:245-290``).
+
+    ``blocks`` is a sequence of ``comm.size`` arrays that agree on every
+    dimension except the last. XLA needs static shapes, so the reference's
+    runtime size exchange happens at trace time (the sizes ARE the trace
+    constants); on the wire the blocks travel padded to the max size and
+    the valid prefixes are re-assembled in-graph.
+
+    Returns a rank-stacked ``[p, ..., sum(sizes)]`` array (every rank's
+    block holds the full concatenation, like the uniform allgather).
+    """
+    if len(blocks) != comm.size:
+        raise CollectiveArgumentError(
+            f"allgatherv expects {comm.size} blocks (one per rank), got "
+            f"{len(blocks)}"
+        )
+    blocks = [jnp.asarray(b) for b in blocks]
+    base = blocks[0].shape[:-1]
+    dtype = jnp.result_type(blocks[0])
+    for i, b in enumerate(blocks):
+        if b.ndim == 0 or b.shape[:-1] != base:
+            raise CollectiveArgumentError(
+                f"block {i} shape {tuple(b.shape)} does not match leading "
+                f"dims {base} (only the LAST dim may vary, like the "
+                "reference's last-dim realloc)"
+            )
+        if jnp.result_type(b) != dtype:
+            raise CollectiveArgumentError(
+                f"block {i} dtype {b.dtype} != {dtype}"
+            )
+    sizes = tuple(int(b.shape[-1]) for b in blocks)
+    nmax = max(sizes) if sizes else 0
+    p = comm.size
+
+    if backend == "ring":
+        gather = lambda b: prim.ring_allgather(b, _AXIS, dim=0)  # noqa: E731
+    elif backend == "xla":
+        gather = lambda b: prim.allgather(b, _AXIS, dim=0)  # noqa: E731
+    else:
+        raise CollectiveArgumentError(
+            f"allgatherv backend must be 'xla' or 'ring', got {backend!r}"
+        )
+
+    def build_kernel():
+        def kernel(b):
+            # b: [1, ..., nmax] per-rank padded block
+            g = gather(b)  # [p, ..., nmax]
+            parts = [
+                jax.lax.slice_in_dim(
+                    jax.lax.index_in_dim(g, r, 0, keepdims=False),
+                    0, sizes[r], axis=len(base),  # the last dim
+                )
+                for r in range(p)
+            ]
+            return jnp.concatenate(parts, axis=-1)[None]
+
+        return kernel
+
+    stacked_shape = (p,) + base + (nmax,)
+    fn = _compile(
+        comm, "allgatherv", backend, (stacked_shape, dtype), (sizes,),
+        build_kernel,
+    )
+
+    padded = jnp.stack(
+        [
+            jnp.concatenate(
+                [b, jnp.zeros(base + (nmax - s,), dtype)], axis=-1
+            )
+            if s < nmax
+            else b
+            for b, s in zip(blocks, sizes)
+        ]
+    )
+    sharding = _rank_sharding(comm, padded.ndim)
+    if getattr(padded, "sharding", None) != sharding:
+        padded = jax.device_put(padded, sharding)
+    return fn(padded)
+
+
 def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
     """Asynchronous variant: returns a handle immediately; the arrays are
     in flight on device (XLA async dispatch replaces the reference's

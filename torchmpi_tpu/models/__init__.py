@@ -7,7 +7,13 @@ from .mnist import (
     init_params,
     make_loss_fn,
 )
-from .resnet import ResNet, ResNet18, ResNet50
+from .resnet import (
+    ResNet,
+    ResNet18,
+    ResNet50,
+    init_resnet,
+    make_stateful_loss_fn,
+)
 from .transformer import LongContextTransformer, RingAttentionBlock
 
 __all__ = [
@@ -22,5 +28,7 @@ __all__ = [
     "cross_entropy_loss",
     "accuracy",
     "make_loss_fn",
+    "make_stateful_loss_fn",
+    "init_resnet",
     "init_params",
 ]

@@ -132,3 +132,33 @@ def ResNet18(**kw) -> ResNet:
 
 def ResNet50(**kw) -> ResNet:
     return ResNet(stage_sizes=[3, 4, 6, 3], block=BottleneckBlock, **kw)
+
+
+def init_resnet(model: ResNet, image_size: int, seed: int = 0):
+    """Initialize (params, batch_stats) for NHWC inputs."""
+    import jax
+
+    x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(seed), x0, train=True)
+    return variables["params"], variables["batch_stats"]
+
+
+def make_stateful_loss_fn(model: ResNet) -> Callable:
+    """``loss_fn(params, batch_stats, batch) -> (loss, new_stats)`` for the
+    engine's ``model_state`` path (cross-replica batch-norm statistics are
+    pmean-synchronized by the engine every step)."""
+    import jax
+
+    def loss_fn(params, state, batch):
+        x, y = batch
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": state},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, updated["batch_stats"]
+
+    return loss_fn

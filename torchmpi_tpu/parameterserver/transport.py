@@ -298,6 +298,11 @@ class _PeerPool:
         self._locks: Dict[int, threading.Lock] = {
             p: threading.Lock() for p in addresses
         }
+        # per-PEER update sequence counters, incremented under that peer's
+        # lock: assignment order == wire order per peer (and thus per dedup
+        # key, since a key's shard lives on exactly one peer), and no
+        # cross-peer sharing that a racing increment could roll back
+        self._seqs: Dict[int, int] = {p: 0 for p in addresses}
 
     def _connect(self, proc: int) -> socket.socket:
         host, port = self.addresses[proc]
@@ -321,16 +326,16 @@ class _PeerPool:
         inst: int,
         rank: int,
         client: int,
-        seq_counter: Optional[List[int]] = None,
+        use_seq: bool = False,
         fp: int = 0,
         rule: str = "",
         payload_arr: Optional[np.ndarray] = None,
     ):
         """Synchronous request/response on the pooled connection. Safe to
-        retry on connection loss: UPDATEs carry ``seq`` so the peer dedups
-        a re-send whose original ACK was lost. ``seq_counter`` is a 1-cell
-        list incremented UNDER the per-peer lock — assignment order ==
-        wire order, so concurrent sends cannot be misdeduped as retries."""
+        retry on connection loss: UPDATEs carry ``seq`` (``use_seq``),
+        drawn from the per-peer counter UNDER the per-peer lock —
+        assignment order == wire order, so concurrent sends cannot be
+        misdeduped as retries."""
         seq = 0
 
         def _do(sock):
@@ -344,9 +349,9 @@ class _PeerPool:
             return _recv_frame(sock)
 
         with self._locks[proc]:
-            if seq_counter is not None:
-                seq_counter[0] += 1
-                seq = seq_counter[0]
+            if use_seq:
+                self._seqs[proc] += 1
+                seq = self._seqs[proc]
             sock = self._conns.get(proc)
             if sock is None:
                 sock = self._conns[proc] = self._connect(proc)
@@ -383,7 +388,6 @@ class Transport:
         import jax
 
         self.process_index = jax.process_index()
-        self._seq_counter = [0]  # incremented under the peer lock
         self.listener = _Listener(lookup_instance)
         host = os.environ.get("TORCHMPI_TPU_PS_HOST") or socket.gethostname()
         addresses = self._exchange_addresses(host, self.listener.port)
@@ -412,8 +416,7 @@ class Transport:
     ) -> None:
         self.pool.request(
             proc, _KIND_UPDATE, inst, rank, client,
-            seq_counter=self._seq_counter, fp=fp,
-            rule=rule, payload_arr=payload,
+            use_seq=True, fp=fp, rule=rule, payload_arr=payload,
         )
 
     def trigger(

@@ -1,9 +1,15 @@
-from .data import DistributedIterator, load_mnist_idx, synthetic_mnist
+from .data import (
+    DistributedIterator,
+    load_mnist_idx,
+    synthetic_imagenet,
+    synthetic_mnist,
+)
 from .tracing import ProfilerWindow, Timer, set_debug_level, vlog
 
 __all__ = [
     "DistributedIterator",
     "synthetic_mnist",
+    "synthetic_imagenet",
     "load_mnist_idx",
     "ProfilerWindow",
     "Timer",

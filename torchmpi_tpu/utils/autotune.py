@@ -161,9 +161,12 @@ def tune_tree_pipeline_switch(
 ) -> Tuple[int, List]:
     """Find the message size (BYTES) where the pipelined ring broadcast
     overtakes the binomial tree; set ``broadcast_size_tree_based``.
-    Returns ``(switch_bytes, measurements)``."""
+    Returns ``(switch_bytes, measurements)``.
+
+    Requires unfrozen constants even with ``apply=False``: the measurement
+    itself pins each variant by temporarily moving the switch constant."""
     comm = _comm(comm)
-    _check_unfrozen(apply)
+    _check_unfrozen(True)
     suffix = _suffix(comm)
     results = []
     crossover_bytes = None
@@ -189,9 +192,12 @@ def tune_chunk_size(
 ) -> Tuple[int, List]:
     """Pick the max ring-message size (BYTES) minimizing large-allreduce
     latency; sets ``max_buffer_size`` (and ``min_buffer_size`` = max/8).
-    Returns ``(best_max_bytes, measurements)``."""
+    Returns ``(best_max_bytes, measurements)``.
+
+    Requires unfrozen constants even with ``apply=False``: each candidate
+    is measured by temporarily setting the buffer-size constants."""
     comm = _comm(comm)
-    _check_unfrozen(apply)
+    _check_unfrozen(True)
     suffix = _suffix(comm)
     max_name = f"max_buffer_size_{suffix}"
     min_name = f"min_buffer_size_{suffix}"

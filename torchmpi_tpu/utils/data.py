@@ -65,6 +65,43 @@ def synthetic_mnist(
     return train, test
 
 
+def synthetic_imagenet(
+    num_train: int = 1024,
+    num_test: int = 256,
+    num_classes: int = 1000,
+    image_size: int = 224,
+    seed: int = 4321,
+):
+    """Deterministic ImageNet-shaped dataset (NHWC float32 in [0, 1]):
+    class prototypes are smooth low-frequency color fields; samples add
+    gaussian noise. Same role as ``synthetic_mnist`` for the ResNet
+    data-parallel config (BASELINE.json config #4) in a zero-egress
+    environment."""
+    rng = np.random.RandomState(seed)
+    h = w = image_size
+    # low-res prototypes upsampled: cheap and image-like
+    lo = 8
+    protos_lo = rng.randn(num_classes, lo, lo, 3).astype(np.float32)
+    reps = -(-h // lo)
+
+    def upsample(p):
+        big = np.repeat(np.repeat(p, reps, axis=0), reps, axis=1)
+        return big[:h, :w]
+
+    def make(n, rs):
+        labels = rs.randint(0, num_classes, size=n).astype(np.int32)
+        x = np.empty((n, h, w, 3), np.float32)
+        for i in range(n):
+            base = upsample(protos_lo[labels[i]])
+            x[i] = base + 0.5 * rs.randn(h, w, 3).astype(np.float32)
+        x = np.clip(0.5 + 0.25 * x, 0.0, 1.0)
+        return x, labels
+
+    train = make(num_train, np.random.RandomState(seed + 1))
+    test = make(num_test, np.random.RandomState(seed + 2))
+    return train, test
+
+
 def load_mnist_idx(directory: str):
     """Load real MNIST from IDX files if present (no download)."""
     import gzip
