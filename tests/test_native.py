@@ -1,6 +1,7 @@
 """Native C++ runtime tests (csrc/tpumpi.cpp via ctypes)."""
 
 import threading
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -169,6 +170,113 @@ def test_native_barrier_threads():
     rounds = [r for r, _ in hits]
     assert rounds == sorted(rounds)
     b.destroy()
+
+
+def test_native_barrier_cross_process(tmp_path):
+    """The barrier's ONLY reason to exist is cross-process sync: two real
+    subprocesses increment a shared mmap counter before each barrier and
+    assert everyone's increment is visible right after it (50 rounds).
+    A broken barrier lets the fast process read a stale count."""
+    import subprocess
+    import sys
+    import textwrap
+    import uuid
+
+    name = f"xp{uuid.uuid4().hex[:8]}"
+    counter_file = tmp_path / "counter.bin"
+    counter_file.write_bytes(b"\0" * 8)
+    worker_src = textwrap.dedent(
+        """
+        import mmap, struct, sys, time
+        sys.path.insert(0, {repo!r})
+        from torchmpi_tpu.runtime import native
+
+        who, name, path = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+        # the owner creates; the joiner polls until the names exist
+        if who == 0:
+            b = native.NativeBarrier(name, 2, owner=True)
+            print("READY", flush=True)
+        else:
+            deadline = time.time() + 20
+            while True:
+                try:
+                    b = native.NativeBarrier(name, 2, owner=False)
+                    break
+                except RuntimeError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.01)
+        with open(path, "r+b") as f:
+            mem = mmap.mmap(f.fileno(), 8)
+            for i in range(50):
+                # increment my slot, then barrier, then check the OTHER's
+                off = who * 4
+                mine = struct.unpack_from("<i", mem, off)[0]
+                struct.pack_into("<i", mem, off, mine + 1)
+                mem.flush()
+                b.wait()
+                theirs = struct.unpack_from("<i", mem, 4 - off)[0]
+                assert theirs >= i + 1, (i, theirs)
+                b.wait()  # depart phase: nobody races into round i+1
+        b.destroy()
+        print(f"worker {{who}} OK", flush=True)
+        """
+    ).format(repo=str(Path(__file__).resolve().parent.parent))
+    script = tmp_path / "bworker.py"
+    script.write_text(worker_src)
+
+    p0 = subprocess.Popen(
+        [sys.executable, str(script), "0", name, str(counter_file)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # wait (bounded) for the owner to create the names before the joiner
+    import select
+
+    ready, _, _ = select.select([p0.stdout], [], [], 60)
+    if not ready:
+        p0.kill()
+        pytest.fail("barrier owner never became READY (create hang)")
+    assert "READY" in p0.stdout.readline()
+    p1 = subprocess.Popen(
+        [sys.executable, str(script), "1", name, str(counter_file)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    outs = []
+    for i, p in enumerate((p0, p1)):
+        try:
+            out, _ = p.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            p0.kill()
+            p1.kill()
+            pytest.fail("cross-process barrier workers timed out (deadlock)")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip((p0, p1), outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+        assert f"worker {i} OK" in out
+
+
+def test_native_barrier_kernel_object_hygiene():
+    """Create/destroy must leave no named objects behind in /dev/shm, and a
+    joiner racing ahead of the owner must FAIL (no O_CREAT) instead of
+    creating orphans the owner's unlink would split-brain."""
+    import os
+    import uuid
+
+    lib = _lib()
+    name = f"hyg{uuid.uuid4().hex[:8]}"
+    # joiner-before-owner: must fail, and must create nothing
+    assert lib.tpumpi_barrier_create(name.encode(), 2, 0) == -1
+    leftovers = [f for f in os.listdir("/dev/shm") if name in f]
+    assert not leftovers, leftovers
+    # owner create + destroy: all names removed
+    b = native.NativeBarrier(name, 1, owner=True)
+    assert [f for f in os.listdir("/dev/shm") if name in f]
+    b.wait()  # size-1 barrier returns immediately
+    b.destroy()
+    leftovers = [f for f in os.listdir("/dev/shm") if name in f]
+    assert not leftovers, leftovers
+    # invalid name fails cleanly and a fresh create still works
+    assert lib.tpumpi_barrier_create(b"bad/name", 2, 1) == -1
 
 
 def test_pool_create_destroy():

@@ -34,8 +34,10 @@
 #include <unordered_map>
 #include <vector>
 
+#include <cerrno>
 #include <fcntl.h>
 #include <semaphore.h>
+#include <sys/mman.h>
 #include <unistd.h>
 
 #define TPUMPI_API extern "C" __attribute__((visibility("default")))
@@ -375,55 +377,106 @@ TPUMPI_API int64_t tpumpi_ps_count() {
 // ---------------------------------------------------------------------------
 // POSIX named-semaphore local barrier (≅ lib/barrier.cpp + resources.cpp:
 // 486-539, which the reference left disabled; functional here).
-// Classic two-phase (arrive + depart) so the barrier is reusable.
+// Classic two-phase (arrive + depart) so the barrier is reusable. The
+// arrival count lives in a POSIX shared-memory int (mmap'd), mutated only
+// under mutex_sem — a real cross-process counter, not the fragile
+// sem_getvalue trick.
 // ---------------------------------------------------------------------------
 namespace {
 
 struct Barrier {
   std::string name;
-  sem_t* mutex_sem;
-  sem_t* turnstile1;
-  sem_t* turnstile2;
-  int* count;  // in shared memory? single-process fallback: heap
-  int size;
-  // For simplicity the count lives in a semaphore-emulated counter:
-  // we use sem getvalue on a counting semaphore.
+  sem_t* mutex_sem = SEM_FAILED;
+  sem_t* turnstile1 = SEM_FAILED;
+  sem_t* turnstile2 = SEM_FAILED;
+  int* count = nullptr;  // shm-mapped arrival counter
+  int shm_fd = -1;
+  int size = 0;
+  bool owner = false;
 };
 
 std::mutex g_barrier_mutex;
 std::unordered_map<int64_t, std::unique_ptr<Barrier>> g_barriers;
 int64_t g_next_barrier = 0;
 
+// sem_wait restarted on signal interruption: an EINTR falling through
+// would mutate the shm counter without holding the mutex (lost update ->
+// permanent barrier hang for every process).
+void sem_wait_retry(sem_t* s) {
+  while (sem_wait(s) == -1 && errno == EINTR) {
+  }
+}
+
+void barrier_release(Barrier* b, bool unlink_names) {
+  if (b->mutex_sem != SEM_FAILED) sem_close(b->mutex_sem);
+  if (b->turnstile1 != SEM_FAILED) sem_close(b->turnstile1);
+  if (b->turnstile2 != SEM_FAILED) sem_close(b->turnstile2);
+  if (b->count != nullptr) munmap(b->count, sizeof(int));
+  if (b->shm_fd >= 0) close(b->shm_fd);
+  if (unlink_names) {
+    for (const char* suffix : {"_m", "_t1", "_t2"}) {
+      sem_unlink((std::string("/tpumpi_") + b->name + suffix).c_str());
+    }
+    shm_unlink((std::string("/tpumpi_") + b->name + "_c").c_str());
+  }
+}
+
 }  // namespace
 
-// `owner` != 0: unlink any stale semaphores from a crashed prior run before
-// creating (the creator process passes owner=1; joiners pass owner=0 and
-// must be started after the owner).
+// `owner` != 0: unlink any stale names from a crashed prior run before
+// creating and initialize the shared counter (the creator process passes
+// owner=1; joiners pass owner=0 and must be started after the owner).
 TPUMPI_API int64_t tpumpi_barrier_create(const char* name, int size,
                                          int owner) {
   auto b = std::make_unique<Barrier>();
   b->name = name;
   b->size = size;
+  b->owner = owner != 0;
   std::string n1 = std::string("/tpumpi_") + name + "_m";
   std::string n2 = std::string("/tpumpi_") + name + "_t1";
   std::string n3 = std::string("/tpumpi_") + name + "_t2";
+  std::string nc = std::string("/tpumpi_") + name + "_c";
   if (owner) {
-    for (const char* suffix : {"_m", "_t1", "_t2", "_c"}) {
+    for (const char* suffix : {"_m", "_t1", "_t2"}) {
       sem_unlink((std::string("/tpumpi_") + name + suffix).c_str());
     }
+    shm_unlink(nc.c_str());
   }
-  b->mutex_sem = sem_open(n1.c_str(), O_CREAT, 0600, 1);
-  b->turnstile1 = sem_open(n2.c_str(), O_CREAT, 0600, 0);
-  b->turnstile2 = sem_open(n3.c_str(), O_CREAT, 0600, 0);
-  if (b->mutex_sem == SEM_FAILED || b->turnstile1 == SEM_FAILED ||
-      b->turnstile2 == SEM_FAILED) {
+  // Joiners attach WITHOUT O_CREAT: a joiner racing ahead of the owner
+  // must fail (and retry) rather than create its own objects that the
+  // owner's unlink+recreate would orphan (split-brain: both sides wait
+  // on different kernel objects forever). Every failure path releases
+  // whatever was opened so far (and, for the owner, unlinks the names so
+  // a retry starts clean).
+  int sflags = owner ? O_CREAT : 0;
+  b->mutex_sem = sem_open(n1.c_str(), sflags, 0600, 1);
+  if (b->mutex_sem == SEM_FAILED) {
+    barrier_release(b.get(), b->owner);
     return -1;
   }
-  // count semaphore: arrivals tracked via an extra counting semaphore
-  std::string n4 = std::string("/tpumpi_") + name + "_c";
-  sem_t* counter = sem_open(n4.c_str(), O_CREAT, 0600, 0);
-  if (counter == SEM_FAILED) return -1;
-  b->count = reinterpret_cast<int*>(counter);  // stored as sem handle
+  b->turnstile1 = sem_open(n2.c_str(), sflags, 0600, 0);
+  if (b->turnstile1 == SEM_FAILED) {
+    barrier_release(b.get(), b->owner);
+    return -1;
+  }
+  b->turnstile2 = sem_open(n3.c_str(), sflags, 0600, 0);
+  if (b->turnstile2 == SEM_FAILED) {
+    barrier_release(b.get(), b->owner);
+    return -1;
+  }
+  b->shm_fd = shm_open(nc.c_str(), (owner ? O_CREAT : 0) | O_RDWR, 0600);
+  if (b->shm_fd < 0 || ftruncate(b->shm_fd, sizeof(int)) != 0) {
+    barrier_release(b.get(), b->owner);
+    return -1;
+  }
+  void* mem = mmap(nullptr, sizeof(int), PROT_READ | PROT_WRITE, MAP_SHARED,
+                   b->shm_fd, 0);
+  if (mem == MAP_FAILED) {
+    barrier_release(b.get(), b->owner);
+    return -1;
+  }
+  b->count = static_cast<int*>(mem);
+  if (owner) *b->count = 0;
   std::lock_guard<std::mutex> lock(g_barrier_mutex);
   int64_t id = g_next_barrier++;
   g_barriers[id] = std::move(b);
@@ -438,26 +491,21 @@ TPUMPI_API int tpumpi_barrier_wait(int64_t id) {
     if (it == g_barriers.end()) return -1;
     b = it->second.get();
   }
-  sem_t* counter = reinterpret_cast<sem_t*>(b->count);
-  // phase 1
-  sem_wait(b->mutex_sem);
-  sem_post(counter);
-  int val = 0;
-  sem_getvalue(counter, &val);
-  if (val == b->size) {
+  // phase 1: everyone arrives; the last arrival opens turnstile1
+  sem_wait_retry(b->mutex_sem);
+  if (++*b->count == b->size) {
     for (int i = 0; i < b->size; ++i) sem_post(b->turnstile1);
   }
   sem_post(b->mutex_sem);
-  sem_wait(b->turnstile1);
-  // phase 2 (reset)
-  sem_wait(b->mutex_sem);
-  sem_trywait(counter);
-  sem_getvalue(counter, &val);
-  if (val == 0) {
+  sem_wait_retry(b->turnstile1);
+  // phase 2: everyone departs; the last departure opens turnstile2,
+  // resetting the barrier for reuse
+  sem_wait_retry(b->mutex_sem);
+  if (--*b->count == 0) {
     for (int i = 0; i < b->size; ++i) sem_post(b->turnstile2);
   }
   sem_post(b->mutex_sem);
-  sem_wait(b->turnstile2);
+  sem_wait_retry(b->turnstile2);
   return 0;
 }
 
@@ -465,14 +513,9 @@ TPUMPI_API void tpumpi_barrier_destroy(int64_t id) {
   std::lock_guard<std::mutex> lock(g_barrier_mutex);
   auto it = g_barriers.find(id);
   if (it == g_barriers.end()) return;
-  Barrier* b = it->second.get();
-  sem_close(b->mutex_sem);
-  sem_close(b->turnstile1);
-  sem_close(b->turnstile2);
-  sem_close(reinterpret_cast<sem_t*>(b->count));
-  for (const char* suffix : {"_m", "_t1", "_t2", "_c"}) {
-    sem_unlink((std::string("/tpumpi_") + b->name + suffix).c_str());
-  }
+  // only the owner unlinks the names: a joiner destroying its handle must
+  // not invalidate the barrier for surviving processes
+  barrier_release(it->second.get(), it->second->owner);
   g_barriers.erase(it);
 }
 

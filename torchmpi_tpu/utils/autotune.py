@@ -55,9 +55,15 @@ def _comm(comm: Optional[Communicator]) -> Communicator:
     return runtime_state.current_communicator()
 
 
-def _check_unfrozen(apply: bool) -> None:
-    if apply and constants.constants_frozen():
+def _check_unfrozen(apply: bool, measure_mutates: bool = False) -> None:
+    if constants.constants_frozen() and (apply or measure_mutates):
         # fail fast: the expensive sweep would end in FrozenConstantsError
+        if measure_mutates:
+            raise constants.FrozenConstantsError(
+                "constants are frozen; this tuner must temporarily set "
+                "constants to pin each measured configuration, so it cannot "
+                "run at all after freeze_constants()"
+            )
         raise constants.FrozenConstantsError(
             "constants are frozen; call with apply=False to only measure"
         )
@@ -166,7 +172,7 @@ def tune_tree_pipeline_switch(
     Requires unfrozen constants even with ``apply=False``: the measurement
     itself pins each variant by temporarily moving the switch constant."""
     comm = _comm(comm)
-    _check_unfrozen(True)
+    _check_unfrozen(apply, measure_mutates=True)
     suffix = _suffix(comm)
     results = []
     crossover_bytes = None
@@ -197,7 +203,7 @@ def tune_chunk_size(
     Requires unfrozen constants even with ``apply=False``: each candidate
     is measured by temporarily setting the buffer-size constants."""
     comm = _comm(comm)
-    _check_unfrozen(True)
+    _check_unfrozen(apply, measure_mutates=True)
     suffix = _suffix(comm)
     max_name = f"max_buffer_size_{suffix}"
     min_name = f"min_buffer_size_{suffix}"
