@@ -10,6 +10,20 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def test_blocksequential_2host_example():
+    """BASELINE.json config #5 at test scale: block-partitioned async
+    gradient allreduce over a 2-host hierarchical communicator converges
+    and actually routes through the hierarchical composition."""
+    from examples.blocksequential_2host import main
+
+    losses, acc, hier_used = main(
+        ["--train", "512", "--epochs", "3", "--batch-per-rank", "4"]
+    )
+    assert hier_used, "hierarchical intra x inter path was not exercised"
+    assert losses[-1] < losses[0]
+    assert acc > 0.6
+
+
 @pytest.mark.slow
 def test_resnet50_dp_e2e_example():
     """BASELINE.json config #4 at test scale: the ResNet-50 data-parallel

@@ -393,32 +393,39 @@ struct Barrier {
   int shm_fd = -1;
   int size = 0;
   bool owner = false;
+
+  // release happens in the destructor: waiters hold a shared_ptr, so a
+  // concurrent destroy() cannot munmap/close under a blocked wait — the
+  // LAST holder (which may be a waiter) tears down.
+  ~Barrier() {
+    if (mutex_sem != SEM_FAILED) sem_close(mutex_sem);
+    if (turnstile1 != SEM_FAILED) sem_close(turnstile1);
+    if (turnstile2 != SEM_FAILED) sem_close(turnstile2);
+    if (count != nullptr) munmap(count, sizeof(int));
+    if (shm_fd >= 0) close(shm_fd);
+    if (owner) {
+      for (const char* suffix : {"_m", "_t1", "_t2"}) {
+        sem_unlink((std::string("/tpumpi_") + name + suffix).c_str());
+      }
+      shm_unlink((std::string("/tpumpi_") + name + "_c").c_str());
+    }
+  }
 };
 
 std::mutex g_barrier_mutex;
-std::unordered_map<int64_t, std::unique_ptr<Barrier>> g_barriers;
+std::unordered_map<int64_t, std::shared_ptr<Barrier>> g_barriers;
 int64_t g_next_barrier = 0;
 
 // sem_wait restarted on signal interruption: an EINTR falling through
 // would mutate the shm counter without holding the mutex (lost update ->
-// permanent barrier hang for every process).
-void sem_wait_retry(sem_t* s) {
-  while (sem_wait(s) == -1 && errno == EINTR) {
+// permanent barrier hang for every process). Any OTHER failure (e.g.
+// EINVAL from a concurrently-closed semaphore) returns -1 and the caller
+// must bail out WITHOUT touching the counter.
+int sem_wait_retry(sem_t* s) {
+  int rc;
+  while ((rc = sem_wait(s)) == -1 && errno == EINTR) {
   }
-}
-
-void barrier_release(Barrier* b, bool unlink_names) {
-  if (b->mutex_sem != SEM_FAILED) sem_close(b->mutex_sem);
-  if (b->turnstile1 != SEM_FAILED) sem_close(b->turnstile1);
-  if (b->turnstile2 != SEM_FAILED) sem_close(b->turnstile2);
-  if (b->count != nullptr) munmap(b->count, sizeof(int));
-  if (b->shm_fd >= 0) close(b->shm_fd);
-  if (unlink_names) {
-    for (const char* suffix : {"_m", "_t1", "_t2"}) {
-      sem_unlink((std::string("/tpumpi_") + b->name + suffix).c_str());
-    }
-    shm_unlink((std::string("/tpumpi_") + b->name + "_c").c_str());
-  }
+  return rc;
 }
 
 }  // namespace
@@ -428,7 +435,7 @@ void barrier_release(Barrier* b, bool unlink_names) {
 // owner=1; joiners pass owner=0 and must be started after the owner).
 TPUMPI_API int64_t tpumpi_barrier_create(const char* name, int size,
                                          int owner) {
-  auto b = std::make_unique<Barrier>();
+  auto b = std::make_shared<Barrier>();
   b->name = name;
   b->size = size;
   b->owner = owner != 0;
@@ -450,31 +457,16 @@ TPUMPI_API int64_t tpumpi_barrier_create(const char* name, int size,
   // a retry starts clean).
   int sflags = owner ? O_CREAT : 0;
   b->mutex_sem = sem_open(n1.c_str(), sflags, 0600, 1);
-  if (b->mutex_sem == SEM_FAILED) {
-    barrier_release(b.get(), b->owner);
-    return -1;
-  }
+  if (b->mutex_sem == SEM_FAILED) return -1;  // dtor releases
   b->turnstile1 = sem_open(n2.c_str(), sflags, 0600, 0);
-  if (b->turnstile1 == SEM_FAILED) {
-    barrier_release(b.get(), b->owner);
-    return -1;
-  }
+  if (b->turnstile1 == SEM_FAILED) return -1;
   b->turnstile2 = sem_open(n3.c_str(), sflags, 0600, 0);
-  if (b->turnstile2 == SEM_FAILED) {
-    barrier_release(b.get(), b->owner);
-    return -1;
-  }
+  if (b->turnstile2 == SEM_FAILED) return -1;
   b->shm_fd = shm_open(nc.c_str(), (owner ? O_CREAT : 0) | O_RDWR, 0600);
-  if (b->shm_fd < 0 || ftruncate(b->shm_fd, sizeof(int)) != 0) {
-    barrier_release(b.get(), b->owner);
-    return -1;
-  }
+  if (b->shm_fd < 0 || ftruncate(b->shm_fd, sizeof(int)) != 0) return -1;
   void* mem = mmap(nullptr, sizeof(int), PROT_READ | PROT_WRITE, MAP_SHARED,
                    b->shm_fd, 0);
-  if (mem == MAP_FAILED) {
-    barrier_release(b.get(), b->owner);
-    return -1;
-  }
+  if (mem == MAP_FAILED) return -1;
   b->count = static_cast<int*>(mem);
   if (owner) *b->count = 0;
   std::lock_guard<std::mutex> lock(g_barrier_mutex);
@@ -484,39 +476,45 @@ TPUMPI_API int64_t tpumpi_barrier_create(const char* name, int size,
 }
 
 TPUMPI_API int tpumpi_barrier_wait(int64_t id) {
-  Barrier* b;
+  std::shared_ptr<Barrier> b;  // keeps the mapping alive across the wait
   {
     std::lock_guard<std::mutex> lock(g_barrier_mutex);
     auto it = g_barriers.find(id);
     if (it == g_barriers.end()) return -1;
-    b = it->second.get();
+    b = it->second;
   }
+  // every sem op error-checked: a failed wait (e.g. a concurrently
+  // destroyed barrier) must bail out WITHOUT touching the counter
   // phase 1: everyone arrives; the last arrival opens turnstile1
-  sem_wait_retry(b->mutex_sem);
+  if (sem_wait_retry(b->mutex_sem) != 0) return -1;
   if (++*b->count == b->size) {
     for (int i = 0; i < b->size; ++i) sem_post(b->turnstile1);
   }
   sem_post(b->mutex_sem);
-  sem_wait_retry(b->turnstile1);
+  if (sem_wait_retry(b->turnstile1) != 0) return -1;
   // phase 2: everyone departs; the last departure opens turnstile2,
   // resetting the barrier for reuse
-  sem_wait_retry(b->mutex_sem);
+  if (sem_wait_retry(b->mutex_sem) != 0) return -1;
   if (--*b->count == 0) {
     for (int i = 0; i < b->size; ++i) sem_post(b->turnstile2);
   }
   sem_post(b->mutex_sem);
-  sem_wait_retry(b->turnstile2);
+  if (sem_wait_retry(b->turnstile2) != 0) return -1;
   return 0;
 }
 
 TPUMPI_API void tpumpi_barrier_destroy(int64_t id) {
-  std::lock_guard<std::mutex> lock(g_barrier_mutex);
-  auto it = g_barriers.find(id);
-  if (it == g_barriers.end()) return;
-  // only the owner unlinks the names: a joiner destroying its handle must
-  // not invalidate the barrier for surviving processes
-  barrier_release(it->second.get(), it->second->owner);
-  g_barriers.erase(it);
+  std::shared_ptr<Barrier> dying;
+  {
+    std::lock_guard<std::mutex> lock(g_barrier_mutex);
+    auto it = g_barriers.find(id);
+    if (it == g_barriers.end()) return;
+    dying = std::move(it->second);
+    g_barriers.erase(it);
+  }
+  // release happens in ~Barrier when the LAST holder (possibly a still-
+  // blocked waiter) drops its reference; the owner unlinks the names
+  // there — a joiner's destroy never invalidates surviving processes
 }
 
 TPUMPI_API const char* tpumpi_version() { return "tpumpi-native-0.1.0"; }

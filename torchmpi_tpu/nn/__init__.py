@@ -7,15 +7,18 @@ TPU-native analog of ``torchmpi/nn.lua``:
 - :func:`synchronize_gradients` — sum-allreduce every gradient leaf
   (``nn.lua:49-56``). Sum, not mean, matching the reference; pass
   ``average=True`` to divide.
-- :func:`async_synchronize_gradients` — the overlapped path. The reference
-  monkey-patches each module's ``backward`` to launch an async allreduce per
-  layer on a fenced stream (``nn.lua:112-213``); on TPU the latency-hiding
-  belongs to XLA's async-collective scheduler, so the design is *gradient
-  buckets*: grads are partitioned into ~equal-size blocks
-  (:class:`GradientBuckets` ≙ ``BlockSequential``'s equal-parameter-count
-  partitioning, ``BlockSequential.lua:29-89``) and each bucket's collective
-  is issued as its own dispatch so communication overlaps with whatever
-  compute follows; handles are waited in reverse order (``nn.lua:207-212``).
+- The overlapped path. The reference monkey-patches each module's
+  ``backward`` to launch an async allreduce per layer on a fenced stream
+  (``nn.lua:112-213``); on TPU the latency-hiding belongs to XLA's
+  async-collective scheduler, so the REAL backward-compute overlap lives in
+  the **in-graph bucketed path** (``in_graph_synchronize_gradients_bucketed``,
+  compiled by the engine): XLA schedules each bucket's psum concurrently
+  with remaining compute. The *eager* :class:`GradientBuckets` API
+  (≙ ``BlockSequential``'s equal-parameter-count partitioning,
+  ``BlockSequential.lua:29-89``) launches only after the full gradient tree
+  exists — its buckets overlap with EACH OTHER and with whatever host/device
+  work follows the launch, not with the backward that produced them; handles
+  are waited in reverse order (``nn.lua:207-212``).
 - In-graph variants (``in_graph_*``) for use inside jit/shard_map — the
   idiomatic path the engine compiles.
 
@@ -170,10 +173,16 @@ class GradientBuckets:
         return [leaves[i] for i in self.buckets[b]]
 
     def allreduce_async(
-        self, grads, comm: Optional[Communicator] = None
+        self,
+        grads,
+        comm: Optional[Communicator] = None,
+        backend: Optional[str] = None,
     ) -> List[SyncHandle]:
         """Launch one async fused allreduce per bucket; returns handles in
-        launch order (wait them in reverse, ``nn.lua:207-212``)."""
+        launch order (wait them in reverse, ``nn.lua:207-212``).
+        ``backend`` optionally pins the collective backend (e.g. ``'ring'``
+        to engage the hierarchical intra×inter composition on 2-level
+        communicators); default = selector choice."""
         comm = _comm(comm)
         p = comm.size
         leaves = tree_util.tree_leaves(grads)
@@ -181,8 +190,10 @@ class GradientBuckets:
         for b in range(self.num_buckets):
             flats = [jnp.reshape(leaves[i], (p, -1)) for i in self.buckets[b]]
             buf = jnp.concatenate(flats, axis=1)
+            # one dispatch path for selector-routed AND pinned backends
+            # (keeps the ring_implementation remap consistent)
             handles.append(
-                collectives.async_.allreduce_tensor(buf, comm=comm)
+                collectives._dispatch("allreduce", buf, comm, "async", backend)
             )
         # Remember which communicator these collectives ran on so the
         # averaging divisor in wait_and_unflatten defaults correctly.
