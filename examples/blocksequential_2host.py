@@ -82,8 +82,10 @@ def main(argv=None):
     assert comm.has_inter_collective, "need >= 2 hosts"
     # keep every bucket on the bandwidth (ring) path so the cross-host
     # hierarchical composition is what actually runs (on TPU the tuned
-    # cutoffs do this; the tiny CPU test sizes need the explicit pin)
+    # cutoffs do this; the tiny CPU test sizes need the explicit pin) —
+    # restored on exit so in-process callers keep their routing
     suffix = mpi.constants.platform_suffix(comm.devices[0].platform)
+    prev_cutoff = mpi.constants.get(f"small_allreduce_size_{suffix}")
     mpi.constants.set(f"small_allreduce_size_{suffix}", 1)
 
     model = MLP6(features=128)
@@ -117,26 +119,31 @@ def main(argv=None):
     it = DistributedIterator(xtr, ytr, args.batch_per_rank * p, p, seed=3)
 
     losses = []
-    for epoch in range(args.epochs):
-        for xb, yb in it:
-            grads = grad_fn(stacked, (jnp.asarray(xb), jnp.asarray(yb)))
-            # BlockSequential overlap: per-block async allreduce, waits in
-            # reverse launch order (nn.lua:207-212); routed through the
-            # hierarchical intra-host x inter-host composition
-            handles = buckets.allreduce_async(grads, comm=comm, backend="ring")
-            grads = buckets.wait_and_unflatten(
-                grads, handles, average=True, comm=comm
+    try:
+        for epoch in range(args.epochs):
+            for xb, yb in it:
+                grads = grad_fn(stacked, (jnp.asarray(xb), jnp.asarray(yb)))
+                # BlockSequential overlap: per-block async allreduce, waits
+                # in reverse launch order (nn.lua:207-212); routed through
+                # the hierarchical intra-host x inter-host composition
+                handles = buckets.allreduce_async(
+                    grads, comm=comm, backend="ring"
+                )
+                grads = buckets.wait_and_unflatten(
+                    grads, handles, average=True, comm=comm
+                )
+                updates, opt_state = update_fn(grads, opt_state, stacked)
+                stacked = jax.vmap(optax.apply_updates)(stacked, updates)
+            loss = float(
+                loss_fn(
+                    jax.tree_util.tree_map(lambda w: w[0], stacked),
+                    (jnp.asarray(xte[:256]), jnp.asarray(yte[:256])),
+                )
             )
-            updates, opt_state = update_fn(grads, opt_state, stacked)
-            stacked = jax.vmap(optax.apply_updates)(stacked, updates)
-        loss = float(
-            loss_fn(
-                jax.tree_util.tree_map(lambda w: w[0], stacked),
-                (jnp.asarray(xte[:256]), jnp.asarray(yte[:256])),
-            )
-        )
-        losses.append(loss)
-        print(f"[bseq] epoch {epoch}: test loss {loss:.4f}")
+            losses.append(loss)
+            print(f"[bseq] epoch {epoch}: test loss {loss:.4f}")
+    finally:
+        mpi.constants.set(f"small_allreduce_size_{suffix}", prev_cutoff)
 
     mpinn.check_with_allreduce(stacked, comm=comm)  # replicas in sync
     hier_used = any(

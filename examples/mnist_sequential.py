@@ -1,0 +1,81 @@
+"""Single-process MNIST baseline — parity with
+``examples/mnist/mnist_sequential.lua``: the sequential run whose loss the
+distributed recipes must match (the reference's convergence oracle,
+``mnist_allreduce.lua:87-113``).
+
+Run:  python examples/mnist_sequential.py [--model lenet] [--epochs 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="logreg", choices=["logreg", "lenet"])
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--batch", type=int, default=336)
+    ap.add_argument("--train", type=int, default=8192)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchmpi_tpu.models import (
+        LeNet,
+        LogisticRegression,
+        accuracy,
+        init_params,
+        make_loss_fn,
+    )
+    from torchmpi_tpu.utils import synthetic_mnist
+
+    (xtr, ytr), (xte, yte) = synthetic_mnist(num_train=args.train)
+    model = LeNet() if args.model == "lenet" else LogisticRegression()
+    params = init_params(model, (1, 28, 28))
+    loss_fn = make_loss_fn(model)
+    opt = optax.sgd(args.lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.RandomState(args.seed)
+    n = len(xtr)
+    if args.batch > n:
+        raise SystemExit(
+            f"--batch {args.batch} exceeds --train {n}: no full batch fits"
+        )
+    losses = []
+    for epoch in range(args.epochs):
+        order = rng.permutation(n)
+        loss = None
+        for i in range(0, n - args.batch + 1, args.batch):
+            idx = order[i : i + args.batch]
+            params, opt_state, loss = step(
+                params, opt_state, jnp.asarray(xtr[idx]), jnp.asarray(ytr[idx])
+            )
+        losses.append(float(loss))
+        print(f"[seq] epoch {epoch}: loss {losses[-1]:.4f}")
+
+    acc = float(
+        accuracy(model.apply({"params": params}, jnp.asarray(xte)), jnp.asarray(yte))
+    )
+    print(f"[seq] done: final loss {losses[-1]:.4f}, test acc {acc:.3f}")
+    return losses, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,15 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def test_mnist_sequential_example():
+    """The single-process convergence oracle (mnist_sequential.lua)."""
+    from examples.mnist_sequential import main
+
+    losses, acc = main(["--train", "2048", "--epochs", "4"])
+    assert losses[-1] < losses[0]
+    assert acc > 0.8
+
+
 def test_blocksequential_2host_example():
     """BASELINE.json config #5 at test scale: block-partitioned async
     gradient allreduce over a 2-host hierarchical communicator converges

@@ -483,8 +483,12 @@ TPUMPI_API int tpumpi_barrier_wait(int64_t id) {
     if (it == g_barriers.end()) return -1;
     b = it->second;
   }
-  // every sem op error-checked: a failed wait (e.g. a concurrently
-  // destroyed barrier) must bail out WITHOUT touching the counter
+  // every sem op error-checked: a failed FIRST wait (e.g. a concurrently
+  // destroyed barrier) bails out without touching the counter; a failure
+  // AFTER the phase-1 increment leaves the barrier poisoned for every
+  // participant (peers must destroy + recreate) — the MPI model, where a
+  // rank failure kills the communicator, and exactly what the reference's
+  // job-wide failure semantics prescribe (SURVEY §5 failure detection)
   // phase 1: everyone arrives; the last arrival opens turnstile1
   if (sem_wait_retry(b->mutex_sem) != 0) return -1;
   if (++*b->count == b->size) {

@@ -190,8 +190,10 @@ class GradientBuckets:
         for b in range(self.num_buckets):
             flats = [jnp.reshape(leaves[i], (p, -1)) for i in self.buckets[b]]
             buf = jnp.concatenate(flats, axis=1)
-            # one dispatch path for selector-routed AND pinned backends
-            # (keeps the ring_implementation remap consistent)
+            # one dispatch path for selector-routed AND pinned backends;
+            # note a pinned backend is honored EXACTLY (no
+            # ring_implementation remap — that applies only to
+            # selector-routed calls)
             handles.append(
                 collectives._dispatch("allreduce", buf, comm, "async", backend)
             )
