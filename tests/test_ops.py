@@ -386,6 +386,69 @@ def test_pallas_broadcast_bool_rides_as_uint8():
     np.testing.assert_array_equal(out, np.tile(x[1], (p, 1)))
 
 
+@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32, jnp.bfloat16])
+def test_pallas_allgather_interpret(p, dtype):
+    """Pallas ring allgather: every device gets [p, ...] stacked in rank
+    order, bit-exact (float blocks ride as byte views: -0.0 preserved)."""
+    from torchmpi_tpu.ops.ring_kernels import ring_allgather_pallas
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    mesh = Mesh(np.array(jax.devices()[:p]), ("mpi",))
+    rng = np.random.RandomState(p)
+    x = rng.randn(p, 7, 33).astype(np.float32)
+    if jnp.dtype(dtype).kind in "iu":
+        x = (x * 100).astype(dtype)
+    else:
+        x = x.astype(dtype)
+        x[:, 0, 0] = -0.0  # bit-exactness probe
+    f = jax.jit(
+        jax.shard_map(
+            lambda b: ring_allgather_pallas(
+                b[0], "mpi", axis_size=p, interpret=True
+            )[None],
+            mesh=mesh,
+            in_specs=P("mpi"),
+            out_specs=P("mpi"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(jnp.asarray(x)))  # [p, p, 7, 33]
+    assert out.dtype == x.dtype
+    # BYTE comparison for every float dtype: -0.0 must survive (bf16's
+    # numpy kind is 'V', so check float-ness via jnp.issubdtype)
+    as_bytes = jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+    for r in range(p):
+        np.testing.assert_array_equal(
+            out[r].view(np.uint8) if as_bytes else out[r],
+            x.view(np.uint8) if as_bytes else x,
+        )
+
+
+def test_eager_pallas_allgather_dispatch():
+    """backend='pallas' allgather concats along the last dim in rank order
+    through the eager contract (forced interpret)."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.collectives import eager
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    mpi.start()
+    rk._FORCE_INTERPRET = True
+    try:
+        p = mpi.size()
+        comm = mpi.current_communicator()
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(p, 40).astype(np.float32))
+        out = np.asarray(eager.run("allgather", x, comm, backend="pallas"))
+        expect = np.asarray(x).reshape(-1)
+        for r in range(p):
+            np.testing.assert_array_equal(out[r], expect)
+    finally:
+        rk._FORCE_INTERPRET = False
+        mpi.stop()
+
+
 def test_pallas_reduction_rejects_lossy_dtype():
     from torchmpi_tpu.ops import ring_kernels as rk
 

@@ -231,6 +231,7 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
         # remaining ops take the ppermute ring (the reference similarly
         # mixed transports per collective).
         from ..ops.ring_kernels import (
+            ring_allgather_pallas,
             ring_allreduce_pallas,
             ring_broadcast_pallas,
         )
@@ -239,11 +240,20 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             lambda b, k: ring_broadcast_pallas(b, root, _AXIS, num_chunks=k)
         )
 
+        def _pallas_allgather(b):
+            # b: [1, ..., d] per-rank block -> concat along the last dim in
+            # rank order (the eager allgather contract)
+            stacked = ring_allgather_pallas(b[0], _AXIS)  # [p, ..., d]
+            moved = jnp.moveaxis(stacked, 0, -2)  # [..., p, d]
+            return moved.reshape(
+                b.shape[:-1] + (moved.shape[-2] * moved.shape[-1],)
+            )
+
         table = {
             "allreduce": lambda b: ring_allreduce_pallas(b, _AXIS),
             "broadcast": _pallas_bcast,
             "reduce": _ring_reduce,
-            "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
+            "allgather": _pallas_allgather,
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
     else:

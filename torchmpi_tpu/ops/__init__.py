@@ -3,6 +3,7 @@
 from .reduce_kernel import accumulate, scale_accumulate
 from .ring_kernels import (
     available,
+    ring_allgather_pallas,
     ring_allreduce_pallas,
     ring_broadcast_pallas,
     ring_reduce_scatter_pallas,
@@ -13,6 +14,7 @@ __all__ = [
     "accumulate",
     "scale_accumulate",
     "available",
+    "ring_allgather_pallas",
     "ring_allreduce_pallas",
     "ring_broadcast_pallas",
     "ring_reduce_scatter_pallas",

@@ -61,6 +61,26 @@ def _min_rows(dtype) -> int:
     return 8 * (4 // jnp.dtype(dtype).itemsize)
 
 
+def _tile_rows(n: int, dtype) -> int:
+    """Rows needed for ``n`` elements, rounded up to whole
+    (min_rows, LANES) sublane tiles — the single source of the padding
+    rule for every kernel wrapper."""
+    min_rows = _min_rows(dtype)
+    raw_rows = -(-n // _LANES)  # ceil(n / lanes)
+    return max(min_rows, -(-raw_rows // min_rows) * min_rows)
+
+
+def _pad_to_tile(flat):
+    """Zero-pad a flat buffer to whole tiles; returns (rows, padded_flat)."""
+    rows = _tile_rows(flat.shape[0], flat.dtype)
+    padded = rows * _LANES
+    if padded != flat.shape[0]:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros(padded - flat.shape[0], flat.dtype)]
+        )
+    return rows, flat
+
+
 def supports_dtype(dtype) -> bool:
     """True when the pallas ring preserves this dtype exactly (native or
     losslessly carried)."""
@@ -87,12 +107,18 @@ def _carrier_dtype(dtype):
     )
 
 
-def _bitcast_to_bytes(flat):
+def _bitcast_to_bytes(flat, force: bool = False):
     """Lossless byte view of any dtype (for data-movement kernels): returns
     (int8 view, restore_fn). bool rides as uint8 (bitcast rejects it);
-    complex is rejected loudly (no TPU support)."""
+    complex is rejected loudly (no TPU support). ``force=True`` bitcasts
+    even kernel-native dtypes — for paths whose zero-padding arithmetic
+    must be bit-exact (e.g. the allgather identity-sum would flip a float
+    -0.0 to +0.0)."""
     d = jnp.dtype(flat.dtype)
-    if d in _NATIVE_DTYPES:
+    # NB: ml_dtypes floats (bfloat16) have numpy kind 'V' — test float-ness
+    # via issubdtype, never d.kind
+    is_float = jnp.issubdtype(d, jnp.floating)
+    if d in _NATIVE_DTYPES and not (force and is_float):
         return flat, lambda out: out
     if d == jnp.dtype(bool):
         return flat.astype(jnp.uint8), lambda out: out.astype(bool)
@@ -263,8 +289,9 @@ def _segmented(flat, p, dtype, call):
     kMin/kMaxBufferSize chunking, constants.cpp:142-145)."""
     n = flat.shape[0]
     min_rows = _min_rows(dtype)
-    rows = -(-n // (p * _LANES))
-    rows = -(-rows // min_rows) * min_rows  # sublane-align each chunk
+    # per-chunk rows for p ring chunks (nested-ceil identity keeps this
+    # equal to ceil(n / (p * LANES)) rounded to tiles)
+    rows = _tile_rows(-(-n // p), dtype)
     seg_rows = min(rows, _max_rows(p, jnp.dtype(dtype).itemsize, min_rows))
     padded = p * seg_rows * _LANES
     num_segments = -(-n // padded)
@@ -346,8 +373,7 @@ def ring_reduce_scatter_pallas(
     # [p, seg_n]: segment s flattened per row; pad rows to tile shape.
     segs = x.reshape((p, seg_n)).astype(carrier)
     min_rows = _min_rows(carrier)
-    raw_rows = -(-seg_n // _LANES)  # ceil(seg_n / lanes)
-    rows = max(min_rows, -(-raw_rows // min_rows) * min_rows)
+    rows = _tile_rows(seg_n, carrier)
     padded = rows * _LANES
     if padded != seg_n:
         segs = jnp.concatenate(
@@ -374,6 +400,63 @@ def ring_reduce_scatter_pallas(
         outs.append(owned)
     full = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
     return full.reshape(-1)[:seg_n].reshape(seg_shape).astype(orig_dtype)
+
+
+def ring_allgather_pallas(
+    x,
+    axis: str = "mpi",
+    axis_size: Optional[int] = None,
+    interpret: bool = False,
+):
+    """All-gather along a new leading ring dimension: every device ends
+    with ``[p, *x.shape]`` stacked in rank order — the pallas analog of the
+    allgather phase of the reference ring (``detail/collectives_cuda.cpp:
+    330-388``), standalone. Data-movement only: any real dtype rides as a
+    lossless byte view.
+
+    Implementation: the allgather phase IS the ring's second half; run the
+    shared phases kernel with ``rs_only=False`` on a zero-padded chunk
+    layout where device r contributes chunk r — the reduce-scatter phase
+    over zeros is then the identity and the all-gather phase distributes
+    every chunk. For long-term perf a dedicated (p-1)-step kernel would
+    halve the steps; correctness-first here, and the eager selector only
+    uses this on real multi-chip hardware where it is measured first.
+    """
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x[None]
+    interpret = interpret or _FORCE_INTERPRET
+    orig_shape, orig_dtype = x.shape, x.dtype
+    # force the byte view: identity-sum over zero padding must be
+    # bit-exact (float -0.0 would flip to +0.0 under x + 0)
+    flat, restore = _bitcast_to_bytes(x.reshape(-1), force=True)
+    carrier = flat.dtype
+    n = flat.shape[0]
+    min_rows = _min_rows(carrier)
+    rows, flat = _pad_to_tile(flat)
+    padded = rows * _LANES
+    my = lax.axis_index(axis)
+    # VMEM budget: row slices run as sequential kernel calls
+    seg_rows = min(rows, _max_rows(p, jnp.dtype(carrier).itemsize, min_rows))
+    grid = flat.reshape(rows, _LANES)
+    outs = []
+    for r0 in range(0, rows, seg_rows):
+        r1 = min(rows, r0 + seg_rows)
+        # chunk layout [p, slice_rows, LANES]: my own block at index my,
+        # zeros elsewhere; after RS (one real + p-1 zero contributions)
+        # chunk c is exactly device c's block, and AG distributes all
+        chunks = jnp.zeros((p, r1 - r0, _LANES), carrier)
+        chunks = lax.dynamic_update_index_in_dim(
+            chunks, grid[r0:r1], my, 0
+        )
+        out = _ring_phases_call(
+            chunks, p, axis, r1 - r0, carrier, False, interpret
+        )
+        outs.append(out)
+    full = jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+    gathered = full.reshape(p, padded)[:, :n]
+    blocks = [restore(gathered[r]) for r in range(p)]
+    return jnp.stack(blocks).reshape((p,) + orig_shape).astype(orig_dtype)
 
 
 # ---------------------------------------------------------------------------
