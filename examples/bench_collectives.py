@@ -22,6 +22,12 @@ def main():
     ap.add_argument("--min-pow", type=int, default=12)
     ap.add_argument("--max-pow", type=int, default=20)
     ap.add_argument("--cpu-mesh", type=int, default=0)
+    ap.add_argument(
+        "--pallas-interpret",
+        action="store_true",
+        help="add the pallas backend in interpret mode (CPU mesh; on real "
+        "multi-chip TPU pass --backends xla,ring,pallas instead)",
+    )
     args = ap.parse_args()
 
     if args.cpu_mesh:
@@ -49,15 +55,28 @@ def main():
             f"{r.bus_gbps:>10.2f}  {'yes' if r.correct else 'NO'}"
         )
 
-    results = run_matrix(
-        comm,
-        ops=args.ops.split(","),
-        backends=args.backends.split(","),
-        modes=args.modes.split(","),
-        sizes=sweep_sizes(args.min_pow, args.max_pow),
-        benchmark=True,
-        report=report,
-    )
+    backends = args.backends.split(",")
+    if args.pallas_interpret:
+        from torchmpi_tpu.ops import ring_kernels as rk
+
+        rk._FORCE_INTERPRET = True
+        if "pallas" not in backends:
+            backends.append("pallas")
+    try:
+        results = run_matrix(
+            comm,
+            ops=args.ops.split(","),
+            backends=backends,
+            modes=args.modes.split(","),
+            sizes=sweep_sizes(args.min_pow, args.max_pow),
+            benchmark=True,
+            report=report,
+        )
+    finally:
+        if args.pallas_interpret:
+            from torchmpi_tpu.ops import ring_kernels as rk
+
+            rk._FORCE_INTERPRET = False
     bad = [r for r in results if not r.correct]
     print(f"{len(results)} configs, {len(bad)} incorrect")
     mpi.stop()

@@ -29,6 +29,7 @@ from .collectives import (
     broadcast_scalar,
     broadcast_tensor,
     collective_availability,
+    pallas,
     reduce_tensor,
     ring,
     selector as collective_selector,
@@ -86,6 +87,7 @@ __all__ = [
     "allreduce_scalar",
     "xla",
     "ring",
+    "pallas",
     "async_",
     "collective_selector",
     "collective_availability",

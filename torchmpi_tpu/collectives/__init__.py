@@ -119,10 +119,12 @@ class _AsyncNS(_BackendNS):
         super().__init__(backend, "async")
         self.xla = _BackendNS("xla", "async")
         self.ring = _BackendNS("ring", "async")
+        self.pallas = _BackendNS("pallas", "async")
 
 
 xla = _BackendNS("xla", "sync")
 ring = _BackendNS("ring", "sync")
+pallas = _BackendNS("pallas", "sync")
 async_ = _AsyncNS()
 
 
@@ -176,6 +178,7 @@ __all__ = [
     "wait",
     "xla",
     "ring",
+    "pallas",
     "async_",
     "selector",
     "collective_availability",

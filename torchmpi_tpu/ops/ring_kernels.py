@@ -455,8 +455,10 @@ def ring_allgather_pallas(
         outs.append(out)
     full = jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
     gathered = full.reshape(p, padded)[:, :n]
-    blocks = [restore(gathered[r]) for r in range(p)]
-    return jnp.stack(blocks).reshape((p,) + orig_shape).astype(orig_dtype)
+    # one flat restore over the whole buffer (every restore branch is
+    # elementwise on a multiple-of-itemsize buffer)
+    restored = restore(gathered.reshape(-1))
+    return restored.reshape((p,) + orig_shape).astype(orig_dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -599,8 +601,7 @@ def ring_broadcast_pallas(
     def one_call(seg_flat):
         n = seg_flat.shape[0]
         k = num_chunks or min(8, max(1, -(-n // (min_rows * _LANES))))
-        rows = -(-n // (k * _LANES))
-        rows = max(min_rows, -(-rows // min_rows) * min_rows)
+        rows = _tile_rows(-(-n // k), carrier)  # per-chunk tile rows
         padded = k * rows * _LANES
         if padded != n:
             seg_flat = jnp.concatenate(
