@@ -299,3 +299,161 @@ def test_resnet18_train_step_with_engine():
     # batch_stats were updated and synchronized
     bs = jax.tree_util.tree_leaves(jax.device_get(engine.model_state))
     assert any(np.abs(np.asarray(b)).sum() > 0 for b in bs)
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism (capability extension; absent upstream, SURVEY §2.3)
+# ---------------------------------------------------------------------------
+
+
+def _pp_setup(p, d=16, m=6, mb=3, seed=0):
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(seed)
+    Ws = rng.randn(p, d, d).astype(np.float32) * 0.3
+    micro = rng.randn(m, mb, d).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:p]), ("pp",))
+    return Ws, micro, mesh
+
+
+def _stage_fn(w, x):
+    # w: [1, d, d] shard_map block of the stacked stage params
+    return jnp.tanh(x @ w[0])
+
+
+def _sequential(Ws, micro):
+    y = micro
+    for s in range(Ws.shape[0]):
+        y = np.tanh(y @ Ws[s])
+    return y
+
+
+@pytest.mark.parametrize("p", [2, 4, 8])
+def test_pipeline_forward_matches_sequential(p):
+    """GPipe schedule parity: piping m microbatches through p stages must
+    equal applying the stages in order."""
+    from torchmpi_tpu.parallel import pipeline_forward
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    Ws, micro, mesh = _pp_setup(p)
+    f = jax.jit(
+        jax.shard_map(
+            lambda w, x: pipeline_forward(_stage_fn, w, x, "pp"),
+            mesh=mesh,
+            in_specs=(P("pp"), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(Ws, micro))
+    np.testing.assert_allclose(out, _sequential(Ws, micro), rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("p", [2, 8])
+def test_pipeline_grad_matches_sequential(p):
+    """The supported pattern — shard_map(value_and_grad(loss_fn)) — must
+    match the sequential model's gradients at every stage count."""
+    from torchmpi_tpu.parallel import pipeline_loss_fn
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    Ws, micro, mesh = _pp_setup(p, seed=p)
+    rng = np.random.RandomState(1)
+    tgt = rng.randn(*micro.shape).astype(np.float32)
+
+    loss_fn = pipeline_loss_fn(
+        _stage_fn, lambda outs, t: jnp.mean((outs - t) ** 2), "pp"
+    )
+    loss, g = jax.jit(
+        jax.shard_map(
+            lambda W, xx, tt: jax.value_and_grad(loss_fn)(W, xx, tt),
+            mesh=mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")),
+            check_vma=False,
+        )
+    )(jnp.asarray(Ws), jnp.asarray(micro), jnp.asarray(tgt))
+
+    def seq_loss(W):
+        y = jnp.asarray(micro)
+        for s in range(p):
+            y = jnp.tanh(y @ W[s])
+        return jnp.mean((y - jnp.asarray(tgt)) ** 2)
+
+    g_ref = jax.grad(seq_loss)(jnp.asarray(Ws))
+    np.testing.assert_allclose(
+        float(loss), float(seq_loss(jnp.asarray(Ws))), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_pipeline_bubble_independent_of_microbatch_count():
+    """More microbatches than stages (and fewer) both stay correct."""
+    from torchmpi_tpu.parallel import pipeline_forward
+
+    p = 4
+    if len(jax.devices()) < p:
+        pytest.skip("needs 4 devices")
+    for m in (1, 2, 9):
+        Ws, micro, mesh = _pp_setup(p, m=m, seed=m)
+        f = jax.jit(
+            jax.shard_map(
+                lambda w, x: pipeline_forward(_stage_fn, w, x, "pp"),
+                mesh=mesh,
+                in_specs=(P("pp"), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        np.testing.assert_allclose(
+            np.asarray(f(Ws, micro)), _sequential(Ws, micro),
+            rtol=2e-5, atol=1e-6,
+        )
+
+
+def test_pipeline_grad_inside_shard_map_correct_scale():
+    """Regression: differentiating INSIDE shard_map must give the same
+    (unscaled) stage gradients as the sequential model — the masked-psum-
+    of-the-LOSS design; replicating outputs and differentiating through
+    them would p-scale every gradient."""
+    from torchmpi_tpu.parallel import pipeline_loss_fn
+
+    p = 4
+    if len(jax.devices()) < p:
+        pytest.skip("needs 4 devices")
+    Ws, micro, mesh = _pp_setup(p)
+    rng = np.random.RandomState(2)
+    tgt = rng.randn(*micro.shape).astype(np.float32)
+
+    loss_fn = pipeline_loss_fn(
+        _stage_fn, lambda outs, t: jnp.mean((outs - t) ** 2), "pp"
+    )
+
+    def inner(W, xx, tt):
+        # grad taken INSIDE the shard_map region
+        return jax.value_and_grad(loss_fn)(W, xx, tt)
+
+    loss, g = jax.jit(
+        jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")),
+            check_vma=False,
+        )
+    )(jnp.asarray(Ws), jnp.asarray(micro), jnp.asarray(tgt))
+
+    def seq_loss(W):
+        y = jnp.asarray(micro)
+        for s in range(p):
+            y = jnp.tanh(y @ W[s])
+        return jnp.mean((y - jnp.asarray(tgt)) ** 2)
+
+    g_ref = jax.grad(seq_loss)(jnp.asarray(Ws))
+    np.testing.assert_allclose(float(loss), float(seq_loss(jnp.asarray(Ws))), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=1e-4, atol=1e-6
+    )

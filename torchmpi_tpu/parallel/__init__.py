@@ -1,9 +1,12 @@
 from .mesh import make_parallel_mesh
+from .pp import pipeline_forward, pipeline_loss_fn
 from .ring_attention import full_self_attention, ring_self_attention
 from .tp import MPLinear, MPLinearOutputSplit, shard_input_features
 
 __all__ = [
     "make_parallel_mesh",
+    "pipeline_forward",
+    "pipeline_loss_fn",
     "ring_self_attention",
     "full_self_attention",
     "MPLinear",

@@ -1,0 +1,143 @@
+"""Pipeline parallelism over a mesh axis (GPipe-style microbatching).
+
+The reference has no layer pipelining across devices (SURVEY.md §2.3 marks
+PP absent — only *communication* pipelining of chunks); this is a
+capability extension in the modern taxonomy, built TPU-first:
+
+- each device along the ``pp`` mesh axis owns ONE stage's parameters;
+- the classic GPipe schedule runs as a ``lax.scan`` over
+  ``num_microbatches + p - 1`` ticks: every tick each stage computes on
+  the activation received from its left neighbor and hands its output
+  rightward with a single ``lax.ppermute`` (one-hop ICI transfer);
+- ``ppermute`` is differentiable, so ``jax.grad`` through
+  :func:`pipeline_forward` yields the standard GPipe backward schedule
+  automatically — no hand-written bubble bookkeeping;
+- stage activations must share one shape/dtype (the usual uniform-width
+  transformer-block restriction).
+
+Bubble fraction is the textbook ``(p-1)/(m+p-1)``; pick
+``num_microbatches >> p`` to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_forward(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    axis: str = "pp",
+    replicate_outputs: bool = True,
+):
+    """Run ``p`` pipeline stages over ``m`` microbatches inside shard_map.
+
+    Parameters
+    ----------
+    stage_fn : ``stage_fn(params, x) -> y`` — one stage's computation;
+        ``y`` must have ``x``'s shape/dtype.
+    stage_params : THIS device's stage parameters (shard_map'd so device
+        ``s`` holds stage ``s``'s pytree).
+    microbatches : ``[m, mb, ...]`` — the full input, present on every
+        stage (only stage 0 reads it; XLA DCEs the rest).
+    axis : the pipeline mesh axis name.
+
+    Returns ``[m, mb, ...]`` outputs of the LAST stage. With
+    ``replicate_outputs`` (default) they are broadcast to every stage via
+    a masked ``psum`` so callers can read them anywhere — but the psum's
+    TRANSPOSE sums p identical cotangents, so do NOT differentiate a loss
+    of the replicated outputs inside shard_map (p-scaled gradients); use
+    :func:`pipeline_loss_fn`, which masks the LOSS instead, for in-graph
+    training. ``replicate_outputs=False`` returns each stage's raw buffer
+    (meaningful only on stage p-1).
+    """
+    p = lax.axis_size(axis)
+    m = microbatches.shape[0]
+    s = lax.axis_index(axis)
+    right = [(i, (i + 1) % p) for i in range(p)]
+    mb_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # stage 0 injects microbatch t (while t < m); others consume the
+        # activation their left neighbor produced last tick
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, m - 1), 0, keepdims=False
+        )
+        x = jnp.where(s == 0, inject, incoming)
+        y = stage_fn(stage_params, x)
+        # the LAST stage's result for microbatch t-(p-1) is ready at tick
+        # t; record it (only stage p-1's lane is meaningful, fixed below)
+        out_idx = jnp.clip(t - (p - 1), 0, m - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(t - (p - 1) >= 0, y, outputs[out_idx]),
+            out_idx,
+            0,
+        )
+        # hand rightward: stage s's output becomes s+1's next input
+        incoming = lax.ppermute(y, axis, right)
+        return (incoming, outputs), None
+
+    init = (
+        jnp.zeros(mb_shape, dtype),
+        jnp.zeros((m,) + mb_shape, dtype),
+    )
+    (incoming, outputs), _ = lax.scan(
+        tick, init, jnp.arange(m + p - 1)
+    )
+    if not replicate_outputs:
+        return outputs  # true outputs only on stage p-1
+    # only stage p-1 holds the true outputs; broadcast them to every
+    # stage with a masked psum (single collective)
+    mine = jnp.where(s == p - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(mine, axis)
+
+
+def pipeline_loss_fn(
+    stage_fn: Callable,
+    loss_of_outputs: Callable,
+    axis: str = "pp",
+):
+    """Build ``fn(stage_params, microbatches, targets) -> scalar`` for use
+    inside shard_map: GPipe forward + the caller's loss over the final
+    outputs. ``jax.grad`` of this function — inside OR outside shard_map —
+    gives each device its OWN stage's gradients at the correct scale (the
+    PP backward schedule falls out of ppermute's transpose).
+
+    Gradient-scale discipline: under SPMD differentiation the transpose of
+    ``psum`` SUMS the per-device cotangents of its replicated result, so
+    differentiating a psum'd loss inside shard_map p-scales every
+    gradient. The returned scalar therefore separates value from gradient:
+    the VALUE is the psum-replicated last-stage loss, but the GRADIENT
+    flows only through the local masked lane
+    (``masked + stop_gradient(replicated - masked)``).
+
+    Supported differentiation pattern: take the grad INSIDE the shard_map
+    region — ``shard_map(jax.value_and_grad(fn), ...)`` — which yields
+    exact sequential-parity stage gradients (tested). Differentiating the
+    already-shard_mapped function from OUTSIDE uses the opposite
+    replicated-output cotangent convention (1/p per lane) and is not
+    supported."""
+
+    def fn(stage_params, microbatches, targets):
+        outs = pipeline_forward(
+            stage_fn, stage_params, microbatches, axis,
+            replicate_outputs=False,
+        )
+        p = lax.axis_size(axis)
+        s = lax.axis_index(axis)
+        loss_local = loss_of_outputs(outs, targets)
+        masked = jnp.where(
+            s == p - 1, loss_local, jnp.zeros_like(loss_local)
+        )
+        replicated = lax.psum(masked, axis)
+        return masked + lax.stop_gradient(replicated - masked)
+
+    return fn
