@@ -1,3 +1,4 @@
+from .ep import moe_dispatch_combine, moe_load_stats
 from .mesh import make_parallel_mesh
 from .pp import pipeline_forward, pipeline_loss_fn
 from .ring_attention import full_self_attention, ring_self_attention
@@ -5,6 +6,8 @@ from .tp import MPLinear, MPLinearOutputSplit, shard_input_features
 
 __all__ = [
     "make_parallel_mesh",
+    "moe_dispatch_combine",
+    "moe_load_stats",
     "pipeline_forward",
     "pipeline_loss_fn",
     "ring_self_attention",

@@ -1,0 +1,111 @@
+"""Expert parallelism (MoE) over a mesh axis.
+
+The reference predates MoE entirely (SURVEY.md §2.3 marks EP absent); this
+is a capability extension in the modern taxonomy, built TPU-first:
+
+- each device along the ``ep`` mesh axis owns ONE expert's parameters and
+  a shard of the tokens;
+- top-1 routing with a fixed per-expert **capacity** keeps every shape
+  static (XLA requirement): token t goes to expert ``argmax(logits[t])``
+  unless that expert's capacity is exhausted, in which case the token is
+  dropped (its output contribution is zero — the standard Switch-style
+  overflow rule);
+- dispatch/combine are einsums against a boolean ``[T, E, C]`` dispatch
+  tensor (the Mesh-TensorFlow formulation), and the cross-device exchange
+  is a single ``lax.all_to_all`` each way — the ICI-native analog of the
+  all-to-all EP traffic in modern MoE stacks;
+- everything is differentiable: gradients flow through the gate values
+  and the expert parameters (the dispatch mask is constant wrt inputs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_dispatch_combine(
+    x,
+    router_logits,
+    expert_fn: Callable,
+    expert_params,
+    axis: str = "ep",
+    capacity: int | None = None,
+):
+    """Route each token to its top-1 expert across the ``axis`` devices.
+
+    Parameters
+    ----------
+    x : ``[T, d]`` this device's token shard.
+    router_logits : ``[T, E]`` routing scores (E = axis size).
+    expert_fn : ``expert_fn(params, tokens[N, d]) -> [N, d]`` — THIS
+        device's expert computation.
+    expert_params : this device's expert parameter pytree.
+    capacity : per-expert slots per source device (default: 2 * ceil(T/E),
+        the usual capacity-factor-2 headroom).
+
+    Returns ``[T, d]`` combined outputs (dropped tokens contribute zeros).
+    """
+    E = lax.axis_size(axis)
+    T, d = x.shape
+    if router_logits.shape != (T, E):
+        raise ValueError(
+            f"router_logits must be [T={T}, E={E}], got "
+            f"{tuple(router_logits.shape)}"
+        )
+    C = capacity if capacity is not None else 2 * (-(-T // E))
+    if C <= 0:
+        raise ValueError(f"capacity must be positive, got {C}")
+
+    gates = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
+    expert_idx = jnp.argmax(router_logits, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)  # [T, E]
+    gate_val = jnp.sum(gates * onehot, axis=-1)  # [T] top-1 prob
+
+    # position of each token within its expert's queue; overflow dropped
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # [T, E] pre-count
+    my_pos = jnp.sum(pos * onehot, axis=-1)  # [T]
+    keep = my_pos < C
+    # dispatch tensor [T, E, C]
+    disp = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(my_pos, C, dtype=x.dtype)[:, None, :]
+        * keep[:, None, None].astype(x.dtype)
+    )
+
+    # [E, C, d]: slot (e, c) holds the token bound for expert e
+    expert_inputs = jnp.einsum("tec,td->ecd", disp, x)
+    # exchange: dim 0 (expert) splits across devices, arrivals stack on a
+    # new source dim -> [E_src, C, d] all bound for MY expert
+    arrived = lax.all_to_all(
+        expert_inputs, axis, split_axis=0, concat_axis=0, tiled=True
+    )
+    outs = expert_fn(expert_params, arrived.reshape(E * C, d)).reshape(
+        E, C, d
+    )
+    # route results back to their source devices
+    returned = lax.all_to_all(
+        outs, axis, split_axis=0, concat_axis=0, tiled=True
+    )
+    # combine: scatter back to token order, weighted by the gate prob
+    y = jnp.einsum("tec,ecd->td", disp, returned)
+    return y * gate_val[:, None]
+
+
+def moe_load_stats(router_logits, axis: str = "ep"):
+    """(tokens_per_expert[E], aux_load_balance_loss) — the standard
+    mean-gate x mean-assignment auxiliary loss that discourages expert
+    collapse."""
+    E = lax.axis_size(axis)
+    gates = jax.nn.softmax(router_logits, axis=-1)
+    assign = jax.nn.one_hot(
+        jnp.argmax(router_logits, axis=-1), E, dtype=gates.dtype
+    )
+    # global statistics across every device's token shard
+    tokens_per_expert = lax.psum(jnp.sum(assign, axis=0), axis)
+    me = lax.pmean(jnp.mean(gates, axis=0), axis)
+    ce = lax.pmean(jnp.mean(assign, axis=0), axis)
+    return tokens_per_expert, E * jnp.sum(me * ce)
