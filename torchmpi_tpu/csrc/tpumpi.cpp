@@ -20,6 +20,7 @@
 // runtime around it, mirroring where the reference spent native code.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -128,30 +129,151 @@ class ThreadPool {
   bool stop_;
 };
 
-std::mutex g_pool_mutex;
-std::unordered_map<int64_t, std::unique_ptr<ThreadPool>> g_pools;
-int64_t g_next_pool = 0;
+// Shared id->object registry. INTENTIONALLY LEAKED (heap-allocated,
+// accessor-scoped): worker threads of pools leaked at interpreter exit may
+// still touch the handle registry, and C++ static destruction order would
+// otherwise tear that registry down first (use-after-destruction). Leaked
+// registries are immortal; live threads simply die with the process.
+template <class T>
+struct Registry {
+  std::mutex m;
+  std::unordered_map<int64_t, std::unique_ptr<T>> map;
+  int64_t next = 0;
+
+  int64_t insert(std::unique_ptr<T> obj) {
+    std::lock_guard<std::mutex> lock(m);
+    int64_t id = next++;
+    map[id] = std::move(obj);
+    return id;
+  }
+
+  // destroy outside the lock (destructors join worker threads)
+  void destroy(int64_t id) {
+    std::unique_ptr<T> dying;
+    {
+      std::lock_guard<std::mutex> lock(m);
+      auto it = map.find(id);
+      if (it == map.end()) return;
+      dying = std::move(it->second);
+      map.erase(it);
+    }
+  }
+
+  // run fn(obj) under the lock; returns -1 for unknown ids (the lock also
+  // orders enqueue against a concurrent destroy's move-out)
+  template <class F>
+  int with(int64_t id, F fn) {
+    std::lock_guard<std::mutex> lock(m);
+    auto it = map.find(id);
+    if (it == map.end()) return -1;
+    return fn(*it->second);
+  }
+};
+
+Registry<ThreadPool>& pool_registry() {
+  static Registry<ThreadPool>* r = new Registry<ThreadPool>();
+  return *r;
+}
 
 }  // namespace
 
 TPUMPI_API int64_t tpumpi_pool_create(int64_t num_threads) {
-  std::lock_guard<std::mutex> lock(g_pool_mutex);
-  int64_t id = g_next_pool++;
-  g_pools[id] =
-      std::make_unique<ThreadPool>(static_cast<size_t>(num_threads));
-  return id;
+  if (num_threads <= 0) return -1;  // a worker-less pool would hang waits
+  return pool_registry().insert(
+      std::make_unique<ThreadPool>(static_cast<size_t>(num_threads)));
 }
 
 TPUMPI_API void tpumpi_pool_destroy(int64_t pool) {
-  std::unique_ptr<ThreadPool> dying;
-  {
-    std::lock_guard<std::mutex> lock(g_pool_mutex);
-    auto it = g_pools.find(pool);
-    if (it == g_pools.end()) return;
-    dying = std::move(it->second);
-    g_pools.erase(it);
+  pool_registry().destroy(pool);
+}
+
+// forward decl (defined with the handle registry below)
+TPUMPI_API void tpumpi_handle_complete(int64_t id, int64_t status);
+
+// Enqueue a task that completes `handle` on a worker thread — the
+// enqueue -> future contract of the reference pool (`ThreadPool::enqueue`
+// returning std::future); the Python side waits the handle.
+TPUMPI_API int tpumpi_pool_enqueue_signal(int64_t pool, int64_t handle) {
+  return pool_registry().with(pool, [handle](ThreadPool& p) {
+    p.enqueue([handle] { tpumpi_handle_complete(handle, 0); });
+    return 0;
+  });
+}
+
+// ---------------------------------------------------------------------------
+// Bounded SPMC pool (≅ lib/spmc_thread_pool-in.h): fixed-capacity task
+// ring, non-blocking enqueue (returns -1 when full), workers poll with the
+// reference's 500µs sleep cadence instead of a condvar.
+// ---------------------------------------------------------------------------
+namespace {
+
+class SpmcPool {
+ public:
+  SpmcPool(size_t threads, size_t capacity)
+      : capacity_(capacity), stop_(false) {
+    for (size_t i = 0; i < threads; ++i) {
+      workers_.emplace_back([this] {
+        for (;;) {
+          int64_t handle = -1;
+          {
+            std::lock_guard<std::mutex> lock(mutex_);
+            if (!queue_.empty()) {
+              handle = queue_.front();
+              queue_.pop_front();
+            } else if (stop_.load()) {
+              return;
+            }
+          }
+          if (handle >= 0) {
+            tpumpi_handle_complete(handle, 0);
+          } else {
+            std::this_thread::sleep_for(std::chrono::microseconds(500));
+          }
+        }
+      });
+    }
   }
-  // destructor joins outside the registry lock
+
+  ~SpmcPool() {
+    stop_.store(true);
+    for (auto& w : workers_) w.join();
+  }
+
+  int try_enqueue(int64_t handle) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    if (queue_.size() >= capacity_) return -1;  // bounded: caller backs off
+    queue_.push_back(handle);
+    return 0;
+  }
+
+ private:
+  size_t capacity_;
+  std::atomic<bool> stop_;
+  std::deque<int64_t> queue_;
+  std::mutex mutex_;
+  std::vector<std::thread> workers_;
+};
+
+Registry<SpmcPool>& spmc_registry() {
+  static Registry<SpmcPool>* r = new Registry<SpmcPool>();
+  return *r;
+}
+
+}  // namespace
+
+TPUMPI_API int64_t tpumpi_spmc_create(int64_t threads, int64_t capacity) {
+  if (threads <= 0 || capacity <= 0) return -1;
+  return spmc_registry().insert(std::make_unique<SpmcPool>(
+      static_cast<size_t>(threads), static_cast<size_t>(capacity)));
+}
+
+TPUMPI_API int tpumpi_spmc_enqueue_signal(int64_t pool, int64_t handle) {
+  return spmc_registry().with(
+      pool, [handle](SpmcPool& p) { return p.try_enqueue(handle); });
+}
+
+TPUMPI_API void tpumpi_spmc_destroy(int64_t pool) {
+  spmc_registry().destroy(pool);
 }
 
 // ---------------------------------------------------------------------------
@@ -167,23 +289,34 @@ struct Handle {
   Handle() : future(promise.get_future().share()) {}
 };
 
-std::mutex g_handle_mutex;
-std::unordered_map<int64_t, std::shared_ptr<Handle>> g_handles;
-int64_t g_next_handle = 0;
+// immortal (leaked) for the same reason as the pool registries: leaked
+// pools' worker threads may complete handles during interpreter exit
+struct HandleRegistry {
+  std::mutex m;
+  std::unordered_map<int64_t, std::shared_ptr<Handle>> map;
+  int64_t next = 0;
+};
+
+HandleRegistry& handle_registry() {
+  static HandleRegistry* r = new HandleRegistry();
+  return *r;
+}
 
 std::shared_ptr<Handle> take_handle(int64_t id) {
-  std::lock_guard<std::mutex> lock(g_handle_mutex);
-  auto it = g_handles.find(id);
-  if (it == g_handles.end()) return nullptr;
+  auto& reg = handle_registry();
+  std::lock_guard<std::mutex> lock(reg.m);
+  auto it = reg.map.find(id);
+  if (it == reg.map.end()) return nullptr;
   return it->second;
 }
 
 }  // namespace
 
 TPUMPI_API int64_t tpumpi_handle_create() {
-  std::lock_guard<std::mutex> lock(g_handle_mutex);
-  int64_t id = g_next_handle++;
-  g_handles[id] = std::make_shared<Handle>();
+  auto& reg = handle_registry();
+  std::lock_guard<std::mutex> lock(reg.m);
+  int64_t id = reg.next++;
+  reg.map[id] = std::make_shared<Handle>();
   return id;
 }
 
@@ -200,14 +333,16 @@ TPUMPI_API int64_t tpumpi_handle_wait(int64_t id) {
   auto h = take_handle(id);
   if (!h) return 0;
   int64_t status = h->future.get();
-  std::lock_guard<std::mutex> lock(g_handle_mutex);
-  g_handles.erase(id);
+  auto& reg = handle_registry();
+  std::lock_guard<std::mutex> lock(reg.m);
+  reg.map.erase(id);
   return status;
 }
 
 TPUMPI_API int64_t tpumpi_handles_outstanding() {
-  std::lock_guard<std::mutex> lock(g_handle_mutex);
-  return static_cast<int64_t>(g_handles.size());
+  auto& reg = handle_registry();
+  std::lock_guard<std::mutex> lock(reg.m);
+  return static_cast<int64_t>(reg.map.size());
 }
 
 // ---------------------------------------------------------------------------

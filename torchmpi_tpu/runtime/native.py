@@ -57,6 +57,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tpumpi_pool_create.argtypes = [c.c_int64]
     lib.tpumpi_pool_create.restype = c.c_int64
     lib.tpumpi_pool_destroy.argtypes = [c.c_int64]
+    lib.tpumpi_pool_enqueue_signal.argtypes = [c.c_int64, c.c_int64]
+    lib.tpumpi_pool_enqueue_signal.restype = c.c_int
+
+    lib.tpumpi_spmc_create.argtypes = [c.c_int64, c.c_int64]
+    lib.tpumpi_spmc_create.restype = c.c_int64
+    lib.tpumpi_spmc_enqueue_signal.argtypes = [c.c_int64, c.c_int64]
+    lib.tpumpi_spmc_enqueue_signal.restype = c.c_int
+    lib.tpumpi_spmc_destroy.argtypes = [c.c_int64]
 
     lib.tpumpi_handle_create.restype = c.c_int64
     lib.tpumpi_handle_complete.argtypes = [c.c_int64, c.c_int64]
