@@ -55,6 +55,26 @@ _WORKER = textwrap.dedent(
     out = mpi.allreduce_tensor(arr)
     local = np.asarray(out.addressable_shards[0].data)
     assert (local == p * (p - 1) / 2).all(), local
+
+    # hierarchical ring allreduce on the auto-pushed per-node level: the
+    # intra ring rides each process's devices, the inter ring crosses the
+    # processes (2x2 cartesian comm built by start()'s ici-group split)
+    hcomm = mpi.stack().at(1)
+    assert hcomm.cartesian and hcomm.num_intra_groups == nproc
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    big = jax.make_array_from_callback(
+        (p, 700),
+        NamedSharding(hcomm.flat_mesh("mpi"), P("mpi")),
+        lambda idx: np.full((1, 700), float(idx[0].start or 0), np.float32),
+    )
+    hout = mpi.ring.allreduce_tensor(big, comm=hcomm)
+    hlocal = np.asarray(hout.addressable_shards[0].data)
+    assert (hlocal == p * (p - 1) / 2).all(), hlocal
+    assert any(
+        k[0] in ("hier_allreduce", "staged_allreduce")
+        for k in hcomm._collective_resources
+    ), "hierarchical path not taken cross-process"
+
     mpi.barrier()
     mpi.stop()
     print(f"proc {{pid}} OK")

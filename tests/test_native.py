@@ -297,7 +297,7 @@ def test_pool_enqueue_signal_completes_handles():
         assert lib.tpumpi_pool_enqueue_signal(pool, h) == 0
     for h in handles:
         assert lib.tpumpi_handle_wait(h) == 0
-    assert lib.tpumpi_pool_enqueue_signal(999999, 0) == -1  # unknown pool
+    assert lib.tpumpi_pool_enqueue_signal(999999, 0) == -2  # unknown pool
     lib.tpumpi_pool_destroy(pool)
 
 

@@ -111,12 +111,14 @@ class ThreadPool {
     for (auto& w : workers_) w.join();
   }
 
-  void enqueue(std::function<void()> fn) {
+  bool enqueue(std::function<void()> fn) {
     {
       std::lock_guard<std::mutex> lock(mutex_);
+      if (stop_) return false;  // destructor already draining
       tasks_.push_back(std::move(fn));
     }
     cv_.notify_one();
+    return true;
   }
 
   size_t size() const { return workers_.size(); }
@@ -134,22 +136,33 @@ class ThreadPool {
 // still touch the handle registry, and C++ static destruction order would
 // otherwise tear that registry down first (use-after-destruction). Leaked
 // registries are immortal; live threads simply die with the process.
+// shared_ptr holders: callers copy the pointer out under the (brief) map
+// lock and operate outside it, so per-object work never contends the
+// global lock; objects whose destructor joins threads refuse late work
+// (enqueue checks their stop flag) instead of hanging it.
 template <class T>
 struct Registry {
   std::mutex m;
-  std::unordered_map<int64_t, std::unique_ptr<T>> map;
+  std::unordered_map<int64_t, std::shared_ptr<T>> map;
   int64_t next = 0;
 
-  int64_t insert(std::unique_ptr<T> obj) {
+  int64_t insert(std::shared_ptr<T> obj) {
     std::lock_guard<std::mutex> lock(m);
     int64_t id = next++;
     map[id] = std::move(obj);
     return id;
   }
 
-  // destroy outside the lock (destructors join worker threads)
+  std::shared_ptr<T> get(int64_t id) {
+    std::lock_guard<std::mutex> lock(m);
+    auto it = map.find(id);
+    return it == map.end() ? nullptr : it->second;
+  }
+
+  // remove from the map; the object dies when the LAST holder (possibly a
+  // caller mid-operation) drops its reference, outside this lock
   void destroy(int64_t id) {
-    std::unique_ptr<T> dying;
+    std::shared_ptr<T> dying;
     {
       std::lock_guard<std::mutex> lock(m);
       auto it = map.find(id);
@@ -159,14 +172,9 @@ struct Registry {
     }
   }
 
-  // run fn(obj) under the lock; returns -1 for unknown ids (the lock also
-  // orders enqueue against a concurrent destroy's move-out)
-  template <class F>
-  int with(int64_t id, F fn) {
+  size_t size() {
     std::lock_guard<std::mutex> lock(m);
-    auto it = map.find(id);
-    if (it == map.end()) return -1;
-    return fn(*it->second);
+    return map.size();
   }
 };
 
@@ -180,7 +188,7 @@ Registry<ThreadPool>& pool_registry() {
 TPUMPI_API int64_t tpumpi_pool_create(int64_t num_threads) {
   if (num_threads <= 0) return -1;  // a worker-less pool would hang waits
   return pool_registry().insert(
-      std::make_unique<ThreadPool>(static_cast<size_t>(num_threads)));
+      std::make_shared<ThreadPool>(static_cast<size_t>(num_threads)));
 }
 
 TPUMPI_API void tpumpi_pool_destroy(int64_t pool) {
@@ -193,11 +201,11 @@ TPUMPI_API void tpumpi_handle_complete(int64_t id, int64_t status);
 // Enqueue a task that completes `handle` on a worker thread — the
 // enqueue -> future contract of the reference pool (`ThreadPool::enqueue`
 // returning std::future); the Python side waits the handle.
+// Returns 0 ok, -2 unknown/destroyed pool (NOT retryable).
 TPUMPI_API int tpumpi_pool_enqueue_signal(int64_t pool, int64_t handle) {
-  return pool_registry().with(pool, [handle](ThreadPool& p) {
-    p.enqueue([handle] { tpumpi_handle_complete(handle, 0); });
-    return 0;
-  });
+  auto p = pool_registry().get(pool);
+  if (!p) return -2;
+  return p->enqueue([handle] { tpumpi_handle_complete(handle, 0); }) ? 0 : -2;
 }
 
 // ---------------------------------------------------------------------------
@@ -239,8 +247,10 @@ class SpmcPool {
     for (auto& w : workers_) w.join();
   }
 
+  // 0 ok; -1 full (transient: back off and retry); -2 stopping (permanent)
   int try_enqueue(int64_t handle) {
     std::lock_guard<std::mutex> lock(mutex_);
+    if (stop_.load()) return -2;
     if (queue_.size() >= capacity_) return -1;  // bounded: caller backs off
     queue_.push_back(handle);
     return 0;
@@ -263,13 +273,15 @@ Registry<SpmcPool>& spmc_registry() {
 
 TPUMPI_API int64_t tpumpi_spmc_create(int64_t threads, int64_t capacity) {
   if (threads <= 0 || capacity <= 0) return -1;
-  return spmc_registry().insert(std::make_unique<SpmcPool>(
+  return spmc_registry().insert(std::make_shared<SpmcPool>(
       static_cast<size_t>(threads), static_cast<size_t>(capacity)));
 }
 
+// 0 ok; -1 ring full (retryable); -2 unknown/destroyed pool (permanent)
 TPUMPI_API int tpumpi_spmc_enqueue_signal(int64_t pool, int64_t handle) {
-  return spmc_registry().with(
-      pool, [handle](SpmcPool& p) { return p.try_enqueue(handle); });
+  auto p = spmc_registry().get(pool);
+  if (!p) return -2;
+  return p->try_enqueue(handle);
 }
 
 TPUMPI_API void tpumpi_spmc_destroy(int64_t pool) {
@@ -291,33 +303,19 @@ struct Handle {
 
 // immortal (leaked) for the same reason as the pool registries: leaked
 // pools' worker threads may complete handles during interpreter exit
-struct HandleRegistry {
-  std::mutex m;
-  std::unordered_map<int64_t, std::shared_ptr<Handle>> map;
-  int64_t next = 0;
-};
-
-HandleRegistry& handle_registry() {
-  static HandleRegistry* r = new HandleRegistry();
+Registry<Handle>& handle_registry() {
+  static Registry<Handle>* r = new Registry<Handle>();
   return *r;
 }
 
 std::shared_ptr<Handle> take_handle(int64_t id) {
-  auto& reg = handle_registry();
-  std::lock_guard<std::mutex> lock(reg.m);
-  auto it = reg.map.find(id);
-  if (it == reg.map.end()) return nullptr;
-  return it->second;
+  return handle_registry().get(id);
 }
 
 }  // namespace
 
 TPUMPI_API int64_t tpumpi_handle_create() {
-  auto& reg = handle_registry();
-  std::lock_guard<std::mutex> lock(reg.m);
-  int64_t id = reg.next++;
-  reg.map[id] = std::make_shared<Handle>();
-  return id;
+  return handle_registry().insert(std::make_shared<Handle>());
 }
 
 // Idempotent: the second and later completes are no-ops (a throwing
@@ -333,16 +331,12 @@ TPUMPI_API int64_t tpumpi_handle_wait(int64_t id) {
   auto h = take_handle(id);
   if (!h) return 0;
   int64_t status = h->future.get();
-  auto& reg = handle_registry();
-  std::lock_guard<std::mutex> lock(reg.m);
-  reg.map.erase(id);
+  handle_registry().destroy(id);
   return status;
 }
 
 TPUMPI_API int64_t tpumpi_handles_outstanding() {
-  auto& reg = handle_registry();
-  std::lock_guard<std::mutex> lock(reg.m);
-  return static_cast<int64_t>(reg.map.size());
+  return static_cast<int64_t>(handle_registry().size());
 }
 
 // ---------------------------------------------------------------------------
