@@ -23,6 +23,10 @@ def test_blocksequential_2host_example():
     """BASELINE.json config #5 at test scale: block-partitioned async
     gradient allreduce over a 2-host hierarchical communicator converges
     and actually routes through the hierarchical composition."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices (2 hosts x intra groups > 1)")
     from examples.blocksequential_2host import main
 
     losses, acc, hier_used = main(
@@ -38,8 +42,14 @@ def test_resnet50_dp_e2e_example():
     """BASELINE.json config #4 at test scale: the ResNet-50 data-parallel
     example runs end-to-end on the virtual 8-mesh — synthetic ImageNet
     pipeline, engine with batch-stats sync, device-resident epochs, eval."""
+    import jax
+
     from examples.resnet_allreduce import main
 
+    # constant GLOBAL batch 16 across mesh sizes: a tiny per-device batch
+    # on a 1-device mesh makes BN + momentum diverge (NaN), which is a
+    # hyperparameter effect, not a framework bug
+    per_rank = max(1, 16 // len(jax.devices()))
     state, acc = main(
         [
             "--model", "resnet50",
@@ -47,7 +57,7 @@ def test_resnet50_dp_e2e_example():
             "--image-size", "32",
             "--train", "64",
             "--test", "32",
-            "--per-rank-batch", "2",
+            "--per-rank-batch", str(per_rank),
             "--epochs", "1",
         ]
     )

@@ -114,7 +114,7 @@ class ThreadPool {
   bool enqueue(std::function<void()> fn) {
     {
       std::lock_guard<std::mutex> lock(mutex_);
-      if (stop_) return false;  // destructor already draining
+      if (stop_) return false;  // defense-in-depth; see Registry comment
       tasks_.push_back(std::move(fn));
     }
     cv_.notify_one();
@@ -138,8 +138,11 @@ class ThreadPool {
 // registries are immortal; live threads simply die with the process.
 // shared_ptr holders: callers copy the pointer out under the (brief) map
 // lock and operate outside it, so per-object work never contends the
-// global lock; objects whose destructor joins threads refuse late work
-// (enqueue checks their stop flag) instead of hanging it.
+// global lock. Destroy-vs-use safety: a caller's shared_ptr keeps the
+// object alive past destroy(), and the pool destructors DRAIN their task
+// queues before workers exit, so even an enqueue racing a destroy has its
+// task completed (the stop-flag checks in the enqueue paths are pure
+// defense-in-depth — unreachable while any caller holds a reference).
 template <class T>
 struct Registry {
   std::mutex m;
@@ -247,7 +250,8 @@ class SpmcPool {
     for (auto& w : workers_) w.join();
   }
 
-  // 0 ok; -1 full (transient: back off and retry); -2 stopping (permanent)
+  // 0 ok; -1 full (transient: back off and retry); -2 stopping
+  // (defense-in-depth; see Registry comment)
   int try_enqueue(int64_t handle) {
     std::lock_guard<std::mutex> lock(mutex_);
     if (stop_.load()) return -2;
