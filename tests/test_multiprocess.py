@@ -124,6 +124,19 @@ _PS_WORKER = textwrap.dedent(
                     scale=-lr,
                 )
                 h.wait()
+    # regression: a PS on a communicator whose devices all live in THIS
+    # process must not require the other process to participate (the old
+    # job-global barriers would hang here)
+    from torchmpi_tpu.runtime.communicator import Communicator
+    local_devs = [d for d in comm.devices if d.process_index == pid]
+    solo = ps.ParameterServer(
+        np.full(8, float(pid), np.float32),
+        comm=Communicator(local_devs, name=f"solo{{pid}}"),
+    )
+    solo.send(np.ones(8, np.float32), rule="add", client=0).wait()
+    np.testing.assert_allclose(solo.receive().wait(), pid + 1.0)
+    solo.free()
+
     mpi.barrier()
     got = center.receive(client=local_ranks()[0]).wait()
 
