@@ -23,7 +23,15 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=336)
     ap.add_argument("--train", type=int, default=8192)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--cpu", action="store_true", help="force the CPU backend"
+    )
     args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     import jax
     import jax.numpy as jnp

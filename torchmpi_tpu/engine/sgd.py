@@ -103,12 +103,22 @@ class AllReduceSGDEngine:
         hooks: Optional[Dict[str, Callable]] = None,
         batch_format: str = "auto",
         model_state=None,
+        param_sharding: str = "replicated",
     ):
         """``model_state``: optional mutable-collection pytree (e.g. flax
         ``batch_stats``). When given, ``loss_fn`` must have the signature
         ``loss_fn(params, state, batch) -> (loss, new_state)``; the state is
         pmean-synchronized across ranks every step (cross-replica batch-norm
-        statistics)."""
+        statistics).
+
+        ``param_sharding``: 'replicated' (the reference's model — every
+        rank holds full params, gradients allreduced) or 'fsdp' (ZeRO-3
+        style: params/optimizer state SHARDED over the data axis, one
+        logical copy; XLA/GSPMD inserts the gather/reduce-scatter
+        collectives). fsdp requires mode='sync' and
+        average_gradients=True (the loss is a global-batch mean, so
+        gradients are means by construction); it is a capability
+        extension — the reference has no sharded-optimizer mode."""
         if comm is None:
             from .. import runtime_state
 
@@ -119,6 +129,17 @@ class AllReduceSGDEngine:
             raise ValueError(
                 f"batch_format must be auto/flat/stacked, got {batch_format!r}"
             )
+        if param_sharding not in ("replicated", "fsdp"):
+            raise ValueError(
+                f"param_sharding must be replicated/fsdp, got {param_sharding!r}"
+            )
+        if param_sharding == "fsdp" and (mode != "sync" or not average_gradients):
+            raise ValueError(
+                "param_sharding='fsdp' requires mode='sync' and "
+                "average_gradients=True (the global-batch loss already "
+                "yields mean gradients; XLA schedules the overlap)"
+            )
+        self.param_sharding = param_sharding
         self.batch_format = batch_format
         self.comm = comm
         self.loss_fn = loss_fn
@@ -137,22 +158,50 @@ class AllReduceSGDEngine:
         self.batch_sharding = NamedSharding(self.mesh, P(_AXIS))
         self.replicated = NamedSharding(self.mesh, P())
 
-        # Replicate initial params/opt state across the communicator. Copy
-        # defensively: device_put may alias the caller's buffers when the
-        # sharding already matches (single device), and the jitted step
+        def _leaf_sharding(a) -> NamedSharding:
+            if self.param_sharding == "replicated":
+                return self.replicated
+            # fsdp: shard each leaf along its first axis divisible by the
+            # world size (falls back to replication for small/odd leaves)
+            p = self.comm.size
+            for i, dim in enumerate(np.shape(a)):
+                if dim >= p and dim % p == 0:
+                    return NamedSharding(
+                        self.mesh, P(*([None] * i), _AXIS)
+                    )
+            return self.replicated
+
+        # Place initial params/opt state (replicated, or fsdp-sharded).
+        # Copy defensively: device_put may alias the caller's buffers when
+        # the sharding already matches (single device), and the jitted step
         # DONATES its inputs — without the copy, the caller's params would
         # be deleted by the first step.
         def _own(tree):
-            return jax.device_put(
-                jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), tree),
-                self.replicated,
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(
+                    jnp.array(a, copy=True), _leaf_sharding(a)
+                ),
+                tree,
             )
+
+        if (
+            self.param_sharding == "fsdp"
+            and broadcast_parameters
+            and jax.process_count() > 1
+        ):
+            # the one-shot replica equalization happens BEFORE sharding in
+            # fsdp mode: each process's shards are filled from its host
+            # copy, so differing per-process inits must be reconciled here
+            # (afterwards there is exactly one logical copy)
+            from jax.experimental import multihost_utils
+
+            params = multihost_utils.broadcast_one_to_all(params)
+            if model_state is not None:
+                model_state = multihost_utils.broadcast_one_to_all(model_state)
 
         self.params = _own(params)
         self.model_state = _own(model_state) if model_state is not None else None
-        self.opt_state = jax.device_put(
-            self.optimizer.init(params), self.replicated
-        )
+        self.opt_state = _own(self.optimizer.init(params))
         self._step_fn = self._build_step()
         self._bcast_fn = self._build_broadcast()
         self._epoch_fns: Dict[tuple, Callable] = {}
@@ -187,7 +236,26 @@ class AllReduceSGDEngine:
         loss = jax.lax.pmean(loss, _AXIS)
         return params, opt_state, new_state, loss
 
+    def _fsdp_step_core(self, params, opt_state, model_state, batch):
+        """GSPMD step: ONE logical computation over the global batch; the
+        sharded params/opt-state make XLA insert the all-gathers before
+        use and reduce-scatter the gradients — ZeRO-3 for free from the
+        sharding annotations."""
+        loss_fn, optimizer = self.loss_fn, self.optimizer
+        if model_state is not None:
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, model_state, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_state = model_state
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_state, loss
+
     def _build_step(self):
+        if self.param_sharding == "fsdp":
+            return jax.jit(self._fsdp_step_core, donate_argnums=(0, 1, 2))
         shmapped = jax.shard_map(
             self._step_core,
             mesh=self.mesh,
@@ -198,6 +266,11 @@ class AllReduceSGDEngine:
         return jax.jit(shmapped, donate_argnums=(0, 1, 2))
 
     def _build_broadcast(self):
+        if self.param_sharding == "fsdp":
+            # one logical (sharded) copy: nothing to equalize at step time
+            # (multi-process init divergence was reconciled host-side in
+            # __init__ before sharding)
+            return lambda p: p
         bcast = jax.shard_map(
             lambda p: mpinn.in_graph_synchronize_parameters(p, _AXIS, 0),
             mesh=self.mesh,
@@ -261,6 +334,62 @@ class AllReduceSGDEngine:
         if fn is not None:
             return fn
         B, nb = per_rank, num_batches
+
+        if self.param_sharding == "fsdp":
+            p = self.comm.size
+
+            def fsdp_epoch(params, opt_state, model_state, xs, ys, rngkey):
+                # identical data partitioning to the replicated path: rank
+                # r draws from its contiguous shard [r*ns, (r+1)*ns) with
+                # its own fold_in(key, r) permutation, so both modes walk
+                # the exact same batch sequence (trajectory parity). The
+                # gather is expressed SHARD-LOCALLY — a vmapped per-row
+                # take whose leading axis aligns with the P(_AXIS) sharding
+                # — so GSPMD keeps batch assembly on-device per shard (a
+                # flat global take with data-dependent indices would force
+                # a dataset-sized collective per step).
+                ns = xs.shape[0] // p
+                xs_r = xs.reshape((p, ns) + xs.shape[1:])
+                ys_r = ys.reshape((p, ns) + ys.shape[1:])
+                if shuffle:
+                    perms = jax.vmap(
+                        lambda r: jax.random.permutation(
+                            jax.random.fold_in(rngkey, r), ns
+                        )
+                    )(jnp.arange(p))
+                else:
+                    perms = jnp.tile(jnp.arange(ns)[None], (p, 1))
+
+                take_rows = jax.vmap(
+                    lambda row, ii: jnp.take(row, ii, axis=0)
+                )
+
+                def body(carry, i):
+                    params, opt_state, model_state = carry
+                    idx = jax.lax.dynamic_slice_in_dim(
+                        perms, i * B, B, axis=1
+                    )  # [p, B] per-rank LOCAL indices
+                    xb = take_rows(xs_r, idx)
+                    yb = take_rows(ys_r, idx)
+                    batch = (
+                        xb.reshape((p * B,) + xs.shape[1:]),
+                        yb.reshape((p * B,) + ys.shape[1:]),
+                    )
+                    params, opt_state, model_state, loss = (
+                        self._fsdp_step_core(
+                            params, opt_state, model_state, batch
+                        )
+                    )
+                    return (params, opt_state, model_state), loss
+
+                (params, opt_state, model_state), losses = jax.lax.scan(
+                    body, (params, opt_state, model_state), jnp.arange(nb)
+                )
+                return params, opt_state, model_state, losses
+
+            fn = jax.jit(fsdp_epoch, donate_argnums=(0, 1, 2))
+            self._epoch_fns[key] = fn
+            return fn
 
         def epoch(params, opt_state, model_state, xs, ys, rngkey):
             # xs/ys: per-rank shard [ns, ...], ns >= nb*B.
