@@ -24,43 +24,67 @@ def _ckptr():
     return ocp.PyTreeCheckpointer()
 
 
+def _engine_state(engine) -> Dict[str, Any]:
+    state = {"params": engine.params, "opt_state": engine.opt_state}
+    if engine.model_state is not None:
+        state["model_state"] = engine.model_state
+    return state
+
+
 def save_engine(path, engine, step: int = 0, extra: Optional[Dict] = None) -> None:
-    """Save an AllReduceSGDEngine's full training state."""
+    """Save an AllReduceSGDEngine's full training state.
+
+    Multi-process (multi-controller) runs hand the LIVE jax arrays to
+    Orbax — sharded/non-addressable arrays (fsdp over processes) are
+    written cooperatively by all hosts; ``jax.device_get`` would raise on
+    them. Single-process saves go through host numpy (robust for typed
+    optax nodes and independent of live placement).
+    """
     path = Path(path).resolve()
     path.mkdir(parents=True, exist_ok=True)
-    state = {
-        "params": jax.device_get(engine.params),
-        "opt_state": jax.device_get(engine.opt_state),
-    }
-    if engine.model_state is not None:
-        state["model_state"] = jax.device_get(engine.model_state)
+    if jax.process_count() > 1:
+        state = _engine_state(engine)
+    else:
+        state = jax.tree_util.tree_map(
+            lambda a: jax.device_get(a), _engine_state(engine)
+        )
     _ckptr().save(path / "state", state, force=True)
-    meta = {"step": int(step), "mode": engine.mode, **(extra or {})}
-    (path / "meta.json").write_text(json.dumps(meta))
+    if jax.process_index() == 0:
+        meta = {"step": int(step), "mode": engine.mode, **(extra or {})}
+        (path / "meta.json").write_text(json.dumps(meta))
 
 
 def restore_engine(path, engine) -> Dict[str, Any]:
-    """Restore state saved by :func:`save_engine` into the engine (device
-    placement follows the engine's replicated sharding). Returns the meta
-    dict (incl. ``step``).
+    """Restore state saved by :func:`save_engine` into the engine. Device
+    placement follows each live leaf's CURRENT sharding — replicated
+    engines restore replicated, fsdp engines restore sharded (densifying
+    to replicated would silently drop ZeRO-3 and force a recompile).
+    Returns the meta dict (incl. ``step``).
 
     The engine's current state is passed as the restore template so typed
     pytree nodes (optax namedtuple states like ScaleByAdamState) come back
     with their original structure instead of plain lists/dicts."""
     path = Path(path).resolve()
-    template = {
-        "params": jax.device_get(engine.params),
-        "opt_state": jax.device_get(engine.opt_state),
-    }
-    if engine.model_state is not None:
-        template["model_state"] = jax.device_get(engine.model_state)
-    state = _ckptr().restore(path / "state", item=template)
-    engine.params = jax.device_put(state["params"], engine.replicated)
-    engine.opt_state = jax.device_put(state["opt_state"], engine.replicated)
-    if "model_state" in state and engine.model_state is not None:
-        engine.model_state = jax.device_put(
-            state["model_state"], engine.replicated
+    live = _engine_state(engine)
+    if jax.process_count() > 1:
+        # cooperative multi-host restore straight into the live shardings
+        import orbax.checkpoint as ocp
+
+        restore_args = ocp.checkpoint_utils.construct_restore_args(live)
+        state = _ckptr().restore(
+            path / "state", item=live, restore_args=restore_args
         )
+    else:
+        template = jax.tree_util.tree_map(lambda a: jax.device_get(a), live)
+        restored = _ckptr().restore(path / "state", item=template)
+        state = jax.tree_util.tree_map(
+            lambda cur, new: jax.device_put(new, cur.sharding), live, restored
+        )
+
+    engine.params = state["params"]
+    engine.opt_state = state["opt_state"]
+    if "model_state" in state and engine.model_state is not None:
+        engine.model_state = state["model_state"]
     return json.loads((path / "meta.json").read_text())
 
 
