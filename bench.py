@@ -1,47 +1,158 @@
 """Benchmark entry point (driver-run on real TPU hardware).
 
-Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+Prints JSON lines; the LAST line is the driver-tracked north-star metric:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
 
 North-star metric (BASELINE.json): MNIST AllReduceSGD samples/sec/chip.
 The reference publishes no absolute numbers (BASELINE.md) — its harness is
 the protocol (10 warmup + 10 timed, tester.lua:103-126). ``vs_baseline``
 is measured against the recorded first-light number in
-``bench_baseline.json`` (value 1.0 means parity with round-1's recording;
-higher is better). If that file is absent, vs_baseline is 1.0.
+``bench_baseline.json`` (1.0 = parity with round-1's recording).
 
-Design (round 2): the dataset is staged into HBM ONCE and every epoch runs
-as a single scan-compiled dispatch (`engine.train_resident`) — batches are
-gathered on-device, so there is zero per-step host<->device traffic. Round
-1 streamed 12.8MB/step through the host tunnel (~12 GB/s), which made the
-measured number mostly transfer variance (driver run: 95k vs local 340k).
-Timing protocol: 1 warmup epoch (compile + steady-state), then timed
-epochs; a steady-state guard drops any epoch >2x slower than the fastest
-(stragglers from host jitter), keeping the reported number reproducible.
+Capture-proofing (round 3): the TPU tunnel on this box can make backend
+init *hang*, not just raise (BENCH_r02 was rc=1 on exactly this). So this
+launcher process never imports jax. All measurement happens in a child
+process (``--worker``) under a hard timeout; failures and timeouts retry
+with backoff for a bounded window; on final failure the launcher still
+prints a parseable ``{"metric":..., "error":...}`` JSON line and exits 0,
+so the driver records a structured failure instead of a traceback.
+
+Reported context (round 3): each line carries analytic FLOP accounting
+(``torchmpi_tpu/utils/flops.py``) — achieved TFLOP/s/chip and MFU vs the
+chip's bf16 peak. The MNIST LeNet number is *latency-bound* (a ~23 MFLOP
+forward pass cannot fill an MXU; its MFU is honest context, not a target);
+the ResNet-50 line is the *compute-bound* companion, printed as a
+secondary record (the north-star line is printed first and re-printed
+last, so a mid-run kill never loses it). See README.md "Benchmarks".
+
+Design of the measurement itself (round 2): the dataset is staged into HBM
+ONCE and every epoch runs as one scan-compiled dispatch
+(``engine.train_resident``) — batches are gathered on-device, zero
+per-step host<->device traffic. Timing: 1 warmup epoch (compile +
+steady-state), then timed epochs; a steady-state guard drops epochs >2x
+the fastest (host jitter).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent))
+HERE = Path(__file__).resolve().parent
+
+# Launcher budget. Per-attempt hard timeout covers a hung backend init
+# (observed failure mode of the axon tunnel); the overall deadline bounds
+# the retry loop so the driver always gets a line in finite time.
+WORKER_TIMEOUT_S = int(os.environ.get("TORCHMPI_TPU_BENCH_TIMEOUT", "900"))
+TOTAL_DEADLINE_S = int(os.environ.get("TORCHMPI_TPU_BENCH_DEADLINE", "2400"))
+BACKOFFS_S = (20, 45, 90, 90, 90)
 
 
-def main(argv=None):
-    import argparse
+def _run_worker(model: str, timeout_s: float):
+    """Run one measurement in a child process; return (json_dict|None, err)."""
+    cmd = [sys.executable, str(HERE / "bench.py"), "--worker", model]
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=max(60.0, timeout_s),
+            cwd=str(HERE),
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"worker timeout after {int(timeout_s)}s"
+    except Exception as e:  # noqa: BLE001 - launcher must never crash
+        return None, f"worker spawn failed: {e!r}"
+    line = None
+    for raw in (proc.stdout or "").splitlines():
+        raw = raw.strip()
+        if raw.startswith("{"):
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                line = obj
+    if line is not None:
+        return line, None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, f"worker rc={proc.returncode}: " + " | ".join(tail)[-500:]
 
-    ap = argparse.ArgumentParser()
-    ap.add_argument(
-        "--model",
-        default="mnist",
-        choices=["mnist", "resnet50"],
-        help="mnist = the driver-tracked north-star metric; resnet50 = "
-        "BASELINE.json config #4 per-chip img/s",
-    )
-    args = ap.parse_args(argv)
 
+def _measure(model, t0, max_attempts):
+    """Retry-with-backoff capture of one model; returns a JSON dict always
+    (an ``error`` record after final failure)."""
+    last_err = "not attempted"
+    for attempt in range(max_attempts):
+        remaining = TOTAL_DEADLINE_S - (time.monotonic() - t0)
+        if remaining <= 60:
+            last_err = str(last_err) + " (deadline exhausted)"
+            break
+        obj, err = _run_worker(model, min(WORKER_TIMEOUT_S, remaining))
+        if obj is not None:
+            return obj
+        last_err = err
+        print(
+            f"# bench attempt {attempt + 1} for {model} failed: {err}",
+            file=sys.stderr,
+            flush=True,
+        )
+        if attempt + 1 < max_attempts and attempt < len(BACKOFFS_S):
+            remaining = TOTAL_DEADLINE_S - (time.monotonic() - t0)
+            if remaining <= BACKOFFS_S[attempt] + 60:
+                break
+            time.sleep(BACKOFFS_S[attempt])
+    return {
+        "metric": _metric_name(model),
+        "value": None,
+        "unit": _metric_unit(model),
+        "vs_baseline": None,
+        "error": str(last_err)[:500],
+    }
+
+
+def _launcher(models):
+    """Capture + print each model's JSON line, re-printing the north-star
+    (mnist) line LAST so the driver's last-line parse always sees it — even
+    if the process is killed while the secondary (resnet) measurement is
+    still running, the mnist line is already on stdout. Exits 0 always."""
+    t0 = time.monotonic()
+    star = None
+    if "mnist" in models:
+        star = _measure("mnist", t0, max_attempts=len(BACKOFFS_S) + 1)
+        print(json.dumps(star), flush=True)
+    for model in models:
+        if model == "mnist":
+            continue
+        print(json.dumps(_measure(model, t0, max_attempts=2)), flush=True)
+    if star is not None and len(models) > 1:
+        print(json.dumps(star), flush=True)
+    return 0
+
+
+def _metric_name(model):
+    return {
+        "mnist": "MNIST LeNet AllReduceSGD samples/sec/chip",
+        "resnet50": "ResNet-50 synthetic-ImageNet DP img/s/chip",
+    }[model]
+
+
+def _metric_unit(model):
+    return {"mnist": "samples/sec/chip", "resnet50": "img/s/chip"}[model]
+
+
+# --------------------------------------------------------------------------
+# Worker side: actually measures. Runs in a child process under a timeout.
+# --------------------------------------------------------------------------
+
+
+def _worker_setup():
+    sys.path.insert(0, str(HERE))
     import jax
 
     devices = jax.devices()
@@ -56,23 +167,44 @@ def main(argv=None):
         jeb.clear_backends()
         jax.config.update("jax_num_cpu_devices", 8)
         devices = jax.devices()
+    return devices, platform
+
+
+def _steady_rate(state, timed_epochs, p):
+    """samples/sec/chip from train_resident epoch times, jitter-guarded."""
+    times = sorted(state["epoch_times"][1:])
+    good = [t for t in times if t <= 2.0 * times[0]]
+    per_epoch = state["samples"] / (1 + timed_epochs)
+    return per_epoch * len(good) / sum(good) / p
+
+
+def _flops_fields(value, flops_per_sample, device):
+    from torchmpi_tpu.utils.flops import mfu
+
+    achieved, frac = mfu(value, flops_per_sample, device)
+    out = {
+        "flops_per_sample": flops_per_sample,
+        "achieved_tflops_per_chip": round(achieved / 1e12, 4),
+    }
+    out["mfu"] = round(frac, 5) if frac is not None else None
+    return out
+
+
+def _worker_mnist():
+    devices, platform = _worker_setup()
 
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
     import torchmpi_tpu as mpi
     from torchmpi_tpu.engine import AllReduceSGDEngine
     from torchmpi_tpu.models import LeNet, init_params, make_loss_fn
     from torchmpi_tpu.utils import synthetic_mnist
+    from torchmpi_tpu.utils.flops import lenet_forward_flops, train_flops
 
     mpi.start()
     comm = mpi.current_communicator()
     p = comm.size
-
-    if args.model == "resnet50":
-        _bench_resnet50(mpi, comm, p, platform)
-        return
 
     num_train = 65536
     (xtr, ytr), _ = synthetic_mnist(num_train=num_train, num_test=1)
@@ -83,13 +215,10 @@ def main(argv=None):
     )
 
     # Per-chip batch swept under the device-resident path (512..16384):
-    # 2048 beats 4096 by ~6% once per-step host transfers are gone (the
-    # old 4096 sweet spot was measured with the transfer-bound pipeline);
-    # capped so every chip count up to 64 still gets >= 2 batches/epoch.
+    # 2048 beats 4096 by ~6% once per-step host transfers are gone; capped
+    # so every chip count up to 64 still gets >= 2 batches/epoch.
     per_rank = min(2048, max(256, num_train // (2 * p)))
 
-    # One staging + one broadcast + one compile: epoch 0 is the warmup
-    # (compile happens inside it), epochs 1..N are the timed sample.
     timed_epochs = 10
     state = engine.train_resident(
         xtr,
@@ -99,47 +228,42 @@ def main(argv=None):
         image_dtype=jnp.bfloat16,
         seed=1,
     )
-    times = sorted(state["epoch_times"][1:])
-    # Steady-state guard: drop epochs >2x the fastest (host-side jitter —
-    # the compute is identical every epoch).
-    good = [t for t in times if t <= 2.0 * times[0]]
-    samples_per_epoch = state["samples"] / (1 + timed_epochs)
-    samples_per_sec = samples_per_epoch * len(good) / sum(good)
-    value = samples_per_sec / p
+    value = _steady_rate(state, timed_epochs, p)
 
-    baseline_file = Path(__file__).parent / "bench_baseline.json"
     vs = 1.0
+    baseline_file = HERE / "bench_baseline.json"
     if baseline_file.exists():
         try:
             rec = json.loads(baseline_file.read_text())
-            key = f"{platform}"
-            if rec.get(key):
-                vs = value / float(rec[key])
+            if rec.get(platform):
+                vs = value / float(rec[platform])
         except Exception:
             pass
 
-    print(
-        json.dumps(
-            {
-                "metric": "MNIST LeNet AllReduceSGD samples/sec/chip",
-                "value": round(value, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(vs, 3),
-            }
-        )
+    line = {
+        "metric": _metric_name("mnist"),
+        "value": round(value, 1),
+        "unit": _metric_unit("mnist"),
+        "vs_baseline": round(vs, 3),
+        "bound": "latency",  # ~23 MFLOP fwd/sample cannot fill an MXU
+    }
+    line.update(
+        _flops_fields(value, train_flops(lenet_forward_flops()), devices[0])
     )
+    print(json.dumps(line), flush=True)
     mpi.stop()
 
 
-def _bench_resnet50(mpi, comm, p, platform):
+def _worker_resnet50():
     """BASELINE.json config #4: ResNet-50 synthetic-ImageNet DP throughput
-    (img/s/chip), device-resident epochs. Not the driver's tracked metric;
-    run with ``python bench.py --model resnet50``."""
-    import json
+    (img/s/chip), device-resident epochs — the compute-bound companion to
+    the latency-bound LeNet north-star."""
+    devices, platform = _worker_setup()
 
     import jax.numpy as jnp
     import optax
 
+    import torchmpi_tpu as mpi
     from torchmpi_tpu.engine import AllReduceSGDEngine
     from torchmpi_tpu.models import (
         ResNet50,
@@ -147,21 +271,24 @@ def _bench_resnet50(mpi, comm, p, platform):
         make_stateful_loss_fn,
     )
     from torchmpi_tpu.utils import synthetic_imagenet
+    from torchmpi_tpu.utils.flops import resnet_forward_flops, train_flops
+
+    mpi.start()
+    comm = mpi.current_communicator()
+    p = comm.size
 
     on_tpu = platform != "cpu"
     image = 224 if on_tpu else 32
     per_rank = 32 if on_tpu else 2
     num_train = 1024 if on_tpu else 64
+    classes = 1000 if on_tpu else 8
     model = ResNet50(
-        num_classes=1000 if on_tpu else 8,
+        num_classes=classes,
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
     params, stats = init_resnet(model, image)
     (xtr, ytr), _ = synthetic_imagenet(
-        num_train=num_train,
-        num_test=1,
-        num_classes=1000 if on_tpu else 8,
-        image_size=image,
+        num_train=num_train, num_test=1, num_classes=classes, image_size=image
     )
     engine = AllReduceSGDEngine(
         make_stateful_loss_fn(model),
@@ -174,22 +301,50 @@ def _bench_resnet50(mpi, comm, p, platform):
         xtr, ytr, per_rank, max_epochs=1 + epochs,
         image_dtype=jnp.bfloat16 if on_tpu else None,
     )
-    times = sorted(state["epoch_times"][1:])
-    good = [t for t in times if t <= 2.0 * times[0]]
-    per_epoch = state["samples"] / (1 + epochs)
-    value = per_epoch * len(good) / sum(good) / p
-    print(
-        json.dumps(
-            {
-                "metric": "ResNet-50 synthetic-ImageNet DP img/s/chip",
-                "value": round(value, 1),
-                "unit": "img/s/chip",
-                "vs_baseline": 1.0,
-            }
+    value = _steady_rate(state, epochs, p)
+    line = {
+        "metric": _metric_name("resnet50"),
+        "value": round(value, 1),
+        "unit": _metric_unit("resnet50"),
+        "vs_baseline": 1.0,
+        "bound": "compute",
+    }
+    line.update(
+        _flops_fields(
+            value,
+            train_flops(resnet_forward_flops(image, num_classes=classes)),
+            devices[0],
         )
     )
+    print(json.dumps(line), flush=True)
     mpi.stop()
 
 
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--model",
+        default="all",
+        choices=["all", "mnist", "resnet50"],
+        help="all = ResNet-50 secondary line + MNIST north-star line (last)",
+    )
+    ap.add_argument(
+        "--worker",
+        default=None,
+        choices=["mnist", "resnet50"],
+        help="internal: run one measurement in-process (no retry shell)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        {"mnist": _worker_mnist, "resnet50": _worker_resnet50}[args.worker]()
+        return 0
+
+    models = ["resnet50", "mnist"] if args.model == "all" else [args.model]
+    return _launcher(models)
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

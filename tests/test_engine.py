@@ -417,3 +417,52 @@ def test_iterator_batch_divisibility():
         DistributedIterator(
             np.zeros((64, 2)), np.zeros(64), batch_size=9, num_ranks=8
         )
+
+
+def test_fn_key_pins_referents_no_id_reuse():
+    """The eval-fn cache key must never alias across GC: _fn_key pins every
+    captured object (_IdRef holds a strong ref), so a dead model's id can
+    never be recycled into a stale jitted-executable hit."""
+    import gc
+    import weakref
+
+    from torchmpi_tpu.engine.sgd import _fn_key
+
+    class M:
+        pass
+
+    def make(m):
+        return lambda x: (m, x)
+
+    a, b = M(), M()
+    ka, kb = _fn_key(make(a)), _fn_key(make(b))
+    assert ka != kb  # same code object, different captures
+    assert ka == _fn_key(make(a))  # re-created lambda over same model hits
+    wr = weakref.ref(a)
+    del a
+    gc.collect()
+    # the key holds the referent alive: its id cannot be reused while the
+    # cache entry exists, so no fresh object can ever compare equal to ka
+    assert wr() is not None
+    assert _fn_key(make(M())) != ka
+
+
+def test_engine_evaluate_keys_on_captured_values():
+    """Two metric lambdas created on the SAME source line over different
+    captured values must dispatch to different executables (the id()-reuse
+    hazard class: a stale hit would return the first lambda's result)."""
+    (xtr, ytr), (xte, yte) = synthetic_mnist(num_train=64, num_test=64)
+    model = LogisticRegression()
+    params = init_params(model, (1, 28, 28))
+    engine = AllReduceSGDEngine(
+        make_loss_fn(model), params, optimizer=optax.sgd(0.1)
+    )
+    engine.broadcast_parameters_now()
+
+    def metric_for(shift):
+        return lambda logits, y: accuracy(logits, y) + shift
+
+    apply_fn = lambda prm, x: model.apply({"params": prm}, x)  # noqa: E731
+    v0 = engine.evaluate(apply_fn, xte, yte, metric_for(0.0))
+    v1 = engine.evaluate(apply_fn, xte, yte, metric_for(10.0))
+    assert abs((v1 - v0) - 10.0) < 1e-5

@@ -269,3 +269,46 @@ def test_two_process_parameterserver_downpour(tmp_path):
     center as the single-process oracle (the reference's whole point,
     parameterserver.cpp:309-400)."""
     _run_workers(tmp_path, _PS_WORKER, "ps proc {pid} OK")
+
+
+_SCALAR_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import torchmpi_tpu as mpi
+
+    mpi.start(
+        coordinator_address=f"localhost:{{port}}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    # broadcast: every process gets the root's value
+    assert mpi.broadcast_scalar(100 + pid, root=1) == 101
+    # allreduce: everyone gets the sum
+    assert mpi.allreduce_scalar(10.5 + pid) == 10.5 + 11.5
+    # reduce: only the root gets the sum, others keep their input
+    r = mpi.reduce_scalar(3 + pid, root=0)
+    assert r == (7 if pid == 0 else 3 + pid), r
+    # sendreceive: dst adopts src's value, src keeps its own
+    s = mpi.sendreceive_scalar(40 + pid, src=1, dst=0)
+    assert s == 41, s
+    s2 = mpi.sendreceive_scalar(50 + pid, src=0, dst=1)
+    assert s2 == 50, s2
+    # type preservation: ints stay ints
+    assert isinstance(mpi.allreduce_scalar(2), int)
+    mpi.stop()
+    print(f"scalar proc {{pid}} OK")
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_two_process_scalar_collectives(tmp_path):
+    """Scalar broadcast/allreduce/reduce/sendreceive across real processes —
+    parity with the reference's per-C-type scalar surface
+    (torchmpi/init.lua:125-134)."""
+    _run_workers(tmp_path, _SCALAR_WORKER, "scalar proc {pid} OK")

@@ -29,10 +29,13 @@ from .collectives import (
     broadcast_scalar,
     broadcast_tensor,
     collective_availability,
+    free_collective_resources,
     pallas,
+    reduce_scalar,
     reduce_tensor,
     ring,
     selector as collective_selector,
+    sendreceive_scalar,
     sendreceive_tensor,
     wait,
     xla,
@@ -85,12 +88,15 @@ __all__ = [
     "sendreceive_tensor",
     "broadcast_scalar",
     "allreduce_scalar",
+    "reduce_scalar",
+    "sendreceive_scalar",
     "xla",
     "ring",
     "pallas",
     "async_",
     "collective_selector",
     "collective_availability",
+    "free_collective_resources",
     "constants",
     "__version__",
 ]

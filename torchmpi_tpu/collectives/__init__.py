@@ -18,6 +18,7 @@ import jax
 from ..runtime.communicator import Communicator
 from ..runtime.handles import SyncHandle
 from . import eager, primitives
+from .eager import free_collective_resources
 from .selector import collective_availability, selector
 
 
@@ -155,6 +156,42 @@ def allreduce_scalar(value):
     return type(value)(gathered.sum())
 
 
+def reduce_scalar(value, root: int = 0):
+    """Reduce (sum) a host scalar to process ``root``; every other process
+    returns its input unchanged — the per-C-type ``C.torchmpi_reduce_*``
+    surface of the reference (``torchmpi/init.lua:125-134``)."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    if jax.process_index() == root:
+        return type(value)(gathered.sum())
+    return value
+
+
+def sendreceive_scalar(value, src: int, dst: int):
+    """Point-to-point host scalar: process ``dst`` returns ``src``'s value,
+    every other process (including ``src``) returns its input unchanged —
+    ``C.torchmpi_sendreceive_*`` (``torchmpi/init.lua:125-134``). Collective
+    over processes: all must call it (the transport is a broadcast-from-src
+    with only ``dst`` adopting the result)."""
+    if jax.process_count() == 1 or src == dst:
+        return value
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    arr = multihost_utils.broadcast_one_to_all(
+        np.asarray(value), is_source=jax.process_index() == src
+    )
+    if jax.process_index() == dst:
+        return type(value)(arr)
+    return value
+
+
 def barrier(comm=None):
     eager.barrier(_current_comm(comm))
 
@@ -174,8 +211,11 @@ __all__ = [
     "sendreceive_tensor",
     "broadcast_scalar",
     "allreduce_scalar",
+    "reduce_scalar",
+    "sendreceive_scalar",
     "barrier",
     "wait",
+    "free_collective_resources",
     "xla",
     "ring",
     "pallas",

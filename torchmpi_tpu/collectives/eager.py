@@ -31,6 +31,7 @@ Key reference mechanics preserved:
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Callable, Optional, Tuple
 
@@ -66,13 +67,52 @@ def _check_rank_stacked(x, comm: Communicator) -> None:
         )
 
 
+class _LRUCache(OrderedDict):
+    """Bounded executable cache: get() refreshes recency, inserts evict the
+    least-recently-used entry past ``collective_cache_max_entries``. A
+    2^8..2^23 x backends x dtypes tester sweep would otherwise accumulate
+    hundreds of compiled executables with no way back — the reference frees
+    its per-size IPC descriptors for the same reason
+    (``torchmpi/cache.lua:19-61``)."""
+
+    def get(self, key, default=None):
+        try:
+            value = super().__getitem__(key)
+        except KeyError:
+            return default
+        self.move_to_end(key)
+        return value
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        limit = constants.get("collective_cache_max_entries")
+        while len(self) > limit:
+            self.popitem(last=False)
+
+
 def _resource_cache(comm: Communicator) -> dict:
     # Lazily attached, like acquireCollectiveResources keying off the comm.
     cache = getattr(comm, "_collective_resources", None)
     if cache is None:
-        cache = {}
+        cache = _LRUCache()
         comm._collective_resources = cache  # type: ignore[attr-defined]
     return cache
+
+
+def free_collective_resources(comm: Communicator) -> None:
+    """Drop every cached compiled executable / sharding / selector decision
+    attached to ``comm`` — the analog of the reference's
+    ``freeCollectiveResources`` (``torchmpi/cache.lua:19-61``, invoked by
+    the tester between sizes, ``torchmpi/tester.lua:131-133``). Safe at any
+    time: the next collective simply recompiles. Called by ``stop()`` for
+    every live stack level."""
+    for attr in ("_collective_resources", "_selector_cache"):
+        if getattr(comm, attr, None) is not None:
+            try:
+                delattr(comm, attr)
+            except AttributeError:
+                pass
 
 
 def _flat_mesh(comm: Communicator) -> Mesh:
@@ -169,6 +209,18 @@ def broadcast_plan(nelem: int, dtype, platform: str) -> Tuple[bool, int]:
     return False, int(k)
 
 
+def _pallas_allgather_lastdim(b, axis: str):
+    """Concat-along-last-dim allgather (the eager contract) on a [1, ..., d]
+    per-rank block via the (p-1)-step pallas forwarding ring. Shared by the
+    flat backend table and the hierarchical intra phase."""
+    from ..ops.ring_kernels import ring_allgather_pallas
+
+    stacked = ring_allgather_pallas(b[0], axis)  # [p, ..., d]
+    moved = jnp.moveaxis(stacked, 0, -2)  # [..., p, d]
+    # b.shape[:-1] keeps the leading per-rank 1: output is [1, ..., p*d]
+    return moved.reshape(b.shape[:-1] + (moved.shape[-2] * moved.shape[-1],))
+
+
 def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ()):
     """Return a kernel fn(block) for the given op/backend.
 
@@ -227,33 +279,25 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
     elif backend == "pallas":
-        # Pallas ICI-RDMA rings for allreduce + pipelined broadcast; the
-        # remaining ops take the ppermute ring (the reference similarly
-        # mixed transports per collective).
+        # Pallas ICI-RDMA rings for allreduce / reduce / allgather +
+        # pipelined broadcast; only sendreceive takes the ppermute path
+        # (a single point-to-point hop IS one XLA collective-permute — a
+        # ring kernel would add nothing).
         from ..ops.ring_kernels import (
-            ring_allgather_pallas,
             ring_allreduce_pallas,
             ring_broadcast_pallas,
+            ring_reduce_pallas,
         )
 
         _pallas_bcast = _bcast_builder(
             lambda b, k: ring_broadcast_pallas(b, root, _AXIS, num_chunks=k)
         )
 
-        def _pallas_allgather(b):
-            # b: [1, ..., d] per-rank block -> concat along the last dim in
-            # rank order (the eager allgather contract)
-            stacked = ring_allgather_pallas(b[0], _AXIS)  # [p, ..., d]
-            moved = jnp.moveaxis(stacked, 0, -2)  # [..., p, d]
-            return moved.reshape(
-                b.shape[:-1] + (moved.shape[-2] * moved.shape[-1],)
-            )
-
         table = {
             "allreduce": lambda b: ring_allreduce_pallas(b, _AXIS),
             "broadcast": _pallas_bcast,
-            "reduce": _ring_reduce,
-            "allgather": _pallas_allgather,
+            "reduce": lambda b: ring_reduce_pallas(b, root, _AXIS),
+            "allgather": lambda b: _pallas_allgather_lastdim(b, _AXIS),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
         }
     else:
@@ -328,10 +372,20 @@ def run(
         # transport selected by use_staged_collectives
         # (kUseStagedCollectives, detail/collectives_cuda.cpp:877-899)
         if op == "allreduce":
-            impl = "staged" if constants.get("use_staged_collectives") else "ring"
+            # the intra (ICI) level is where the custom transport pays:
+            # when the selector routed to pallas, the composition's intra
+            # phase runs the RDMA ring (collectives_cuda.cpp:501-581 — the
+            # reference's intra-IPC transport was the custom one there too)
+            impl = (
+                "staged"
+                if constants.get("use_staged_collectives")
+                else effective
+            )
             return run_hierarchical_allreduce(x, comm, impl=impl)
         if op in ("broadcast", "reduce", "allgather"):
-            return run_hierarchical_collective(op, x, comm, root=root)
+            return run_hierarchical_collective(
+                op, x, comm, root=root, ring_impl=effective
+            )
     elif hier and op == "allreduce":
         # non-cartesian (ragged/tree) comms: grouped reduce + roots
         # exchange + the trailing intra broadcast
@@ -489,13 +543,32 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
     if impl == "staged":
         return _run_staged_hierarchical_allreduce(x, comm)
     donate = constants.get("donate_eager_buffers")
-    tuning = ring_tuning(comm._devices[0].platform) if impl == "ring" else ()
+    tuning = (
+        ring_tuning(comm._devices[0].platform)
+        if impl in ("ring", "pallas")
+        else ()
+    )
     key = (
         "hier_allreduce", impl, tuple(x.shape), jnp.result_type(x), donate,
         tuning,
     )
 
-    if impl == "ring":
+    if impl == "pallas":
+        # intra = ICI: the Pallas RDMA ring; inter = cross-ICI/DCN: the
+        # ppermute ring (XLA schedules it over the slower fabric) — the
+        # reference's intra-IPC-ring x inter-MPI split.
+        from ..ops.ring_kernels import ring_allreduce_pallas
+
+        minb, maxb, nbuf = tuning
+
+        def kernel(b):
+            b = ring_allreduce_pallas(b, "intra")
+            return prim.ring_allreduce(
+                b, "inter",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf,
+            )
+    elif impl == "ring":
         minb, maxb, nbuf = tuning
 
         def kernel(b):
@@ -596,7 +669,9 @@ def _hier_compile(comm: Communicator, key, ndim: int, donate: bool, kernel,
     return fn
 
 
-def run_hierarchical_collective(op: str, x, comm: Communicator, root: int = 0):
+def run_hierarchical_collective(
+    op: str, x, comm: Communicator, root: int = 0, ring_impl: str = "ring"
+):
     """Two-level composition of broadcast/reduce/allgather on a cartesian
     communicator, routed like the hierarchical allreduce — the reference's
     per-collective hierarchical dispatch (``collectives_cuda.cpp:501-581,
@@ -611,6 +686,12 @@ def run_hierarchical_collective(op: str, x, comm: Communicator, root: int = 0):
     - allgather: intra all-gather then inter all-gather along the last dim,
       with the concatenation re-ordered from mesh (group-major) order to
       global rank order.
+
+    ``ring_impl`` selects the INTRA-phase transport: ``'ring'`` (ppermute)
+    or ``'pallas'`` (ICI RDMA kernels) — the level where the custom
+    transport pays, like the reference's intra-IPC rings
+    (``collectives_cuda.cpp:1057-1141``). The inter phase always runs the
+    ppermute ring (it rides the slower cross-group fabric).
     """
     x = jnp.asarray(x)
     _check_rank_stacked(x, comm)
@@ -632,29 +713,49 @@ def run_hierarchical_collective(op: str, x, comm: Communicator, root: int = 0):
         )
     key = (
         "hier", op, root, tuple(x.shape), jnp.result_type(x), donate, tuning,
-        (tree, chunks),
+        (tree, chunks), ring_impl,
     )
     g0 = next(gi for gi, g in enumerate(comm._groups) if root in g)
     i0 = comm.member(root).intra_rank
+    pallas_intra = ring_impl == "pallas"
 
     def bcast_axis(b, r, axis):
         if tree:
             return prim.tree_broadcast(b, r, axis)
         return prim.ring_broadcast(b, r, axis, num_chunks=chunks)
 
+    def intra_bcast(b):
+        if pallas_intra:
+            from ..ops.ring_kernels import ring_broadcast_pallas
+
+            return ring_broadcast_pallas(b, i0, "intra", num_chunks=chunks)
+        return bcast_axis(b, i0, "intra")
+
+    def intra_reduce(b):
+        if pallas_intra:
+            from ..ops.ring_kernels import ring_reduce_pallas
+
+            return ring_reduce_pallas(b, i0, "intra")
+        return prim.ring_reduce(
+            b, i0, "intra",
+            max_bytes_per_step=maxb, min_bytes_per_step=minb,
+            num_buffers=nbuf,
+        )
+
+    def intra_allgather(b):
+        if pallas_intra:
+            return _pallas_allgather_lastdim(b, "intra")
+        return prim.ring_allgather(b, "intra", dim=-1)
+
     if op == "broadcast":
         def kernel(b):
             # inter phase within every intra row, then intra phase
             b = bcast_axis(b, g0, "inter")
-            return bcast_axis(b, i0, "intra")
+            return intra_bcast(b)
         post = None
     elif op == "reduce":
         def kernel(b):
-            y = prim.ring_reduce(
-                b, i0, "intra",
-                max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf,
-            )
+            y = intra_reduce(b)
             z = prim.ring_reduce(
                 y, g0, "inter",
                 max_bytes_per_step=maxb, min_bytes_per_step=minb,
@@ -667,7 +768,7 @@ def run_hierarchical_collective(op: str, x, comm: Communicator, root: int = 0):
         post = None
     else:  # allgather
         def kernel(b):
-            b = prim.ring_allgather(b, "intra", dim=-1)
+            b = intra_allgather(b)
             return prim.ring_allgather(b, "inter", dim=-1)
 
         p, d = comm.size, int(x.shape[-1])

@@ -81,6 +81,12 @@ class _Constants:
     # default custom-ring implementation: 'ppermute' (pure XLA, portable) or
     # 'pallas' (ICI RDMA kernels, TPU only).
     ring_implementation: str = "ppermute"
+    # Bound on cached compiled executables per communicator (LRU evicted).
+    # The reference frees per-size IPC descriptors between tester sweeps
+    # (cache.lua:19-61, tester.lua:131-133); compiled XLA executables are
+    # this design's per-size resource, so they get the same lifecycle:
+    # bounded while live, freed wholesale by free_collective_resources/stop.
+    collective_cache_max_entries: int = 256
     # Deadlock watchdog for host-side waits (parameter-server client ops):
     # seconds before a blocked wait aborts with a diagnostic. 0 disables.
     # Analog of the reference's 10s spin-acquire abort (resources.cpp:

@@ -40,36 +40,73 @@ from ..runtime.communicator import Communicator
 _AXIS = "mpi"
 
 
+class _IdRef:
+    """Identity key that pins its referent. Hashing/equality are by object
+    identity, and the strong reference guarantees the identity stays valid:
+    a raw ``id()`` key can collide when the original object is GC'd and a
+    new one reuses its address (silently serving a stale jitted executable
+    for a *different* model); holding the object makes that impossible —
+    the id cannot be recycled while the cache entry (and thus this ref)
+    is alive, and ``is`` comparison is exact either way."""
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __hash__(self):
+        # id-based regardless of the referent's own __hash__, matching the
+        # identity equality (and defined even for unhashable referents).
+        return object.__hash__(self.obj)
+
+    def __eq__(self, other):
+        return isinstance(other, _IdRef) and self.obj is other.obj
+
+
 def _fn_key(fn) -> Any:
     """Stable cache key for a callable: code object + identities of captured
     closure values. A lambda re-created each call inside a loop shares its
     code object, so keying on the function object itself would miss (and
     recompile) every time; two lambdas from the same source line that close
-    over different models still get distinct keys via the cell contents."""
+    over different models still get distinct keys via the cell contents
+    (``_IdRef`` pins them, so the keys can never alias across GC)."""
     code = getattr(fn, "__code__", None)
     if code is None:
-        return fn
+        return _IdRef(fn)
     cells = getattr(fn, "__closure__", None) or ()
     # __self__ distinguishes bound methods of different instances (their
     # __code__/__closure__ proxy to the one shared class function);
     # __defaults__ distinguishes def f(x, m=model_a) from m=model_b.
+    self_obj = getattr(fn, "__self__", None)
     return (
         code,
-        id(getattr(fn, "__self__", None)),
-        tuple(id(d) for d in (getattr(fn, "__defaults__", None) or ())),
-        tuple(id(c.cell_contents) for c in cells),
+        _IdRef(self_obj) if self_obj is not None else None,
+        tuple(_IdRef(d) for d in (getattr(fn, "__defaults__", None) or ())),
+        tuple(_IdRef(c.cell_contents) for c in cells),
     )
 
 
 def _array_fingerprint(a) -> tuple:
-    """Cheap content fingerprint (shape, dtype, sampled-bytes hash) used to
-    detect in-place mutation of cached eval arrays without hashing the
-    whole buffer."""
+    """Cheap content fingerprint (shape, dtype, strided sample hashes) used
+    to detect in-place mutation of cached eval arrays without hashing the
+    whole buffer. Two samples: ~16 leading-axis rows (catches whole-row
+    updates) plus a ~4096-point stride across the flattened buffer
+    (catches scattered writes anywhere, at that granularity — mutations
+    smaller than one stride cell can still slip through; callers mutating
+    cached arrays in place should not rely on sub-stride edits being
+    seen)."""
     arr = np.asarray(a)
     if arr.size == 0:
-        return (arr.shape, arr.dtype.str, 0)
-    sample = arr[:: max(1, len(arr) // 16)]
-    return (arr.shape, arr.dtype.str, hash(np.ascontiguousarray(sample).tobytes()))
+        return (arr.shape, arr.dtype.str, 0, 0)
+    rows = arr[:: max(1, len(arr) // 16)]
+    flat = arr.reshape(-1) if arr.flags.c_contiguous else arr.ravel()
+    pts = flat[:: max(1, flat.size // 4096)]
+    return (
+        arr.shape,
+        arr.dtype.str,
+        hash(np.ascontiguousarray(rows).tobytes()),
+        hash(np.ascontiguousarray(pts).tobytes()),
+    )
 
 
 class AllReduceSGDEngine:
@@ -637,6 +674,9 @@ class AllReduceSGDEngine:
         cached = self._eval_data.get(dkey)
         if cached is not None and cached[0] == fp:
             xd, yd = cached[1], cached[2]
+            # recency refresh: FIFO eviction would drop the entry a loop
+            # alternating over >4 datasets is about to reuse
+            self._eval_data[dkey] = self._eval_data.pop(dkey)
         else:
             xd = jax.device_put(np.asarray(x[:n]), self.batch_sharding)
             yd = jax.device_put(np.asarray(y[:n]), self.batch_sharding)
@@ -647,7 +687,9 @@ class AllReduceSGDEngine:
         has_state = self.model_state is not None
         key = (_fn_key(apply_fn), _fn_key(metric), has_state)
         fn = self._eval_fns.get(key)
-        if fn is None:
+        if fn is not None:
+            self._eval_fns[key] = self._eval_fns.pop(key)  # LRU refresh
+        else:
             if has_state:
                 fn = jax.jit(
                     lambda params, state, x, y: metric(
@@ -656,6 +698,8 @@ class AllReduceSGDEngine:
                 )
             else:
                 fn = jax.jit(lambda params, x, y: metric(apply_fn(params, x), y))
+            if len(self._eval_fns) >= 8:  # bound executables + _IdRef pins
+                self._eval_fns.pop(next(iter(self._eval_fns)))
             self._eval_fns[key] = fn
         if has_state:
             return float(fn(self.params, self.model_state, xd, yd))

@@ -6,6 +6,7 @@ from .ring_kernels import (
     ring_allgather_pallas,
     ring_allreduce_pallas,
     ring_broadcast_pallas,
+    ring_reduce_pallas,
     ring_reduce_scatter_pallas,
     supports_dtype,
 )
@@ -17,6 +18,7 @@ __all__ = [
     "ring_allgather_pallas",
     "ring_allreduce_pallas",
     "ring_broadcast_pallas",
+    "ring_reduce_pallas",
     "ring_reduce_scatter_pallas",
     "supports_dtype",
 ]

@@ -1,6 +1,10 @@
 from .ep import moe_dispatch_combine, moe_load_stats
 from .mesh import make_parallel_mesh
-from .pp import pipeline_forward, pipeline_loss_fn
+from .pp import (
+    pipeline_1f1b_value_and_grad,
+    pipeline_forward,
+    pipeline_loss_fn,
+)
 from .ring_attention import full_self_attention, ring_self_attention
 from .tp import MPLinear, MPLinearOutputSplit, shard_input_features
 
@@ -8,6 +12,7 @@ __all__ = [
     "make_parallel_mesh",
     "moe_dispatch_combine",
     "moe_load_stats",
+    "pipeline_1f1b_value_and_grad",
     "pipeline_forward",
     "pipeline_loss_fn",
     "ring_self_attention",

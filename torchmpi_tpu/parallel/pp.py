@@ -17,13 +17,25 @@ capability extension in the modern taxonomy, built TPU-first:
 
 Bubble fraction is the textbook ``(p-1)/(m+p-1)``; pick
 ``num_microbatches >> p`` to amortize.
+
+Two schedules:
+
+- GPipe via autodiff (:func:`pipeline_forward` / :func:`pipeline_loss_fn`):
+  the backward falls out of ``ppermute``'s transpose; activation residuals
+  grow O(m) with the scan length.
+- 1F1B / PipeDream-flush (:func:`pipeline_1f1b_value_and_grad`): an
+  explicit static schedule interleaving one forward with one backward per
+  stage after warmup, with per-tick ``jax.vjp`` against an O(p) circular
+  activation stash — same tick count, flat memory in m.
 """
 
 from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -103,11 +115,11 @@ def pipeline_loss_fn(
     stage_fn: Callable,
     loss_of_outputs: Callable,
     axis: str = "pp",
+    convention: str = "grad-inside",
 ):
     """Build ``fn(stage_params, microbatches, targets) -> scalar`` for use
     inside shard_map: GPipe forward + the caller's loss over the final
-    outputs. ``jax.grad`` of this function — inside OR outside shard_map —
-    gives each device its OWN stage's gradients at the correct scale (the
+    outputs. ``jax.grad`` gives each device its OWN stage's gradients (the
     PP backward schedule falls out of ppermute's transpose).
 
     Gradient-scale discipline: under SPMD differentiation the transpose of
@@ -118,12 +130,23 @@ def pipeline_loss_fn(
     flows only through the local masked lane
     (``masked + stop_gradient(replicated - masked)``).
 
-    Supported differentiation pattern: take the grad INSIDE the shard_map
-    region — ``shard_map(jax.value_and_grad(fn), ...)`` — which yields
-    exact sequential-parity stage gradients (tested). Differentiating the
-    already-shard_mapped function from OUTSIDE uses the opposite
-    replicated-output cotangent convention (1/p per lane) and is not
-    supported."""
+    The two differentiation patterns use OPPOSITE replicated-output
+    cotangent conventions, so ``convention`` must name where the grad is
+    taken (measured: the other placement yields gradients off by exactly
+    p or 1/p):
+
+    - ``'grad-inside'`` (default): ``shard_map(jax.value_and_grad(fn))`` —
+      every device's loss lane receives cotangent 1.
+    - ``'grad-outside'``: ``jax.grad(shard_map(fn, out_specs=P()))`` — the
+      replicated output's transpose hands each lane cotangent 1/p; the
+      differentiable lane is pre-scaled by p to compensate, so stage
+      gradients come out at sequential parity (tested both ways).
+    """
+    if convention not in ("grad-inside", "grad-outside"):
+        raise ValueError(
+            "convention must be 'grad-inside' (shard_map(grad(fn))) or "
+            f"'grad-outside' (grad(shard_map(fn))), got {convention!r}"
+        )
 
     def fn(stage_params, microbatches, targets):
         outs = pipeline_forward(
@@ -137,6 +160,264 @@ def pipeline_loss_fn(
             s == p - 1, loss_local, jnp.zeros_like(loss_local)
         )
         replicated = lax.psum(masked, axis)
-        return masked + lax.stop_gradient(replicated - masked)
+        # the differentiable lane: x1 when each lane's cotangent is 1
+        # (grad-inside), xp when the outside transpose hands each lane 1/p
+        diff_lane = masked * p if convention == "grad-outside" else masked
+        return diff_lane + lax.stop_gradient(replicated - diff_lane)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDream-flush) schedule
+# ---------------------------------------------------------------------------
+
+
+def _one_f_one_b_schedule(p: int, m: int):
+    """Static greedy 1F1B schedule: per tick and stage, which microbatch to
+    forward / backward (-1 = idle). One compute slot per tick per stage;
+    activations/cotangents sent at the end of a tick are usable the next.
+
+    Policy: each stage runs warmup forwards until ``min(m, p - s)``
+    microbatches are in flight, then strictly prefers backward over forward
+    (the 1F1B alternation) — bounding live activations at O(p) instead of
+    GPipe's O(m). Dependencies (fwd needs left's fwd done, bwd needs
+    right's bwd done and the local fwd) are enforced by construction."""
+    fwd_next, bwd_next = [0] * p, [0] * p
+    fwd_time: dict = {}
+    bwd_time: dict = {}
+    max_inflight = [min(m, p - s) for s in range(p)]
+    rows_f, rows_b = [], []
+    t = 0
+    while any(b < m for b in bwd_next):
+        row_f, row_b = [-1] * p, [-1] * p
+        for s in range(p):
+            jf, jb = fwd_next[s], bwd_next[s]
+            # .get default t => "not yet happened" fails the < t check
+            can_fwd = jf < m and (
+                s == 0 or fwd_time.get((s - 1, jf), t) < t
+            )
+            can_bwd = (
+                jb < m
+                and jb < jf
+                and (s == p - 1 or bwd_time.get((s + 1, jb), t) < t)
+            )
+            if can_bwd and (jf - jb >= max_inflight[s] or not can_fwd):
+                row_b[s] = jb
+                bwd_time[(s, jb)] = t
+                bwd_next[s] += 1
+            elif can_fwd:
+                row_f[s] = jf
+                fwd_time[(s, jf)] = t
+                fwd_next[s] += 1
+        rows_f.append(row_f)
+        rows_b.append(row_b)
+        t += 1
+        if t > 4 * (m + p) + 8:
+            raise AssertionError(
+                f"1F1B schedule failed to converge for p={p}, m={m}"
+            )
+    return (
+        np.asarray(rows_f, np.int32),
+        np.asarray(rows_b, np.int32),
+        fwd_time,
+        bwd_time,
+    )
+
+
+def _min_safe_stash(m: int, lives) -> int:
+    """Smallest circular-buffer size with no live-range collision: slots
+    ``j % size`` may not alias while both live. ``lives`` is a list of
+    (j, write_tick, read_tick) tuples; static schedule -> exact check."""
+    for size in range(1, m + 1):
+        ok = True
+        for j, w, r in lives:
+            for j2, w2, r2 in lives:
+                if j2 <= j or (j2 - j) % size != 0:
+                    continue
+                if w2 <= r:  # j2 overwrites the slot before j is read
+                    ok = False
+                    break
+            if not ok:
+                break
+        if ok:
+            return size
+    return m
+
+
+def _one_f_one_b_plan(p: int, m: int):
+    """Schedule arrays + exact minimal stash sizes (all static)."""
+    rows_f, rows_b, fwd_time, bwd_time = _one_f_one_b_schedule(p, m)
+    # x stash: written at the stage's own fwd tick, read at its bwd tick
+    x_lives = [
+        [
+            (j, fwd_time[(s, j)], bwd_time[(s, j)])
+            for j in range(m)
+        ]
+        for s in range(p)
+    ]
+    # incoming activations: written the tick after the LEFT stage's fwd,
+    # read at this stage's fwd tick
+    in_lives = [
+        [
+            (j, fwd_time[(s - 1, j)] + 1, fwd_time[(s, j)])
+            for j in range(m)
+        ]
+        for s in range(1, p)
+    ]
+    # incoming cotangents: written the tick after the RIGHT stage's bwd,
+    # read at this stage's bwd tick
+    gy_lives = [
+        [
+            (j, bwd_time[(s + 1, j)] + 1, bwd_time[(s, j)])
+            for j in range(m)
+        ]
+        for s in range(p - 1)
+    ]
+    x_buf = max(_min_safe_stash(m, lv) for lv in x_lives)
+    in_buf = max(
+        (_min_safe_stash(m, lv) for lv in in_lives), default=1
+    )
+    gy_buf = max(
+        (_min_safe_stash(m, lv) for lv in gy_lives), default=1
+    )
+    return rows_f, rows_b, x_buf, in_buf, gy_buf
+
+
+def pipeline_1f1b_value_and_grad(
+    stage_fn: Callable,
+    loss_of_microbatch: Callable,
+    axis: str = "pp",
+):
+    """Build ``fn(stage_params, microbatches, targets) -> (loss, grads)``
+    running the 1F1B (PipeDream-flush) schedule — backward of microbatch j
+    starts as soon as its forward clears the pipe, so live activations are
+    bounded by O(p) stash slots instead of GPipe-through-autodiff's O(m)
+    scan residuals. Use inside ``shard_map``; each device returns its OWN
+    stage's parameter gradients (exact sequential parity, tested) and the
+    replicated total loss ``(1/m) * sum_j loss_of_microbatch(y_j, t_j)``.
+
+    No differentiation-convention trap here: the function computes its
+    gradients internally (per-tick ``jax.vjp`` against the stashed stage
+    input — rematerializing the stage forward, the standard TPU
+    memory/FLOPs trade) and is not meant to be differentiated again.
+
+    ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``;
+    ``loss_of_microbatch(y, target) -> scalar``.
+    """
+
+    def fn(stage_params, microbatches, targets):
+        p = lax.axis_size(axis)
+        s = lax.axis_index(axis)
+        m = microbatches.shape[0]
+        mb_shape = microbatches.shape[1:]
+        dtype = microbatches.dtype
+        rows_f, rows_b, x_buf, in_buf, gy_buf = _one_f_one_b_plan(p, m)
+        fwd_sched = jnp.asarray(rows_f)  # [T, p]
+        bwd_sched = jnp.asarray(rows_b)
+        right = [(i, (i + 1) % p) for i in range(p)]
+        left = [(i, (i - 1) % p) for i in range(p)]
+        s_left = lax.rem(s + p - 1, p)
+        s_right = lax.rem(s + 1, p)
+
+        def masked_write(buf, idx, value, cond):
+            cur = lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                buf, jnp.where(cond, value, cur), idx, 0
+            )
+
+        def tick(carry, t):
+            in_act, gy, x_saved, grads, loss_sum = carry
+            jf = fwd_sched[t, s]
+            jb = bwd_sched[t, s]
+            do_fwd, do_bwd = jf >= 0, jb >= 0
+            jf_c = jnp.clip(jf, 0, m - 1)
+            jb_c = jnp.clip(jb, 0, m - 1)
+
+            # ---- forward slot ----
+            x_in = jnp.where(
+                s == 0,
+                lax.dynamic_index_in_dim(
+                    microbatches, jf_c, 0, keepdims=False
+                ),
+                lax.dynamic_index_in_dim(
+                    in_act, jf_c % in_buf, 0, keepdims=False
+                ),
+            )
+            # idle slots skip the stage compute entirely (lax.cond is a
+            # real branch inside shard_map+scan on TPU — masking with
+            # jnp.where would burn both slots' FLOPs every tick)
+            y = lax.cond(
+                do_fwd,
+                lambda: stage_fn(stage_params, x_in),
+                lambda: jnp.zeros(mb_shape, dtype),
+            )
+            x_saved = masked_write(x_saved, jf_c % x_buf, x_in, do_fwd)
+
+            # ---- backward slot (remat: vjp against the stashed input) ----
+            x_b = lax.dynamic_index_in_dim(
+                x_saved, jb_c % x_buf, 0, keepdims=False
+            )
+            tgt_b = lax.dynamic_index_in_dim(
+                targets, jb_c, 0, keepdims=False
+            )
+            last = s == p - 1
+            gy_in = lax.dynamic_index_in_dim(
+                gy, jb_c % gy_buf, 0, keepdims=False
+            )
+
+            def run_bwd():
+                def fwd_and_loss(w, xx):
+                    yy = stage_fn(w, xx)
+                    return yy, loss_of_microbatch(yy, tgt_b)
+
+                (y_b, l_b), pull = jax.vjp(fwd_and_loss, stage_params, x_b)
+                cot_y = jnp.where(last, jnp.zeros_like(y_b), gy_in)
+                cot_l = jnp.where(last, jnp.asarray(1.0 / m, l_b.dtype),
+                                  jnp.asarray(0.0, l_b.dtype))
+                gw, gx = pull((cot_y, cot_l))
+                return gw, gx, l_b.astype(jnp.float32)
+
+            gw, gx, l_b = lax.cond(
+                do_bwd,
+                run_bwd,
+                lambda: (
+                    zeros_g,
+                    jnp.zeros(mb_shape, dtype),
+                    jnp.zeros((), jnp.float32),
+                ),
+            )
+            grads = jax.tree_util.tree_map(lambda G, g: G + g, grads, gw)
+            loss_sum = loss_sum + jnp.where(
+                do_bwd & last, l_b / m, jnp.zeros((), jnp.float32)
+            )
+
+            # ---- exchanges: activations ride right, cotangents left ----
+            act_recv = lax.ppermute(y, axis, right)
+            cot_recv = lax.ppermute(gx, axis, left)
+            jf_l = fwd_sched[t, s_left]
+            jb_r = bwd_sched[t, s_right]
+            in_act = masked_write(
+                in_act, jnp.clip(jf_l, 0, m - 1) % in_buf, act_recv,
+                (jf_l >= 0) & (s > 0),
+            )
+            gy = masked_write(
+                gy, jnp.clip(jb_r, 0, m - 1) % gy_buf, cot_recv,
+                (jb_r >= 0) & (s < p - 1),
+            )
+            return (in_act, gy, x_saved, grads, loss_sum), None
+
+        zeros_g = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+        init = (
+            jnp.zeros((in_buf,) + mb_shape, dtype),
+            jnp.zeros((gy_buf,) + mb_shape, dtype),
+            jnp.zeros((x_buf,) + mb_shape, dtype),
+            zeros_g,
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, grads, loss_sum), _ = lax.scan(
+            tick, init, jnp.arange(rows_f.shape[0])
+        )
+        return lax.psum(loss_sum, axis), grads
 
     return fn

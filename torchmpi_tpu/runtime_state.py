@@ -184,6 +184,16 @@ def stop() -> None:
     from .parameterserver import free_all as _ps_free_all
 
     _ps_free_all()
+    # free cached compiled executables on every stack level (the
+    # freeDescriptors sweep of torch_mpi.cpp:282-306 / cache.lua:19-61)
+    from .collectives.eager import free_collective_resources
+
+    if _stack is not None:
+        for level in range(len(_stack.names())):
+            try:
+                free_collective_resources(_stack.at(level))
+            except Exception:
+                pass
     pools.shutdown_all()
     with _lock:
         _stack = None

@@ -1,0 +1,122 @@
+"""Analytic FLOP models for the benchmark workloads.
+
+The reference grounds every reported number in an analytic model (its
+collectives tester converts measured time to bus GB/s with an algorithm
+bandwidth formula, ``test/collectives_all.lua:313-318``). This module does
+the same for compute: walk the model architectures layer by layer, count
+multiply-accumulate FLOPs, and convert a measured samples/sec into achieved
+FLOP/s and model-FLOPs-utilization (MFU) against the chip's peak.
+
+Conventions (stated so the numbers are auditable):
+- 1 MAC = 2 FLOPs (multiply + add), the standard accounting.
+- Training step = 3x forward FLOPs (backward ~= 2x forward: one pass for
+  input grads, one for weight grads). Elementwise ops (relu, batchnorm,
+  pooling, softmax) are ignored — they are <1% of conv/dense FLOPs and are
+  VPU work, not MXU work, so including them would overstate MFU.
+- Peaks are per-chip dense bf16 from Google's published specs. MFU is
+  reported as ``None`` when the device kind is unknown (e.g. CPU) rather
+  than guessed.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+def conv2d_flops(h: int, w: int, cin: int, cout: int, kh: int, kw: int,
+                 stride: int = 1) -> tuple[int, int, int]:
+    """FLOPs of a SAME-padded conv; returns (flops, h_out, w_out)."""
+    ho, wo = math.ceil(h / stride), math.ceil(w / stride)
+    return 2 * kh * kw * cin * cout * ho * wo, ho, wo
+
+
+def dense_flops(cin: int, cout: int) -> int:
+    return 2 * cin * cout
+
+
+def lenet_forward_flops(image: int = 28) -> int:
+    """Per-sample forward FLOPs of ``models.mnist.LeNet`` (28x28x1 input)."""
+    f1, h, w = conv2d_flops(image, image, 1, 32, 5, 5)
+    h, w = h // 2, w // 2  # max_pool 2x2 s2
+    f2, h, w = conv2d_flops(h, w, 32, 64, 5, 5)
+    h, w = h // 2, w // 2
+    f3 = dense_flops(h * w * 64, 256)
+    f4 = dense_flops(256, 10)
+    return f1 + f2 + f3 + f4
+
+
+def resnet_forward_flops(image: int = 224, stage_sizes=(3, 4, 6, 3),
+                         bottleneck: bool = True, num_classes: int = 1000,
+                         num_filters: int = 64) -> int:
+    """Per-sample forward FLOPs of ``models.resnet.ResNet`` (NHWC input).
+
+    Mirrors the module walk in ``models/resnet.py`` exactly: 7x7/2 stem,
+    3x3/2 max-pool, then bottleneck (1x1 -> 3x3 -> 1x1, x4 expansion) or
+    basic (3x3 -> 3x3) stages with stride-2 at each stage entry (v1.5:
+    stride on the 3x3) and a 1x1 projection whenever shapes change.
+    For 224px ResNet-50 this yields ~8.2 GFLOP forward (= the commonly
+    cited ~4.1 GMACs at 2 FLOPs/MAC).
+    """
+    total, h, w = 0, image, image
+    f, h, w = conv2d_flops(h, w, 3, num_filters, 7, 7, stride=2)
+    total += f
+    h, w = math.ceil(h / 2), math.ceil(w / 2)  # max_pool 3x3 s2 SAME
+    cin = num_filters
+    for i, count in enumerate(stage_sizes):
+        feats = num_filters * 2 ** i
+        cout = feats * 4 if bottleneck else feats
+        for j in range(count):
+            stride = 2 if (i > 0 and j == 0) else 1
+            if bottleneck:
+                f1, _, _ = conv2d_flops(h, w, cin, feats, 1, 1)
+                f2, h2, w2 = conv2d_flops(h, w, feats, feats, 3, 3, stride)
+                f3, _, _ = conv2d_flops(h2, w2, feats, cout, 1, 1)
+                total += f1 + f2 + f3
+            else:
+                f2, h2, w2 = conv2d_flops(h, w, cin, feats, 3, 3, stride)
+                f3, _, _ = conv2d_flops(h2, w2, feats, feats, 3, 3)
+                total += f2 + f3
+            if cin != cout or stride != 1:
+                fp, _, _ = conv2d_flops(h, w, cin, cout, 1, 1, stride)
+                total += fp
+            h, w, cin = h2, w2, cout
+    total += dense_flops(cin, num_classes)
+    return total
+
+
+def train_flops(forward_flops: int) -> int:
+    """Forward + backward (~2x forward) per-sample training FLOPs."""
+    return 3 * forward_flops
+
+
+# Per-chip dense peak FLOP/s (bf16 unless noted), from published TPU specs.
+# Keys are matched as substrings of jax's ``device.device_kind``.
+_TPU_PEAK_BF16 = (
+    ("v6", 918e12),     # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),     # v5e / "TPU v5 lite" (checked after v5p)
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def device_peak_flops(device) -> Optional[float]:
+    """Per-chip bf16 peak for a jax device, or None if unknown."""
+    kind = getattr(device, "device_kind", "") or ""
+    kind = kind.lower()
+    if "tpu" not in kind and getattr(device, "platform", "") != "tpu":
+        return None
+    for tag, peak in _TPU_PEAK_BF16:
+        if tag in kind:
+            return peak
+    return None
+
+
+def mfu(samples_per_sec_per_chip: float, flops_per_sample: int,
+        device) -> tuple[float, Optional[float]]:
+    """(achieved FLOP/s per chip, fraction-of-peak or None)."""
+    achieved = samples_per_sec_per_chip * flops_per_sample
+    peak = device_peak_flops(device)
+    return achieved, (achieved / peak if peak else None)

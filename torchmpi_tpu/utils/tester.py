@@ -159,7 +159,15 @@ def run_matrix(
     benchmark: bool = False,
     report: Optional[Callable[[BenchResult], None]] = None,
 ) -> List[BenchResult]:
-    """The full config-matrix sweep (``collectives_all.lua:554-598``)."""
+    """The full config-matrix sweep (``collectives_all.lua:554-598``).
+
+    Like the reference tester, per-size resources are freed as the sweep
+    walks the matrix (``tester.lua:131-133`` frees IPC descriptors between
+    sizes): here the per-size resource is the compiled executable, so the
+    per-communicator cache is dropped after each op's sweep — the LRU bound
+    caps growth within one, the explicit free keeps a long matrix flat."""
+    from ..collectives.eager import free_collective_resources
+
     sizes = sizes or sweep_sizes()
     results = []
     for op in ops:
@@ -172,4 +180,5 @@ def run_matrix(
                     results.append(res)
                     if report:
                         report(res)
+        free_collective_resources(comm)
     return results
