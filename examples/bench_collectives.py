@@ -23,6 +23,12 @@ def main():
     ap.add_argument("--max-pow", type=int, default=20)
     ap.add_argument("--cpu-mesh", type=int, default=0)
     ap.add_argument(
+        "--ps",
+        action="store_true",
+        help="also measure parameter-server center traffic (MB/s, the "
+        "clientSend/clientReceive hot path)",
+    )
+    ap.add_argument(
         "--pallas-interpret",
         action="store_true",
         help="add the pallas backend in interpret mode (CPU mesh; on real "
@@ -77,6 +83,18 @@ def main():
             from torchmpi_tpu.ops import ring_kernels as rk
 
             rk._FORCE_INTERPRET = False
+    if args.ps:
+        from torchmpi_tpu.utils.tester import run_ps_throughput
+
+        r = run_ps_throughput(comm, nelem=1 << (args.max_pow - 1))
+        print(
+            f"{'ps-send':<12}{'server':<9}{r['nbytes']//4:>10}"
+            f"{'':>12}{r['send_mbps']/1e3:>10.2f}  yes"
+        )
+        print(
+            f"{'ps-recv':<12}{'server':<9}{r['nbytes']//4:>10}"
+            f"{'':>12}{r['recv_mbps']/1e3:>10.2f}  yes"
+        )
     bad = [r for r in results if not r.correct]
     print(f"{len(results)} configs, {len(bad)} incorrect")
     mpi.stop()

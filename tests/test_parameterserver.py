@@ -308,3 +308,69 @@ def test_stop_frees_parameter_servers():
     from torchmpi_tpu.parameterserver.server import _server
 
     assert _server._thread is None or not _server._thread.is_alive()
+
+
+def test_transport_barrier_generation_counting():
+    """A fast peer's NEXT barrier frame (same tag) arriving before this
+    process finishes the current wait must be banked for the next wait,
+    not discarded (round-2 advisor finding)."""
+    from torchmpi_tpu.parameterserver.transport import _Listener
+
+    lst = _Listener(lambda i: None)
+    try:
+        lst.barrier_arrived("t", 1)
+        lst.barrier_arrived("t", 1)  # early arrival of the NEXT generation
+        assert lst.barrier_wait("t", {1}, timeout=1.0)
+        assert lst.barrier_wait("t", {1}, timeout=1.0)  # banked generation
+        assert not lst.barrier_wait("t", {1}, timeout=0.05)  # drained
+    finally:
+        lst.close()
+
+
+def test_transport_retry_waits_for_inflight_apply():
+    """A reconnect retry racing the still-in-flight FIRST apply of the same
+    (inst, rank, client, seq) must WAIT for it and ack its outcome — not
+    re-post the update (double-applying a non-idempotent 'add'; round-2
+    advisor medium finding)."""
+    import socket
+    import threading
+    import time
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applies = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                time.sleep(0.4)  # slow apply: the retry lands mid-flight
+                applies.append(float(np.asarray(msg.payload).sum()))
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    inst = FakeInst()
+    lst = T._Listener(lambda i: inst)
+    try:
+        payload = np.ones(4, np.float32)
+        s1 = socket.create_connection(("localhost", lst.port), timeout=10)
+        s2 = socket.create_connection(("localhost", lst.port), timeout=10)
+        for s in (s1, s2):
+            s.settimeout(10)
+        kw = dict(
+            inst=1, rank=0, client=0, seq=7, rule="add",
+            dtype=payload.dtype.str, payload=payload.tobytes(),
+        )
+        T._send_frame(s1, T._KIND_UPDATE, **kw)
+        time.sleep(0.1)  # first apply is now in flight
+        T._send_frame(s2, T._KIND_UPDATE, **kw)  # the racing retry
+        k1 = T._recv_frame(s1)[0]
+        k2 = T._recv_frame(s2)[0]
+        assert k1 == T._KIND_ACK and k2 == T._KIND_ACK
+        assert applies == [4.0], applies  # applied exactly ONCE
+        s1.close()
+        s2.close()
+    finally:
+        lst.close()

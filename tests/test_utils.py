@@ -265,3 +265,17 @@ def test_deadlock_watchdog():
     from torchmpi_tpu.parameterserver import free_all
 
     free_all()
+
+
+def test_ps_throughput_harness():
+    """PS center-traffic throughput line (MB/s): sane positive numbers,
+    server freed afterwards (the clientSend/clientReceive hot-path
+    measurement, parameterserver.cpp:309-400)."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.utils.tester import run_ps_throughput
+
+    r = run_ps_throughput(
+        mpi.current_communicator(), nelem=1 << 14, warmup=1, timed=3
+    )
+    assert r["send_mbps"] > 0 and r["recv_mbps"] > 0
+    assert r["nbytes"] == (1 << 14) * 4

@@ -71,10 +71,29 @@ class PSGroup:
         ``local_update`` preprocesses each block before sending (Downpour's
         ``t:mul(-lr)``)."""
         leaves = tree_util.tree_leaves(values)
-        ranks = range(self.p) if client_ranks is None else client_ranks
+        ranks = list(range(self.p)) if client_ranks is None else list(client_ranks)
         handles = []
+        batch_add = rule == "add" and len(ranks) > 1
         for srv, leaf in zip(self.servers, leaves):
             arr = np.asarray(leaf)
+            if batch_add:
+                # 'add' is linear and order-independent: pre-sum the client
+                # blocks on the host and make ONE server trip per leaf
+                # instead of one per rank — the vectorized analog of the
+                # reference's chunked Isend fan-out amortization
+                # (parameterserver.cpp:309-353). local_update keeps its
+                # per-block contract (it may not be linear, e.g. clipping).
+                if local_update is None:
+                    total = arr[np.asarray(ranks)].sum(axis=0)
+                else:
+                    total = np.sum(
+                        [np.asarray(local_update(arr[r])) for r in ranks],
+                        axis=0,
+                    )
+                handles.append(
+                    srv.send(total, rule="add", client=ranks[0], scale=scale)
+                )
+                continue
             for r in ranks:
                 block = arr[r]
                 if local_update is not None:
@@ -94,27 +113,81 @@ class PSGroup:
         ]
         return [h for per_srv in self._prefetched for h in per_srv]
 
+    def integrate_tensors_stacked(
+        self, params, fold: Callable, client_ranks=None
+    ):
+        """Vectorized integration: ``fold(fetched, blocks)`` receives the
+        WHOLE ``[k, *leaf_shape]`` stack of fetches and the matching
+        client blocks per leaf and returns ``(new_blocks, extra)`` —
+        ONE stacked numpy op per leaf instead of a per-rank python loop
+        (the O(bytes) analog of the reference's chunked fan-out,
+        ``parameterserver.cpp:309-353``). Returns
+        ``(params, ranks, extras)`` with ``extras[i]`` = leaf i's fold
+        extra (schedules use it for e.g. EASGD's elastic differences).
+        Ranks that did not prefetch keep their block unchanged."""
+        ranks, stacks = self.wait_prefetched_stacked(
+            client_ranks=client_ranks
+        )
+        idx = np.asarray(ranks)
+        leaves = list(tree_util.tree_leaves(params))
+        extras = []
+        for i, fetched in enumerate(stacks):
+            arr = np.array(leaves[i])  # mutable host copy
+            new_blocks, extra = fold(fetched, arr[idx])
+            arr[idx] = new_blocks
+            leaves[i] = jnp.asarray(arr)
+            extras.append(extra)
+        return (
+            tree_util.tree_unflatten(self.treedef, leaves),
+            ranks,
+            extras,
+        )
+
     def integrate_tensors(self, params, fn: Callable, client_ranks=None):
-        """Wait prefetches and fold them into the rank-stacked params:
-        ``new_block = fn(fetched, block)`` per (leaf, client rank)
-        (``integrateTensors``, ``parameterserver/init.lua:173-184``).
-        Ranks that did not prefetch keep their block unchanged.
+        """Per-block integration: ``new_block = fn(fetched, block)`` per
+        (leaf, client rank) (``integrateTensors``,
+        ``parameterserver/init.lua:173-184``) — the compat wrapper over
+        :meth:`integrate_tensors_stacked` for folds that are not
+        vectorizable.
 
         If no prefetch is outstanding (e.g. the first integration of a
         schedule whose first prefetch lands *after* it — the reference's
         counter arithmetic allows this and falls back to the init-time
         buffers), a synchronous fetch is issued now."""
+
+        def fold(fetched, blocks):
+            return (
+                np.stack(
+                    [
+                        np.asarray(fn(fetched[j], blocks[j]))
+                        for j in range(len(fetched))
+                    ]
+                ),
+                None,
+            )
+
+        params, _, _ = self.integrate_tensors_stacked(
+            params, fold, client_ranks=client_ranks
+        )
+        return params
+
+    def wait_prefetched_stacked(self, client_ranks=None):
+        """Wait the outstanding prefetches (issuing synchronous ones when
+        none are pending, like :meth:`integrate_tensors`) and return
+        ``(ranks, stacks)`` where ``stacks[i]`` is a ``[k, *leaf_shape]``
+        numpy array of the k client fetches of leaf i. This is the
+        vectorized integration primitive: schedules fold a whole leaf in
+        ONE stacked numpy op instead of a per-rank python loop (O(bytes),
+        not O(ranks x leaves) interpreter trips)."""
         if self._prefetched is None:
             self.prefetch_tensors(client_ranks=client_ranks)
-        leaves = list(tree_util.tree_leaves(params))
-        for i, (srv, per_srv) in enumerate(zip(self.servers, self._prefetched)):
-            arr = np.array(leaves[i])  # mutable host copy
-            for r, h in zip(self._prefetch_ranks, per_srv):
-                fetched = h.wait()
-                arr[r] = fn(fetched, arr[r])
-            leaves[i] = jnp.asarray(arr)
+        ranks = list(self._prefetch_ranks)
+        stacks = [
+            np.stack([np.asarray(h.wait()) for h in per_srv])
+            for per_srv in self._prefetched
+        ]
         self._prefetched = None
-        return tree_util.tree_unflatten(self.treedef, leaves)
+        return ranks, stacks
 
     def receive_full(self, client: int = 0):
         """Synchronously fetch the full center value of every leaf."""

@@ -60,13 +60,45 @@ _KIND_BARRIER = 6
 _HEADER = struct.Struct(">HBIIIQIIHHQ")
 
 
+# Auto-derived per-job frame secret (see _init_job_token): 0 only until
+# the transport bootstraps or in single-process runs (no listener peers).
+_job_token_value = 0
+
+
 def _auth_token() -> int:
     tok = os.environ.get("TORCHMPI_TPU_PS_TOKEN", "")
     if not tok:
-        return 0
+        return _job_token_value
     import zlib
 
     return zlib.crc32(tok.encode()) & 0xFFFFFFFF
+
+
+def _init_job_token() -> None:
+    """Derive a shared per-job frame secret from the runtime's coordination
+    service (process 0 broadcasts random bytes at transport bootstrap), so
+    the PS listener is never open unauthenticated by default — previously
+    auth was opt-in via TORCHMPI_TPU_PS_TOKEN and any network peer could
+    read or mutate parameters. The env token still overrides (stable
+    secrets across restarts). Ordering: runs BEFORE the address exchange,
+    so no peer can learn this listener's address until every process holds
+    the secret."""
+    global _job_token_value
+    if os.environ.get("TORCHMPI_TPU_PS_TOKEN", ""):
+        return
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    import zlib
+
+    from jax.experimental import multihost_utils
+
+    seed = np.frombuffer(os.urandom(16), np.uint8)
+    tok = multihost_utils.broadcast_one_to_all(
+        seed, is_source=jax.process_index() == 0
+    )
+    _job_token_value = zlib.crc32(bytes(np.asarray(tok))) & 0xFFFFFFFF
 
 
 def instance_fingerprint(shape, dtype, size: int, owners) -> int:
@@ -141,11 +173,19 @@ class _Listener:
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         # UPDATE dedup: last applied seq per (inst, rank, client) — a
-        # reconnect retry after a lost ACK must not double-apply
+        # reconnect retry after a lost ACK must not double-apply. The
+        # in-progress table closes the remaining window: a retry arriving
+        # while the FIRST apply is still running (applied-seq not yet
+        # recorded) waits for that apply instead of re-posting it.
         self._applied: Dict[Tuple[int, int, int], int] = {}
+        self._inflight: Dict[Tuple[Tuple[int, int, int], int], threading.Event] = {}
         self._applied_lock = threading.Lock()
-        # subset barrier bookkeeping: tag -> set of origin processes seen
-        self._barrier_seen: Dict[str, set] = {}
+        # subset barrier bookkeeping: tag -> per-origin ARRIVAL COUNTERS
+        # (not a set): a fast peer's next barrier frame with the same tag
+        # can land before this process finishes waiting on the current
+        # one; counting generations keeps that early arrival banked for
+        # the next wait instead of silently discarding it.
+        self._barrier_seen: Dict[str, Dict[int, int]] = {}
         self._barrier_cv = threading.Condition()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -156,16 +196,27 @@ class _Listener:
 
     def barrier_arrived(self, tag: str, origin: int) -> None:
         with self._barrier_cv:
-            self._barrier_seen.setdefault(tag, set()).add(origin)
+            counts = self._barrier_seen.setdefault(tag, {})
+            counts[origin] = counts.get(origin, 0) + 1
             self._barrier_cv.notify_all()
 
     def barrier_wait(self, tag: str, expect: set, timeout=None) -> bool:
+        def _ready() -> bool:
+            counts = self._barrier_seen.get(tag, {})
+            return all(counts.get(o, 0) >= 1 for o in expect)
+
         with self._barrier_cv:
-            ok = self._barrier_cv.wait_for(
-                lambda: expect <= self._barrier_seen.get(tag, set()), timeout
-            )
+            ok = self._barrier_cv.wait_for(_ready, timeout)
             if ok:
-                self._barrier_seen.pop(tag, None)
+                # consume ONE generation per origin; surplus arrivals stay
+                # banked for the caller's next barrier with this tag
+                counts = self._barrier_seen.get(tag, {})
+                for o in expect:
+                    counts[o] -= 1
+                    if counts[o] <= 0:
+                        counts.pop(o, None)
+                if not counts:
+                    self._barrier_seen.pop(tag, None)
             return ok
 
     def _accept_loop(self):
@@ -221,44 +272,77 @@ class _Listener:
 
                 if kind == _KIND_UPDATE:
                     dkey = (inst_id, rank, client)
+                    ikey = (dkey, seq)
+                    owner = True
+                    pending: Optional[_threading.Event] = None
                     with self._applied_lock:
                         if seq and self._applied.get(dkey, 0) >= seq:
                             # retry of an already-applied update: ack only
                             _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
                             continue
-                    values = np.frombuffer(payload, np.dtype(dtype))
-                    ev = _threading.Event()
-                    from .server import _CancelToken
-
-                    token = _CancelToken()
-                    msg = _Message(
-                        "update", client=client, rule=rule,
-                        payload=values.copy(), done=ev, cancelled=token,
-                    )
-                    inst.post(rank, msg)
-                    if not ev.wait(timeout):
-                        # atomically withdraw: if the server has not
-                        # STARTED applying, it never will (serve_once
-                        # CAS-checks the token) and the failure report is
-                        # exact; if it is mid-apply, wait for it to finish
-                        # and report the true outcome instead of lying.
-                        if token.cancel():
+                        if seq:
+                            pending = self._inflight.get(ikey)
+                            if pending is None:
+                                self._inflight[ikey] = _threading.Event()
+                            else:
+                                owner = False
+                    if not owner:
+                        # a reconnect retry racing the FIRST apply (its
+                        # seq not yet recorded): wait for that apply and
+                        # report ITS outcome — re-posting would apply a
+                        # non-idempotent rule ('add') twice.
+                        pending.wait(timeout)
+                        with self._applied_lock:
+                            done = self._applied.get(dkey, 0) >= seq
+                        if done:
+                            _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
+                        else:
                             _send_frame(
                                 conn, _KIND_ERROR,
-                                rule="remote update apply timed out",
+                                rule="original update apply did not complete",
+                            )
+                        continue
+                    try:
+                        values = np.frombuffer(payload, np.dtype(dtype))
+                        ev = _threading.Event()
+                        from .server import _CancelToken
+
+                        token = _CancelToken()
+                        msg = _Message(
+                            "update", client=client, rule=rule,
+                            payload=values.copy(), done=ev, cancelled=token,
+                        )
+                        inst.post(rank, msg)
+                        if not ev.wait(timeout):
+                            # atomically withdraw: if the server has not
+                            # STARTED applying, it never will (serve_once
+                            # CAS-checks the token) and the failure report
+                            # is exact; if it is mid-apply, wait for it to
+                            # finish and report the true outcome instead
+                            # of lying.
+                            if token.cancel():
+                                _send_frame(
+                                    conn, _KIND_ERROR,
+                                    rule="remote update apply timed out",
+                                )
+                                continue
+                            ev.wait()  # apply in progress: it will complete
+                        if msg.error is not None:
+                            _send_frame(
+                                conn, _KIND_ERROR,
+                                rule=f"update apply failed: {msg.error}",
                             )
                             continue
-                        ev.wait()  # apply in progress: it will complete
-                    if msg.error is not None:
-                        _send_frame(
-                            conn, _KIND_ERROR,
-                            rule=f"update apply failed: {msg.error}",
-                        )
-                        continue
-                    with self._applied_lock:
+                        with self._applied_lock:
+                            if seq:
+                                self._applied[dkey] = seq
+                        _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
+                    finally:
                         if seq:
-                            self._applied[dkey] = seq
-                    _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
+                            with self._applied_lock:
+                                done_ev = self._inflight.pop(ikey, None)
+                            if done_ev is not None:
+                                done_ev.set()
                 elif kind == _KIND_TRIGGER:
                     f: Future = Future()
                     inst.post(rank, _Message("trigger", client=client, reply=f))
@@ -311,6 +395,15 @@ class _PeerPool:
             try:
                 sock = socket.create_connection((candidate, port), timeout=30)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # The 30s above bounds only the CONNECT. Established
+                # sockets must not inherit it: a server apply slower than
+                # 30s would raise timeout, reconnect, and resend — racing
+                # the still-in-flight first apply (double-apply risk for
+                # non-idempotent rules). Block indefinitely, or for the
+                # explicit deadlock watchdog when one is configured.
+                sock.settimeout(
+                    constants.get("deadlock_timeout_seconds") or None
+                )
                 return sock
             except OSError as e:  # try localhost fallback (single-host test)
                 last_err = e
@@ -389,6 +482,10 @@ class Transport:
 
         self.process_index = jax.process_index()
         self.listener = _Listener(lookup_instance)
+        # token FIRST, then addresses: peers cannot reach the listener
+        # before the exchange publishes its address, and by then every
+        # process holds the job secret
+        _init_job_token()
         host = os.environ.get("TORCHMPI_TPU_PS_HOST") or socket.gethostname()
         addresses = self._exchange_addresses(host, self.listener.port)
         self.pool = _PeerPool(addresses)

@@ -314,12 +314,21 @@ def _cache_key(comm: Communicator) -> str:
 
 def save_tuning(comm: Optional[Communicator] = None) -> Path:
     """Persist the current values of every tunable routing constant under
-    this (platform, world size)."""
+    this (platform, world size).
+
+    Multi-process safe: the write is atomic (temp file + ``os.replace``)
+    so a reader or a crash never sees a torn file, and every process
+    writes — the cache path is HOST-local (~/.cache), so gating on a
+    global rank would leave other hosts' caches empty and their processes
+    loading default routing constants on restart (divergent SPMD backend
+    choices across controllers). Same-host concurrent writers all persist
+    the SAME (platform, size) entry with the same measured values, so
+    last-writer-wins is content-identical."""
     comm = _comm(comm)
+    path = _cache_path()
     suffix = _suffix(comm)
     names = [t.format(s=suffix) for t in _TUNABLE]
     entry = {n: constants.get(n) for n in names}
-    path = _cache_path()
     path.parent.mkdir(parents=True, exist_ok=True)
     data = {}
     if path.exists():
@@ -328,7 +337,9 @@ def save_tuning(comm: Optional[Communicator] = None) -> Path:
         except Exception:
             data = {}
     data[_cache_key(comm)] = entry
-    path.write_text(json.dumps(data, indent=2, sort_keys=True))
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    tmp.write_text(json.dumps(data, indent=2, sort_keys=True))
+    os.replace(tmp, path)
     return path
 
 

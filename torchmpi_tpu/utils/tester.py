@@ -182,3 +182,49 @@ def run_matrix(
                         report(res)
         free_collective_resources(comm)
     return results
+
+
+def run_ps_throughput(
+    comm: Communicator,
+    nelem: int = 1 << 20,
+    warmup: int = 3,
+    timed: int = 10,
+):
+    """Parameter-server center-traffic throughput: timed client
+    ``send('add')`` fan-out (handle completes on APPLIED, the Ssend
+    happens-before) and full ``receive`` assembly, reported in MB/s — the
+    PS analog of the collectives bus-bandwidth lines, matching the
+    reference's chunked clientSend/clientReceive hot path
+    (``lib/parameterserver.cpp:309-400``).
+
+    Single-controller runs measure the in-process shard pipeline; under
+    multi-controller JAX the same call exercises the cross-process socket
+    transport (run the bench example once per process). Returns a dict
+    with ``send_mbps``, ``recv_mbps``, ``nbytes``.
+    """
+    from ..parameterserver.server import ParameterServer
+
+    x = np.ones(nelem, np.float32)
+    nbytes = x.nbytes
+    ps = ParameterServer(np.zeros(nelem, np.float32), comm=comm)
+    try:
+        for _ in range(warmup):
+            ps.send(x, rule="add").wait()
+        t0 = time.perf_counter()
+        for _ in range(timed):
+            ps.send(x, rule="add").wait()
+        send_dt = time.perf_counter() - t0
+
+        for _ in range(warmup):
+            ps.receive().wait()
+        t0 = time.perf_counter()
+        for _ in range(timed):
+            ps.receive().wait()
+        recv_dt = time.perf_counter() - t0
+    finally:
+        ps.free()
+    return {
+        "send_mbps": nbytes * timed / send_dt / 1e6,
+        "recv_mbps": nbytes * timed / recv_dt / 1e6,
+        "nbytes": nbytes,
+    }
