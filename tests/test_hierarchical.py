@@ -260,3 +260,26 @@ def test_hierarchical_pallas_routed_from_dispatch():
         ), "hier path did not compile the pallas intra variant"
     finally:
         rk._FORCE_INTERPRET = False
+
+
+def test_hierarchical_pallas_bidir_intra_phase():
+    """ring_implementation='pallas_bidir' reaches the hierarchical intra
+    phase too (not just the flat path the autotuner measures)."""
+    from torchmpi_tpu.collectives.eager import run_hierarchical_allreduce
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    p, comm = _2level()
+    mpi.constants.set("ring_implementation", "pallas_bidir")
+    rk._FORCE_INTERPRET = True
+    try:
+        rng = np.random.RandomState(9)
+        x = jnp.asarray(rng.randn(p, 300).astype(np.float32))
+        rk._LAST_STEP_COUNTS.clear()
+        out = np.asarray(run_hierarchical_allreduce(x, comm, impl="pallas"))
+        np.testing.assert_allclose(
+            out, np.tile(np.asarray(x).sum(axis=0), (p, 1)), rtol=2e-5,
+            atol=1e-5,
+        )
+        assert "allreduce_bidir" in rk._LAST_STEP_COUNTS
+    finally:
+        rk._FORCE_INTERPRET = False

@@ -374,3 +374,111 @@ def test_transport_retry_waits_for_inflight_apply():
         s2.close()
     finally:
         lst.close()
+
+
+def test_transport_multi_rank_update_frame():
+    """A _KIND_UPDATE_MULTI frame applies every (rank, slice) it carries
+    and is acked/deduped as a unit (one round trip per peer instead of
+    one per shard rank)."""
+    import socket
+    import threading
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applied = {}
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            applied.setdefault(rank, []).append(
+                np.asarray(msg.payload).copy()
+            )
+            msg.done.set()
+
+    lst = T._Listener(lambda i: FakeInst())
+    try:
+        s = socket.create_connection(("localhost", lst.port), timeout=10)
+        s.settimeout(10)
+        a = np.arange(4, dtype=np.float32)
+        b = np.arange(6, dtype=np.float32) + 100
+        payload = (
+            T._MULTI_COUNT.pack(2)
+            + T._MULTI_ITEM.pack(0, a.nbytes)
+            + T._MULTI_ITEM.pack(3, b.nbytes)
+            + a.tobytes()
+            + b.tobytes()
+        )
+        kw = dict(
+            inst=1, rank=T._MULTI_RANK, client=2, seq=9, rule="add",
+            dtype=a.dtype.str, payload=payload,
+        )
+        T._send_frame(s, T._KIND_UPDATE_MULTI, **kw)
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        np.testing.assert_array_equal(applied[0][0], a)
+        np.testing.assert_array_equal(applied[3][0], b)
+        # retry of the same frame (post-ACK): deduped, applied exactly once
+        T._send_frame(s, T._KIND_UPDATE_MULTI, **kw)
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        assert len(applied[0]) == 1 and len(applied[3]) == 1
+        s.close()
+    finally:
+        lst.close()
+
+
+def test_transport_poisoned_multi_frame_not_reapplied():
+    """A partially-failed multi frame must answer its reconnect retry from
+    the poison record — never re-apply the items that succeeded."""
+    import socket
+    import threading
+    import time
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applies = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                if rank == 3:
+                    msg.error = "shard 3 exploded"
+                else:
+                    applies.append(rank)
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    lst = T._Listener(lambda i: FakeInst())
+    try:
+        s = socket.create_connection(("localhost", lst.port), timeout=10)
+        s.settimeout(10)
+        a = np.ones(4, np.float32)
+        payload = (
+            T._MULTI_COUNT.pack(2)
+            + T._MULTI_ITEM.pack(0, a.nbytes)
+            + T._MULTI_ITEM.pack(3, a.nbytes)
+            + a.tobytes() * 2
+        )
+        kw = dict(
+            inst=1, rank=T._MULTI_RANK, client=0, seq=4, rule="add",
+            dtype=a.dtype.str, payload=payload,
+        )
+        T._send_frame(s, T._KIND_UPDATE_MULTI, **kw)
+        k, *_, rrule, _, _ = T._recv_frame(s)
+        assert k == T._KIND_ERROR and "exploded" in rrule
+        assert applies == [0]  # rank 0 applied once, rank 3 failed
+        # the reconnect retry (same seq): answered from the poison record,
+        # rank 0 NOT re-applied
+        s2 = socket.create_connection(("localhost", lst.port), timeout=10)
+        s2.settimeout(10)
+        T._send_frame(s2, T._KIND_UPDATE_MULTI, **kw)
+        k2, *_, rrule2, _, _ = T._recv_frame(s2)
+        assert k2 == T._KIND_ERROR and "exploded" in rrule2
+        time.sleep(0.1)
+        assert applies == [0], applies
+        s.close()
+        s2.close()
+    finally:
+        lst.close()

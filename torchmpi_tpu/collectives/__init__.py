@@ -54,7 +54,9 @@ def _dispatch(op, x, comm, mode, backend=None, **kw):
             from .selector import backend_availability
 
             impl = constants.get("ring_implementation")
-            if impl == "pallas" and backend_availability().get("pallas"):
+            if impl in ("pallas", "pallas_bidir") and backend_availability().get(
+                "pallas"
+            ):
                 backend = "pallas"
             elif impl == "ppermute":
                 backend = "ring"

@@ -284,6 +284,7 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
         # (a single point-to-point hop IS one XLA collective-permute — a
         # ring kernel would add nothing).
         from ..ops.ring_kernels import (
+            ring_allreduce_bidir_pallas,
             ring_allreduce_pallas,
             ring_broadcast_pallas,
             ring_reduce_pallas,
@@ -292,9 +293,14 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
         _pallas_bcast = _bcast_builder(
             lambda b, k: ring_broadcast_pallas(b, root, _AXIS, num_chunks=k)
         )
+        _pallas_allreduce = (
+            ring_allreduce_bidir_pallas
+            if "bidir" in extra
+            else ring_allreduce_pallas
+        )
 
         table = {
-            "allreduce": lambda b: ring_allreduce_pallas(b, _AXIS),
+            "allreduce": lambda b: _pallas_allreduce(b, _AXIS),
             "broadcast": _pallas_bcast,
             "reduce": lambda b: ring_reduce_pallas(b, root, _AXIS),
             "allgather": lambda b: _pallas_allgather_lastdim(b, _AXIS),
@@ -392,6 +398,14 @@ def run(
         # (collectives_cuda.cpp:569-579)
         return run_tree_hierarchical_allreduce(x, comm)
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
+    if (
+        effective == "pallas"
+        and op == "allreduce"
+        and constants.get("ring_implementation") == "pallas_bidir"
+    ):
+        # bidirectional-ring variant; participates in the executable cache
+        # key via ``extra`` so toggling the constant recompiles
+        extra = extra + ("bidir",)
     tuning: Tuple = ()
     if effective in ("ring", "pallas"):
         tuning = ring_tuning(platform)
@@ -548,21 +562,34 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
         if impl in ("ring", "pallas")
         else ()
     )
+    # the uni-vs-bidirectional pallas variant participates in the cache
+    # key: the autotuner toggles ring_implementation between measurements
+    bidir = (
+        impl == "pallas"
+        and constants.get("ring_implementation") == "pallas_bidir"
+    )
     key = (
         "hier_allreduce", impl, tuple(x.shape), jnp.result_type(x), donate,
-        tuning,
+        tuning, bidir,
     )
 
     if impl == "pallas":
-        # intra = ICI: the Pallas RDMA ring; inter = cross-ICI/DCN: the
-        # ppermute ring (XLA schedules it over the slower fabric) — the
-        # reference's intra-IPC-ring x inter-MPI split.
-        from ..ops.ring_kernels import ring_allreduce_pallas
+        # intra = ICI: the Pallas RDMA ring (uni- or bidirectional per
+        # ring_implementation); inter = cross-ICI/DCN: the ppermute ring
+        # (XLA schedules it over the slower fabric) — the reference's
+        # intra-IPC-ring x inter-MPI split.
+        from ..ops.ring_kernels import (
+            ring_allreduce_bidir_pallas,
+            ring_allreduce_pallas,
+        )
 
+        intra_ring = (
+            ring_allreduce_bidir_pallas if bidir else ring_allreduce_pallas
+        )
         minb, maxb, nbuf = tuning
 
         def kernel(b):
-            b = ring_allreduce_pallas(b, "intra")
+            b = intra_ring(b, "intra")
             return prim.ring_allreduce(
                 b, "inter",
                 max_bytes_per_step=maxb, min_bytes_per_step=minb,

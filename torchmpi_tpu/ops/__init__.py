@@ -4,6 +4,7 @@ from .reduce_kernel import accumulate, scale_accumulate
 from .ring_kernels import (
     available,
     ring_allgather_pallas,
+    ring_allreduce_bidir_pallas,
     ring_allreduce_pallas,
     ring_broadcast_pallas,
     ring_reduce_pallas,
@@ -16,6 +17,7 @@ __all__ = [
     "scale_accumulate",
     "available",
     "ring_allgather_pallas",
+    "ring_allreduce_bidir_pallas",
     "ring_allreduce_pallas",
     "ring_broadcast_pallas",
     "ring_reduce_pallas",

@@ -538,11 +538,21 @@ class ParameterServer:
 
             def send_to(proc, ranks, errs):
                 try:
-                    for r in ranks:
+                    # acked after the peer APPLIED the rule (clientSend's
+                    # Ssend happens-before, parameterserver.cpp:339-347);
+                    # all of a peer's shard slices travel in ONE frame
+                    if len(ranks) > 1:
+                        transport.update_multi(
+                            proc, inst.id,
+                            [
+                                (r, flat[inst.ranges[r][0]:inst.ranges[r][1]])
+                                for r in ranks
+                            ],
+                            client, rule, fp=inst.fingerprint,
+                        )
+                    else:
+                        r = ranks[0]
                         s, e = inst.ranges[r]
-                        # acked after the peer APPLIED the rule
-                        # (clientSend's Ssend happens-before,
-                        # parameterserver.cpp:339-347)
                         transport.update(
                             proc, inst.id, r, client, rule, flat[s:e],
                             fp=inst.fingerprint,

@@ -45,6 +45,17 @@ _KIND_ACK = 3
 _KIND_SHARD = 4
 _KIND_ERROR = 5
 _KIND_BARRIER = 6
+# one frame carrying updates for SEVERAL shard ranks owned by the same
+# peer: payload = u32 count, then count x (u32 rank, u64 nbytes) headers,
+# then the concatenated slice bytes. One round trip (and one applied-ack)
+# per peer instead of one per rank — the frame-level analog of the
+# reference's chunked Isend fan-out (parameterserver.cpp:309-353).
+_KIND_UPDATE_MULTI = 7
+_MULTI_COUNT = struct.Struct(">I")
+_MULTI_ITEM = struct.Struct(">IQ")
+# the `rank` header field of a multi frame (dedup key sentinel: the frame
+# is deduped as a unit, not per rank)
+_MULTI_RANK = 0xFFFFFFFF
 
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
 #        fp u32, token u32, rule_len u16, dtype_len u16, payload_len u64
@@ -155,6 +166,41 @@ def _recv_frame(sock: socket.socket):
     return kind, inst, rank, client, seq, fp, rule, dtype, payload
 
 
+def _enable_keepalive(sock: socket.socket) -> None:
+    """Kernel-level liveness detection for blocking PS sockets: probe after
+    30s idle, every 15s, declare dead after 3 misses (~75s). Distinguishes
+    a dead/partitioned peer (error) from a live-but-slow apply (fine)."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    for opt, val in (
+        ("TCP_KEEPIDLE", 30),
+        ("TCP_KEEPINTVL", 15),
+        ("TCP_KEEPCNT", 3),
+    ):
+        if hasattr(socket, opt):  # linux names; best-effort elsewhere
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+            except OSError:
+                pass
+
+
+def _parse_multi_payload(payload: bytes, dt: np.dtype):
+    """Decode a _KIND_UPDATE_MULTI payload into [(rank, values)]."""
+    (count,) = _MULTI_COUNT.unpack_from(payload, 0)
+    off = _MULTI_COUNT.size
+    metas = []
+    for _ in range(count):
+        r, nb = _MULTI_ITEM.unpack_from(payload, off)
+        off += _MULTI_ITEM.size
+        metas.append((r, nb))
+    items = []
+    for r, nb in metas:
+        items.append(
+            (r, np.frombuffer(payload, dt, count=nb // dt.itemsize, offset=off))
+        )
+        off += nb
+    return items
+
+
 class _Listener:
     """Accept loop serving this process's shard ranks."""
 
@@ -179,6 +225,12 @@ class _Listener:
         # recorded) waits for that apply instead of re-posting it.
         self._applied: Dict[Tuple[int, int, int], int] = {}
         self._inflight: Dict[Tuple[Tuple[int, int, int], int], threading.Event] = {}
+        # poisoned multi frames: a PARTIALLY-applied _KIND_UPDATE_MULTI
+        # (one item applied, another failed) must never be re-applied by
+        # a reconnect retry whose ERROR response was lost — the retry is
+        # answered from this record instead (bounded FIFO; failures are
+        # rare and fatal to the client anyway)
+        self._failed: Dict[Tuple[Tuple[int, int, int], int], str] = {}
         self._applied_lock = threading.Lock()
         # subset barrier bookkeeping: tag -> per-origin ARRIVAL COUNTERS
         # (not a set): a fast peer's next barrier frame with the same tag
@@ -270,16 +322,26 @@ class _Listener:
                 timeout = constants.get("deadlock_timeout_seconds") or None
                 from .server import _Message
 
-                if kind == _KIND_UPDATE:
+                if kind in (_KIND_UPDATE, _KIND_UPDATE_MULTI):
                     dkey = (inst_id, rank, client)
                     ikey = (dkey, seq)
                     owner = True
                     pending: Optional[_threading.Event] = None
+                    poisoned = None
                     with self._applied_lock:
                         if seq and self._applied.get(dkey, 0) >= seq:
                             # retry of an already-applied update: ack only
                             _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
                             continue
+                        if seq:
+                            poisoned = self._failed.get(ikey)
+                    if poisoned is not None:
+                        # retry of a partially-applied multi frame whose
+                        # ERROR response was lost: re-report, never
+                        # re-apply (items that succeeded would double)
+                        _send_frame(conn, _KIND_ERROR, rule=poisoned)
+                        continue
+                    with self._applied_lock:
                         if seq:
                             pending = self._inflight.get(ikey)
                             if pending is None:
@@ -303,35 +365,57 @@ class _Listener:
                             )
                         continue
                     try:
-                        values = np.frombuffer(payload, np.dtype(dtype))
-                        ev = _threading.Event()
+                        dt = np.dtype(dtype)
+                        if kind == _KIND_UPDATE_MULTI:
+                            items = _parse_multi_payload(payload, dt)
+                        else:
+                            items = [(rank, np.frombuffer(payload, dt))]
                         from .server import _CancelToken
 
-                        token = _CancelToken()
-                        msg = _Message(
-                            "update", client=client, rule=rule,
-                            payload=values.copy(), done=ev, cancelled=token,
-                        )
-                        inst.post(rank, msg)
-                        if not ev.wait(timeout):
-                            # atomically withdraw: if the server has not
-                            # STARTED applying, it never will (serve_once
-                            # CAS-checks the token) and the failure report
-                            # is exact; if it is mid-apply, wait for it to
-                            # finish and report the true outcome instead
-                            # of lying.
-                            if token.cancel():
-                                _send_frame(
-                                    conn, _KIND_ERROR,
-                                    rule="remote update apply timed out",
-                                )
-                                continue
-                            ev.wait()  # apply in progress: it will complete
-                        if msg.error is not None:
-                            _send_frame(
-                                conn, _KIND_ERROR,
-                                rule=f"update apply failed: {msg.error}",
+                        posted = []
+                        for r, values in items:
+                            ev = _threading.Event()
+                            token = _CancelToken()
+                            msg = _Message(
+                                "update", client=client, rule=rule,
+                                payload=values.copy(), done=ev,
+                                cancelled=token,
                             )
+                            inst.post(r, msg)
+                            posted.append((ev, token, msg))
+                        failure: Optional[str] = None
+                        for ev, token, msg in posted:
+                            if not ev.wait(timeout):
+                                # atomically withdraw: if the server has
+                                # not STARTED applying, it never will
+                                # (serve_once CAS-checks the token) and
+                                # the failure report is exact; if it is
+                                # mid-apply, wait for it to finish and
+                                # report the true outcome instead of
+                                # lying.
+                                if token.cancel():
+                                    failure = "remote update apply timed out"
+                                    continue
+                                ev.wait()  # apply in progress: completes
+                            if msg.error is not None:
+                                failure = f"update apply failed: {msg.error}"
+                        if failure is not None:
+                            # A multi frame is acked/deduped as a UNIT.
+                            # The error is fatal client-side (the pool
+                            # never resends on _KIND_ERROR) — but the
+                            # ERROR response itself can be lost to a
+                            # connection drop, and the reconnect RESEND
+                            # must not re-apply the items that succeeded:
+                            # poison this (key, seq) so the retry is
+                            # answered from the record.
+                            if kind == _KIND_UPDATE_MULTI and seq:
+                                with self._applied_lock:
+                                    while len(self._failed) >= 64:
+                                        self._failed.pop(
+                                            next(iter(self._failed))
+                                        )
+                                    self._failed[ikey] = failure
+                            _send_frame(conn, _KIND_ERROR, rule=failure)
                             continue
                         with self._applied_lock:
                             if seq:
@@ -400,7 +484,12 @@ class _PeerPool:
                 # 30s would raise timeout, reconnect, and resend — racing
                 # the still-in-flight first apply (double-apply risk for
                 # non-idempotent rules). Block indefinitely, or for the
-                # explicit deadlock watchdog when one is configured.
+                # explicit deadlock watchdog when one is configured —
+                # with TCP keepalive as the liveness bound: a crashed or
+                # partitioned peer surfaces as a ConnectionError in
+                # ~75s instead of hanging forever, while a merely SLOW
+                # apply (live peer) never trips it.
+                _enable_keepalive(sock)
                 sock.settimeout(
                     constants.get("deadlock_timeout_seconds") or None
                 )
@@ -423,6 +512,8 @@ class _PeerPool:
         fp: int = 0,
         rule: str = "",
         payload_arr: Optional[np.ndarray] = None,
+        payload_raw: bytes = b"",
+        dtype_str: str = "",
     ):
         """Synchronous request/response on the pooled connection. Safe to
         retry on connection loss: UPDATEs carry ``seq`` (``use_seq``),
@@ -430,15 +521,15 @@ class _PeerPool:
         assignment order == wire order, so concurrent sends cannot be
         misdeduped as retries."""
         seq = 0
+        if payload_arr is not None:
+            payload_raw = payload_arr.tobytes()
+            dtype_str = payload_arr.dtype.str
 
         def _do(sock):
-            if payload_arr is not None:
-                _send_frame(
-                    sock, kind, inst, rank, client, seq, fp, rule,
-                    payload_arr.dtype.str, payload_arr.tobytes(),
-                )
-            else:
-                _send_frame(sock, kind, inst, rank, client, seq, fp, rule)
+            _send_frame(
+                sock, kind, inst, rank, client, seq, fp, rule,
+                dtype_str, payload_raw,
+            )
             return _recv_frame(sock)
 
         with self._locks[proc]:
@@ -514,6 +605,30 @@ class Transport:
         self.pool.request(
             proc, _KIND_UPDATE, inst, rank, client,
             use_seq=True, fp=fp, rule=rule, payload_arr=payload,
+        )
+
+    def update_multi(
+        self, proc: int, inst: int, rank_slices, client: int, rule: str,
+        fp: int = 0,
+    ) -> None:
+        """One frame carrying updates for every shard rank this peer owns
+        (``rank_slices`` = [(rank, 1-D array)], all one dtype): one round
+        trip + one applied-ack per peer instead of one per rank — the
+        frame-level analog of the reference's per-chunk Isend fan-out
+        (``parameterserver.cpp:309-353``)."""
+        arrs = [np.ascontiguousarray(a) for _, a in rank_slices]
+        payload = b"".join(
+            [_MULTI_COUNT.pack(len(rank_slices))]
+            + [
+                _MULTI_ITEM.pack(r, a.nbytes)
+                for (r, _), a in zip(rank_slices, arrs)
+            ]
+            + [a.tobytes() for a in arrs]
+        )
+        self.pool.request(
+            proc, _KIND_UPDATE_MULTI, inst, _MULTI_RANK, client,
+            use_seq=True, fp=fp, rule=rule,
+            payload_raw=payload, dtype_str=arrs[0].dtype.str,
         )
 
     def trigger(
