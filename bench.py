@@ -139,11 +139,16 @@ def _metric_name(model):
     return {
         "mnist": "MNIST LeNet AllReduceSGD samples/sec/chip",
         "resnet50": "ResNet-50 synthetic-ImageNet DP img/s/chip",
+        "lm": "LongContextTransformer LM tokens/sec/chip",
     }[model]
 
 
 def _metric_unit(model):
-    return {"mnist": "samples/sec/chip", "resnet50": "img/s/chip"}[model]
+    return {
+        "mnist": "samples/sec/chip",
+        "resnet50": "img/s/chip",
+        "lm": "tokens/sec/chip",
+    }[model]
 
 
 # --------------------------------------------------------------------------
@@ -155,6 +160,29 @@ def _worker_setup():
     sys.path.insert(0, str(HERE))
     import jax
 
+    # Honor an explicit CPU request BEFORE the first backend touch: the
+    # box's TPU plugin (sitecustomize) wins over the JAX_PLATFORMS env
+    # var, and probing a busy/dead tunnel hangs rather than raising.
+    force = os.environ.get("TORCHMPI_TPU_FORCE_CPU", "").lower()
+    if force in ("1", "true", "yes", "on") or (
+        os.environ.get("JAX_PLATFORMS") == "cpu"
+    ):
+        jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache: a worker killed mid-compile by the
+    # per-attempt timeout would otherwise recompile from scratch on retry;
+    # with the cache, the retry resumes where compilation got to.
+    cache_dir = os.environ.get(
+        "TORCHMPI_TPU_XLA_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "torchmpi_tpu", "xla"
+        ),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # cache is an optimization, never a requirement
     devices = jax.devices()
     platform = devices[0].platform
     if platform == "cpu" and len(devices) == 1:
@@ -320,6 +348,91 @@ def _worker_resnet50():
     mpi.stop()
 
 
+def _worker_lm():
+    """Long-context transformer LM training throughput (tokens/sec/chip),
+    device-resident epochs — the third tracked line: long context is
+    first-class in this framework (the 2017 reference predates it; SURVEY.md
+    §5 marks it absent there). Single-chip runs use the full-attention path;
+    the sequence-parallel ring-attention path is exercised by
+    ``dryrun_multichip`` (dp x sp) and ``examples/long_context.py``."""
+    devices, platform = _worker_setup()
+
+    import jax.numpy as jnp
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.engine import AllReduceSGDEngine
+    from torchmpi_tpu.models import (
+        LongContextTransformer,
+        init_lm_params,
+        make_lm_loss_fn,
+    )
+    from torchmpi_tpu.utils import synthetic_tokens
+    from torchmpi_tpu.utils.flops import (
+        train_flops,
+        transformer_forward_flops,
+    )
+
+    mpi.start()
+    comm = mpi.current_communicator()
+    p = comm.size
+
+    on_tpu = platform != "cpu"
+    # Sized to be compute-bound on one chip yet compile fast over the
+    # tunnel; CPU fallback shrinks everything so the virtual mesh run
+    # finishes in seconds.
+    cfg = dict(
+        vocab_size=8192 if on_tpu else 256,
+        num_layers=8 if on_tpu else 2,
+        num_heads=8 if on_tpu else 4,
+        head_dim=64 if on_tpu else 32,
+        d_model=512 if on_tpu else 128,
+    )
+    seq = 1024 if on_tpu else 128
+    num_seqs = 256 if on_tpu else 32
+    per_rank = 8 if on_tpu else 2
+    model = LongContextTransformer(
+        max_len=seq,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        **cfg,
+    )
+    params = init_lm_params(model, seq)
+    xtr, ytr = synthetic_tokens(
+        num_seqs=num_seqs, seq_len=seq, vocab=cfg["vocab_size"]
+    )
+    engine = AllReduceSGDEngine(
+        make_lm_loss_fn(model),
+        params,
+        optimizer=optax.adam(3e-4),
+    )
+    epochs = 6 if on_tpu else 2
+    state = engine.train_resident(
+        xtr, ytr, per_rank, max_epochs=1 + epochs
+    )
+    seqs_per_sec = _steady_rate(state, epochs, p)
+    value = seqs_per_sec * seq
+
+    line = {
+        "metric": _metric_name("lm"),
+        "value": round(value, 1),
+        "unit": _metric_unit("lm"),
+        "vs_baseline": 1.0,
+        "bound": "compute",
+        "seq_len": seq,
+    }
+    fwd = transformer_forward_flops(
+        seq,
+        cfg["d_model"],
+        cfg["num_layers"],
+        cfg["num_heads"],
+        cfg["head_dim"],
+        cfg["vocab_size"],
+    )
+    line.update(_flops_fields(value, train_flops(fwd) // seq, devices[0]))
+    print(json.dumps(line), flush=True)
+    mpi.stop()
+
+
 def main(argv=None):
     import argparse
 
@@ -327,22 +440,29 @@ def main(argv=None):
     ap.add_argument(
         "--model",
         default="all",
-        choices=["all", "mnist", "resnet50"],
-        help="all = ResNet-50 secondary line + MNIST north-star line (last)",
+        choices=["all", "mnist", "resnet50", "lm"],
+        help="all = ResNet-50 + LM secondary lines + MNIST north-star "
+        "line (last)",
     )
     ap.add_argument(
         "--worker",
         default=None,
-        choices=["mnist", "resnet50"],
+        choices=["mnist", "resnet50", "lm"],
         help="internal: run one measurement in-process (no retry shell)",
     )
     args = ap.parse_args(argv)
 
     if args.worker:
-        {"mnist": _worker_mnist, "resnet50": _worker_resnet50}[args.worker]()
+        {
+            "mnist": _worker_mnist,
+            "resnet50": _worker_resnet50,
+            "lm": _worker_lm,
+        }[args.worker]()
         return 0
 
-    models = ["resnet50", "mnist"] if args.model == "all" else [args.model]
+    models = (
+        ["resnet50", "lm", "mnist"] if args.model == "all" else [args.model]
+    )
     return _launcher(models)
 
 

@@ -14,7 +14,12 @@ from .resnet import (
     init_resnet,
     make_stateful_loss_fn,
 )
-from .transformer import LongContextTransformer, RingAttentionBlock
+from .transformer import (
+    LongContextTransformer,
+    RingAttentionBlock,
+    init_lm_params,
+    make_lm_loss_fn,
+)
 
 __all__ = [
     "LogisticRegression",
@@ -31,4 +36,6 @@ __all__ = [
     "make_stateful_loss_fn",
     "init_resnet",
     "init_params",
+    "init_lm_params",
+    "make_lm_loss_fn",
 ]

@@ -18,6 +18,29 @@ import jax.numpy as jnp
 from ..parallel.ring_attention import full_self_attention, ring_self_attention
 
 
+def make_lm_loss_fn(model: fnn.Module):
+    """Next-token loss for the engine: ``loss_fn(params, batch)`` with
+    ``batch = (tokens_in, tokens_target)``, both ``[B, T]`` int32. Mean
+    cross-entropy over every position (the engine's batch contract matches
+    ``models.mnist.make_loss_fn`` so LMs drive the same train loops the
+    classifiers do)."""
+
+    def loss_fn(params, batch):
+        tokens, targets = batch
+        logits = model.apply({"params": params}, tokens)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return -jnp.mean(picked)
+
+    return loss_fn
+
+
+def init_lm_params(model: fnn.Module, seq_len: int, seed: int = 0):
+    rng = jax.random.PRNGKey(seed)
+    variables = model.init(rng, jnp.zeros((1, seq_len), jnp.int32))
+    return variables["params"]
+
+
 class RingAttentionBlock(fnn.Module):
     num_heads: int
     head_dim: int

@@ -329,25 +329,31 @@ class _Listener:
                     pending: Optional[_threading.Event] = None
                     poisoned = None
                     with self._applied_lock:
+                        # applied / poisoned / inflight are decided in ONE
+                        # critical section: were the applied-check and the
+                        # inflight registration split, the original apply
+                        # could complete (recording seq and popping its
+                        # inflight entry) between them, and a reconnect
+                        # retry would register itself as a fresh owner and
+                        # re-post a non-idempotent rule.
                         if seq and self._applied.get(dkey, 0) >= seq:
                             # retry of an already-applied update: ack only
                             _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
                             continue
                         if seq:
                             poisoned = self._failed.get(ikey)
+                            if poisoned is None:
+                                pending = self._inflight.get(ikey)
+                                if pending is None:
+                                    self._inflight[ikey] = _threading.Event()
+                                else:
+                                    owner = False
                     if poisoned is not None:
                         # retry of a partially-applied multi frame whose
                         # ERROR response was lost: re-report, never
                         # re-apply (items that succeeded would double)
                         _send_frame(conn, _KIND_ERROR, rule=poisoned)
                         continue
-                    with self._applied_lock:
-                        if seq:
-                            pending = self._inflight.get(ikey)
-                            if pending is None:
-                                self._inflight[ikey] = _threading.Event()
-                            else:
-                                owner = False
                     if not owner:
                         # a reconnect retry racing the FIRST apply (its
                         # seq not yet recorded): wait for that apply and

@@ -3,6 +3,7 @@ from .data import (
     load_mnist_idx,
     synthetic_imagenet,
     synthetic_mnist,
+    synthetic_tokens,
 )
 from .tracing import ProfilerWindow, Timer, set_debug_level, vlog
 
@@ -10,6 +11,7 @@ __all__ = [
     "DistributedIterator",
     "synthetic_mnist",
     "synthetic_imagenet",
+    "synthetic_tokens",
     "load_mnist_idx",
     "ProfilerWindow",
     "Timer",

@@ -245,7 +245,9 @@ def tune_ring_implementation(
     must EARN its slot on the wire, like the reference's "our ring beats
     NCCL" claim."""
     comm = _comm(comm)
-    _check_unfrozen(apply)
+    # measure_mutates: the sweep itself flips ring_implementation to time
+    # each kernel, so frozen constants must fail fast even with apply=False
+    _check_unfrozen(apply, measure_mutates=True)
     from ..collectives.selector import backend_availability
 
     results = []

@@ -85,6 +85,30 @@ def resnet_forward_flops(image: int = 224, stage_sizes=(3, 4, 6, 3),
     return total
 
 
+def transformer_forward_flops(seq: int, d_model: int, num_layers: int,
+                              num_heads: int, head_dim: int, vocab: int,
+                              mlp_ratio: int = 4) -> int:
+    """Per-SEQUENCE forward FLOPs of ``models.LongContextTransformer``.
+
+    Counts the matmuls as executed: the attention kernels compute the full
+    T x T score/value products and mask afterwards (streaming-softmax ring
+    blocks do the same per block pair), so causal masking does NOT halve
+    the counted FLOPs — masked MACs still run on the MXU. Embedding lookup
+    (a gather) is free; the vocabulary head is not. Divide by ``seq`` for
+    per-token FLOPs (the LM bench reports tokens/sec)."""
+    attn_dim = num_heads * head_dim
+    per_layer = (
+        dense_flops(d_model, 3 * attn_dim) * seq          # qkv projection
+        + 2 * seq * seq * attn_dim                        # q @ k^T
+        + 2 * seq * seq * attn_dim                        # softmax @ v
+        + dense_flops(attn_dim, d_model) * seq            # output proj
+        + dense_flops(d_model, mlp_ratio * d_model) * seq # mlp up
+        + dense_flops(mlp_ratio * d_model, d_model) * seq # mlp down
+    )
+    head = dense_flops(d_model, vocab) * seq
+    return num_layers * per_layer + head
+
+
 def train_flops(forward_flops: int) -> int:
     """Forward + backward (~2x forward) per-sample training FLOPs."""
     return 3 * forward_flops
