@@ -52,6 +52,26 @@ TOTAL_DEADLINE_S = int(os.environ.get("TORCHMPI_TPU_BENCH_DEADLINE", "2400"))
 BACKOFFS_S = (20, 45, 90, 90, 90)
 
 
+def _probe_backend(timeout_s: float = 150.0) -> bool:
+    """Cheap pre-flight: can a child process see the backend and run one
+    op? A wedged tunnel hangs ``jax.devices()``, so burning a full
+    900s worker attempt to discover that wastes the retry budget; this
+    probe discovers it in ~2 minutes."""
+    cmd = [sys.executable, str(HERE / "bench.py"), "--probe"]
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            timeout=timeout_s,
+            cwd=str(HERE),
+            text=True,
+        )
+    except Exception:  # noqa: BLE001 - timeout or spawn failure
+        return False
+    return proc.returncode == 0 and "PROBE_OK" in (proc.stdout or "")
+
+
 def _run_worker(model: str, timeout_s: float):
     """Run one measurement in a child process; return (json_dict|None, err)."""
     cmd = [sys.executable, str(HERE / "bench.py"), "--worker", model]
@@ -93,6 +113,18 @@ def _measure(model, t0, max_attempts):
         if remaining <= 60:
             last_err = str(last_err) + " (deadline exhausted)"
             break
+        if not _probe_backend(min(150.0, remaining)):
+            # wedged/absent backend: skip the expensive worker attempt,
+            # spend the backoff waiting for the tunnel instead
+            last_err = "backend probe failed (tunnel hung or dead)"
+            print(
+                f"# bench probe {attempt + 1} failed; backing off",
+                file=sys.stderr,
+                flush=True,
+            )
+            if attempt < len(BACKOFFS_S):
+                time.sleep(BACKOFFS_S[attempt])
+            continue
         obj, err = _run_worker(model, min(WORKER_TIMEOUT_S, remaining))
         if obj is not None:
             return obj
@@ -450,7 +482,21 @@ def main(argv=None):
         choices=["mnist", "resnet50", "lm"],
         help="internal: run one measurement in-process (no retry shell)",
     )
+    ap.add_argument(
+        "--probe",
+        action="store_true",
+        help="internal: backend liveness check (one tiny op)",
+    )
     args = ap.parse_args(argv)
+
+    if args.probe:
+        devices, _ = _worker_setup()
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        (x @ x).block_until_ready()
+        print("PROBE_OK", flush=True)
+        return 0
 
     if args.worker:
         {
