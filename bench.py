@@ -52,11 +52,19 @@ TOTAL_DEADLINE_S = int(os.environ.get("TORCHMPI_TPU_BENCH_DEADLINE", "2400"))
 BACKOFFS_S = (20, 45, 90, 90, 90)
 
 
+_PROBE_PASSED = False  # once alive, stay trusted (workers have timeouts)
+
+
 def _probe_backend(timeout_s: float = 150.0) -> bool:
     """Cheap pre-flight: can a child process see the backend and run one
     op? A wedged tunnel hangs ``jax.devices()``, so burning a full
     900s worker attempt to discover that wastes the retry budget; this
-    probe discovers it in ~2 minutes."""
+    probe discovers it in ~2 minutes. A success is cached for the rest of
+    the launcher run — re-proving a live backend before every worker would
+    spend minutes of the deadline on redundant JAX inits."""
+    global _PROBE_PASSED
+    if _PROBE_PASSED:
+        return True
     cmd = [sys.executable, str(HERE / "bench.py"), "--probe"]
     try:
         proc = subprocess.run(
@@ -69,7 +77,10 @@ def _probe_backend(timeout_s: float = 150.0) -> bool:
         )
     except Exception:  # noqa: BLE001 - timeout or spawn failure
         return False
-    return proc.returncode == 0 and "PROBE_OK" in (proc.stdout or "")
+    _PROBE_PASSED = (
+        proc.returncode == 0 and "PROBE_OK" in (proc.stdout or "")
+    )
+    return _PROBE_PASSED
 
 
 def _run_worker(model: str, timeout_s: float):
@@ -115,14 +126,18 @@ def _measure(model, t0, max_attempts):
             break
         if not _probe_backend(min(150.0, remaining)):
             # wedged/absent backend: skip the expensive worker attempt,
-            # spend the backoff waiting for the tunnel instead
-            last_err = "backend probe failed (tunnel hung or dead)"
+            # spend the backoff waiting for the tunnel instead. Keep any
+            # REAL worker error from an earlier attempt — it explains the
+            # failure better than "probe failed" does.
+            if last_err == "not attempted":
+                last_err = "backend probe failed (tunnel hung or dead)"
             print(
                 f"# bench probe {attempt + 1} failed; backing off",
                 file=sys.stderr,
                 flush=True,
             )
-            if attempt < len(BACKOFFS_S):
+            remaining = TOTAL_DEADLINE_S - (time.monotonic() - t0)
+            if attempt < len(BACKOFFS_S) and remaining > BACKOFFS_S[attempt] + 60:
                 time.sleep(BACKOFFS_S[attempt])
             continue
         obj, err = _run_worker(model, min(WORKER_TIMEOUT_S, remaining))

@@ -127,6 +127,64 @@ def test_sendreceive(backend):
             np.testing.assert_array_equal(out[r], r)
 
 
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("mode", MODES)
+def test_reducescatter_closed_form(backend, mode):
+    """Rank r's output block is slice r of the elementwise sum (last-dim
+    scatter, the dual of allgather's concat contract)."""
+    p = mpi.size()
+    n = 3 * p
+    # distinct per-position values so slice identity is checked, not just sums
+    base = np.arange(n, dtype=np.float32)[None, :]
+    x = jnp.asarray(base + 10.0 * np.arange(p, dtype=np.float32)[:, None])
+    ns = _ns(backend, mode)
+    out = _run(lambda: ns.reducescatter_tensor(x), mode)
+    assert out.shape == (p, n // p)
+    total = base[0] * p + 10.0 * p * (p - 1) / 2
+    for r in range(p):
+        np.testing.assert_array_equal(
+            out[r], total[r * (n // p) : (r + 1) * (n // p)]
+        )
+    np.testing.assert_array_equal(  # non-inplace
+        np.asarray(x)[0], base[0]
+    )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("mode", MODES)
+def test_alltoall_closed_form(backend, mode):
+    """Output block [r, j] is what rank j addressed to rank r: with
+    x[r, s] = 100*r + s, out[r, j] must be 100*j + r (the transpose)."""
+    p = mpi.size()
+    n = 5
+    r_idx = np.arange(p, dtype=np.float32)
+    x = jnp.asarray(
+        (100.0 * r_idx[:, None, None] + r_idx[None, :, None])
+        * np.ones((1, 1, n), np.float32)
+    )
+    ns = _ns(backend, mode)
+    out = _run(lambda: ns.alltoall_tensor(x), mode)
+    assert out.shape == (p, p, n)
+    expected = 100.0 * r_idx[None, :, None] + r_idx[:, None, None]
+    np.testing.assert_array_equal(out, expected * np.ones((1, 1, n)))
+
+
+def test_reducescatter_argument_errors():
+    p = mpi.size()
+    with pytest.raises(CollectiveArgumentError):
+        mpi.reducescatter_tensor(jnp.zeros((p, 3 * p + 1)))  # not divisible
+    with pytest.raises(CollectiveArgumentError):
+        mpi.reducescatter_tensor(jnp.zeros((p,)))  # no last dim
+
+
+def test_alltoall_argument_errors():
+    p = mpi.size()
+    with pytest.raises(CollectiveArgumentError):
+        mpi.alltoall_tensor(jnp.zeros((p, p + 1, 4)))  # block dim != p
+    with pytest.raises(CollectiveArgumentError):
+        mpi.alltoall_tensor(jnp.zeros((p,)))
+
+
 def test_allgather_1d_stays_rank_stacked():
     """One scalar per rank: output must be rank-stacked [p, p], composable
     with further eager collectives."""

@@ -449,6 +449,32 @@ def test_eager_pallas_allgather_dispatch():
         mpi.stop()
 
 
+def test_eager_pallas_reducescatter_dispatch():
+    """backend='pallas' reducescatter scatters the summed last dim in rank
+    order through the eager contract (forced interpret)."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.collectives import eager
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    mpi.start()
+    rk._FORCE_INTERPRET = True
+    try:
+        p = mpi.size()
+        comm = mpi.current_communicator()
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(p, 4 * p).astype(np.float32))
+        out = np.asarray(eager.run("reducescatter", x, comm, backend="pallas"))
+        assert out.shape == (p, 4)
+        total = np.asarray(x).sum(axis=0)
+        for r in range(p):
+            np.testing.assert_allclose(
+                out[r], total[4 * r : 4 * (r + 1)], rtol=1e-5, atol=1e-6
+            )
+    finally:
+        rk._FORCE_INTERPRET = False
+        mpi.stop()
+
+
 def test_pallas_reduction_rejects_lossy_dtype():
     from torchmpi_tpu.ops import ring_kernels as rk
 

@@ -30,9 +30,11 @@ from .collectives import (
     broadcast_tensor,
     collective_availability,
     free_collective_resources,
+    alltoall_tensor,
     pallas,
     reduce_scalar,
     reduce_tensor,
+    reducescatter_tensor,
     ring,
     selector as collective_selector,
     sendreceive_scalar,
@@ -86,6 +88,8 @@ __all__ = [
     "allgather_tensor",
     "allgatherv_tensor",
     "sendreceive_tensor",
+    "reducescatter_tensor",
+    "alltoall_tensor",
     "broadcast_scalar",
     "allreduce_scalar",
     "reduce_scalar",

@@ -86,6 +86,25 @@ def sendreceive_tensor(x, src, dst, comm=None):
     return _dispatch("sendreceive", x, comm, "sync", src=src, dst=dst)
 
 
+def reducescatter_tensor(x, comm=None):
+    """Reduce-scatter over the LAST dim (dual of ``allgather_tensor``'s
+    concat-last-dim contract): rank r's output block is slice r of the
+    elementwise sum. Beyond the reference's surface (it has no
+    reduce-scatter collective; its ring used one internally,
+    ``lib/detail/collectives.cpp:128-326``) — exposed because ZeRO-style
+    sharded optimizers consume it directly."""
+    return _dispatch("reducescatter", x, comm, "sync")
+
+
+def alltoall_tensor(x, comm=None):
+    """All-to-all: input [p, p, ...] where block [r, s] is rank r's payload
+    for rank s; output block [r, j] is what rank j sent rank r. Beyond the
+    reference's surface (its alltoall-shaped traffic was the PS shard
+    fan-out, ``lib/parameterserver.cpp:309-353``) — exposed because expert
+    parallelism dispatches through it (``parallel/ep.py``)."""
+    return _dispatch("alltoall", x, comm, "sync")
+
+
 def allgatherv_tensor(blocks, comm=None, backend: str = "xla"):
     """Variable-size allgather over ragged last-dim per-rank blocks
     (reference ``Allgatherv``, ``lib/collectives.cpp:245-290``)."""
@@ -115,6 +134,12 @@ class _BackendNS:
         return _dispatch(
             "sendreceive", x, comm, self._mode, self._backend, src=src, dst=dst
         )
+
+    def reducescatter_tensor(self, x, comm=None):
+        return _dispatch("reducescatter", x, comm, self._mode, self._backend)
+
+    def alltoall_tensor(self, x, comm=None):
+        return _dispatch("alltoall", x, comm, self._mode, self._backend)
 
 
 class _AsyncNS(_BackendNS):
@@ -211,6 +236,8 @@ __all__ = [
     "allgather_tensor",
     "allgatherv_tensor",
     "sendreceive_tensor",
+    "reducescatter_tensor",
+    "alltoall_tensor",
     "broadcast_scalar",
     "allreduce_scalar",
     "reduce_scalar",

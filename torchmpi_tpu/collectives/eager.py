@@ -209,6 +209,17 @@ def broadcast_plan(nelem: int, dtype, platform: str) -> Tuple[bool, int]:
     return False, int(k)
 
 
+def _pallas_reduce_scatter_lastdim(b, axis: str):
+    """Scatter-along-last-dim reduce-scatter (dual of the allgather
+    contract) on a [1, ..., d] per-rank block via the pallas RS ring, which
+    scatters dim 0 with psum_scatter tiled semantics."""
+    from ..ops.ring_kernels import ring_reduce_scatter_pallas
+
+    moved = jnp.moveaxis(b[0], -1, 0)  # [d, ...]
+    mine = ring_reduce_scatter_pallas(moved, axis)  # [d/p, ...]
+    return jnp.moveaxis(mine, 0, -1)[None]
+
+
 def _pallas_allgather_lastdim(b, axis: str):
     """Concat-along-last-dim allgather (the eager contract) on a [1, ..., d]
     per-rank block via the (p-1)-step pallas forwarding ring. Shared by the
@@ -269,6 +280,13 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             "reduce": lambda b: prim.reduce(b, root, _AXIS),
             "allgather": lambda b: prim.allgather(b, _AXIS, dim=-1),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
+            "reducescatter": lambda b: prim.reduce_scatter(
+                b, _AXIS, dim=b.ndim - 1
+            ),
+            # b: [1, p, ...] — scatter/stack the rank dimension
+            "alltoall": lambda b: prim.alltoall(
+                b, _AXIS, split_dim=1, concat_dim=1
+            ),
         }
     elif backend == "ring":
         table = {
@@ -277,6 +295,10 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             "reduce": _ring_reduce,
             "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
+            "reducescatter": lambda b: prim.ring_reduce_scatter(
+                b, _AXIS, dim=-1
+            ),
+            "alltoall": lambda b: prim.ring_alltoall(b[0], _AXIS)[None],
         }
     elif backend == "pallas":
         # Pallas ICI-RDMA rings for allreduce / reduce / allgather +
@@ -305,6 +327,14 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             "reduce": lambda b: ring_reduce_pallas(b, root, _AXIS),
             "allgather": lambda b: _pallas_allgather_lastdim(b, _AXIS),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
+            "reducescatter": lambda b: _pallas_reduce_scatter_lastdim(
+                b, _AXIS
+            ),
+            # a single fused all_to_all IS one XLA collective already —
+            # same rationale as sendreceive's ppermute path
+            "alltoall": lambda b: prim.alltoall(
+                b, _AXIS, split_dim=1, concat_dim=1
+            ),
         }
     else:
         raise CollectiveArgumentError(f"unknown backend {backend!r}")
@@ -346,6 +376,20 @@ def run(
         # One scalar per rank: lift to [p, 1] so the output stays rank-stacked
         # ([p, p]: every rank's block is the gathered vector).
         x = x[:, None]
+    if op == "reducescatter":
+        if x.ndim < 2 or x.shape[-1] % comm.size != 0:
+            raise CollectiveArgumentError(
+                f"reducescatter scatters the last dim, which must exist and "
+                f"be divisible by the communicator size {comm.size}; got "
+                f"shape {tuple(x.shape)}"
+            )
+    if op == "alltoall":
+        if x.ndim < 2 or x.shape[1] != comm.size:
+            raise CollectiveArgumentError(
+                f"alltoall needs rank-stacked [p, p, ...] input (block "
+                f"[r, s] = rank r's payload for rank s); got shape "
+                f"{tuple(x.shape)} for p={comm.size}"
+            )
     platform = comm._devices[0].platform
     effective = backend
     if backend in ("ring", "pallas") and route_small:
@@ -358,7 +402,7 @@ def run(
         # silently corrupted int32 >= 2^24 via an f32 cast) — unsupported
         # dtypes take the ppermute ring. Data-movement ops carry any real
         # dtype losslessly as a byte view; only complex must fall back.
-        if op in ("allreduce", "reduce"):
+        if op in ("allreduce", "reduce", "reducescatter"):
             if not ring_kernels.supports_dtype(dt):
                 effective = "ring"
         elif jnp.dtype(dt).kind == "c":

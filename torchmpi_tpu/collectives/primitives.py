@@ -301,6 +301,76 @@ def ring_reduce(
     return jnp.where(idx == root, total, x)
 
 
+def ring_reduce_scatter(
+    x, axis: str = "mpi", dim: int = -1, axis_size: Optional[int] = None
+):
+    """Reduce-scatter over ``dim`` as the (p-1)-step reduce-scatter phase of
+    the ring (``lib/detail/collectives.cpp:128-326``'s first half, standalone):
+    rank r returns slice r of the summed tensor (``lax.psum_scatter`` tiled
+    semantics). ``x.shape[dim]`` must be divisible by the axis size."""
+    p = axis_size or lax.axis_size(axis)
+    if dim < 0:
+        dim = x.ndim + dim
+    if p == 1:
+        return x
+    if x.shape[dim] % p != 0:
+        raise ValueError(
+            f"reduce_scatter dim {dim} ({x.shape[dim]}) must be divisible "
+            f"by the axis size ({p})"
+        )
+    r = lax.axis_index(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    moved = jnp.moveaxis(x, dim, 0)  # [d, ...]
+    ch = moved.reshape((p, moved.shape[0] // p) + moved.shape[1:])
+
+    def rs_step(s, ch):
+        # schedule shifted one slot vs the allreduce RS phase so rank r
+        # finishes owning slice r (not (r+1) mod p): at step s it sends
+        # partial slice (r-s-1) and folds the incoming (r-s-2)
+        send_idx = (r - s - 1) % p
+        recv_idx = (r - s - 2) % p
+        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
+        recv = lax.ppermute(buf, axis, perm)
+        upd = lax.dynamic_index_in_dim(ch, recv_idx, keepdims=False) + recv
+        return lax.dynamic_update_index_in_dim(ch, upd, recv_idx, 0)
+
+    ch = lax.fori_loop(0, p - 1, rs_step, ch)
+    mine = lax.dynamic_index_in_dim(ch, r, keepdims=False)  # [d/p, ...]
+    return jnp.moveaxis(mine, 0, dim)
+
+
+def alltoall(x, axis: str = "mpi", split_dim: int = 0, concat_dim: int = 0):
+    """Fused XLA all-to-all: ``x``'s ``split_dim`` (length p) is scattered,
+    one block per rank, and the received blocks are stacked along
+    ``concat_dim`` — block j of the output came from rank j."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True
+    )
+
+
+def ring_alltoall(x, axis: str = "mpi", axis_size: Optional[int] = None):
+    """All-to-all as p-1 pairwise exchanges (one ``ppermute`` per relative
+    offset — the custom-p2p decomposition; the reference's alltoall-shaped
+    traffic is its PS shard fan-out, ``lib/parameterserver.cpp:309-353``).
+    ``x``: [p, ...] where block s is this rank's payload for rank s; returns
+    [p, ...] where block j came from rank j."""
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x
+    r = lax.axis_index(axis)
+    own = lax.dynamic_index_in_dim(x, r, keepdims=False)
+    out = jnp.zeros_like(x)
+    out = lax.dynamic_update_index_in_dim(out, own, r, 0)
+    for k in range(1, p):
+        # every rank i sends its block for rank (i+k) directly; what
+        # arrives came from rank (r-k)
+        perm = [(i, (i + k) % p) for i in range(p)]
+        buf = lax.dynamic_index_in_dim(x, (r + k) % p, keepdims=False)
+        recv = lax.ppermute(buf, axis, perm)
+        out = lax.dynamic_update_index_in_dim(out, recv, (r - k) % p, 0)
+    return out
+
+
 def ring_allgather(x, axis: str = "mpi", dim: int = -1, axis_size: Optional[int] = None):
     """All-gather as p-1 ring forwarding steps (same plan as the allgather
     phase of the ring allreduce)."""

@@ -22,7 +22,15 @@ from typing import Dict, List
 
 import jax
 
-_COLLECTIVES = ("broadcast", "reduce", "allreduce", "sendreceive", "allgather")
+_COLLECTIVES = (
+    "broadcast",
+    "reduce",
+    "allreduce",
+    "sendreceive",
+    "allgather",
+    "reducescatter",
+    "alltoall",
+)
 
 
 def _pallas_available() -> bool:
@@ -72,6 +80,8 @@ _DEFAULT: Dict[str, Dict[str, Dict[str, Dict[str, List[str]]]]] = {
                 "allreduce": ["pallas", "ring", "xla"],
                 "sendreceive": ["xla", "ring"],
                 "allgather": ["xla", "ring"],
+                "reducescatter": ["xla", "ring"],
+                "alltoall": ["xla", "ring"],
             },
             "async": {c: ["xla", "ring"] for c in _COLLECTIVES},
         },
