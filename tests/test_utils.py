@@ -31,13 +31,22 @@ def test_bus_bandwidth_models():
     assert bus_bytes("broadcast", 1000, 8) == 1000
     assert bus_bytes("reduce", 1000, 8) == 1000
     assert bus_bytes("allgather", 1000, 8) == 7000
+    assert bus_bytes("reducescatter", 1000, 8) == 1000 * 7 / 8
+    assert bus_bytes("alltoall", 1000, 8) == 1000 * 7 / 8
 
 
 def test_run_one_config_correctness_modes():
     from torchmpi_tpu.utils.tester import run_one_config
 
     comm = mpi.current_communicator()
-    for op in ("allreduce", "broadcast", "reduce", "allgather"):
+    for op in (
+        "allreduce",
+        "broadcast",
+        "reduce",
+        "allgather",
+        "reducescatter",
+        "alltoall",
+    ):
         res = run_one_config(op, 512, comm, backend="ring", mode="sync")
         assert res.correct, op
     res = run_one_config("allreduce", 256, comm, backend="xla", mode="async",

@@ -49,6 +49,10 @@ def bus_bytes(op: str, nbytes: int, p: int) -> float:
         return float(nbytes * (p - 1))
     if op == "sendreceive":
         return float(nbytes)
+    if op in ("reducescatter", "alltoall"):
+        # ring RS: each rank forwards (p-1) partial slices of n/p bytes;
+        # alltoall: each rank ships (p-1) of its p blocks
+        return nbytes * (p - 1) / p
     raise ValueError(op)
 
 
@@ -89,9 +93,21 @@ def run_one_config(
     from ..collectives import eager
 
     p = comm.size
-    x = jnp.tile(
-        jnp.arange(p, dtype=jnp.float32)[:, None], (1, max(1, nelem))
-    )
+    if op == "alltoall":
+        # [p, p, chunk] rank-addressed blocks, ~nelem elements per rank
+        chunk = max(1, nelem // p)
+        r_idx = jnp.arange(p, dtype=jnp.float32)
+        x = jnp.broadcast_to(
+            (100.0 * r_idx[:, None] + r_idx[None, :])[:, :, None],
+            (p, p, chunk),
+        )
+    elif op == "reducescatter":
+        n = max(p, -(-max(1, nelem) // p) * p)  # last dim divisible by p
+        x = jnp.tile(jnp.arange(p, dtype=jnp.float32)[:, None], (1, n))
+    else:
+        x = jnp.tile(
+            jnp.arange(p, dtype=jnp.float32)[:, None], (1, max(1, nelem))
+        )
     pinned = not route_override and backend in ("xla", "ring", "pallas")
     ns = collectives.async_ if mode == "async" else collectives
     if backend and not pinned:
@@ -117,6 +133,10 @@ def run_one_config(
             r = ns.allgather_tensor(x, comm=comm)
         elif op == "sendreceive":
             r = ns.sendreceive_tensor(x, src=0, dst=p - 1, comm=comm)
+        elif op == "reducescatter":
+            r = ns.reducescatter_tensor(x, comm=comm)
+        elif op == "alltoall":
+            r = ns.alltoall_tensor(x, comm=comm)
         else:
             raise ValueError(op)
         if mode == "async":
@@ -132,6 +152,12 @@ def run_one_config(
     elif op == "allgather":
         expect = np.repeat(np.arange(p, dtype=np.float32), out.shape[1] // p)
         correct = bool(np.allclose(out[0], expect))
+    elif op == "reducescatter":
+        correct = bool(np.allclose(out, p * (p - 1) / 2))
+    elif op == "alltoall":
+        r_idx = np.arange(p, dtype=np.float32)
+        expect = 100.0 * r_idx[None, :, None] + r_idx[:, None, None]
+        correct = bool(np.allclose(out, expect))
 
     mean_us = float("nan")
     gbps = float("nan")
