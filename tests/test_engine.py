@@ -287,6 +287,48 @@ def test_engine_fsdp_matches_replicated():
     ), "fsdp shard holds the full leaf"
 
 
+@pytest.mark.parametrize("sharding", ["replicated", "fsdp"])
+def test_engine_accum_steps_matches_unaccumulated(sharding):
+    """accum_steps=k must follow the k=1 trajectory exactly: equal
+    microbatches make the accumulated mean gradient identical to the
+    full-batch mean gradient (capability extension; no reference analog)."""
+    p = mpi.size()
+    (xtr, ytr), _ = synthetic_mnist(num_train=256, num_test=1)
+    model = MLP6(features=8 * p)
+    params = init_params(model, (1, 28, 28))
+
+    losses = {}
+    final = {}
+    for k in (1, 4):
+        eng = AllReduceSGDEngine(
+            make_loss_fn(model),
+            params,
+            optimizer=optax.sgd(0.1),
+            param_sharding=sharding,
+            accum_steps=k,
+        )
+        st = eng.train_resident(xtr, ytr, 8, max_epochs=2, shuffle=False)
+        losses[k] = st["losses"]
+        final[k] = jax.tree_util.tree_leaves(jax.device_get(eng.params))
+    np.testing.assert_allclose(losses[4], losses[1], rtol=1e-4)
+    for la, lb in zip(final[1], final[4]):
+        np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-6)
+
+
+def test_engine_accum_steps_validation():
+    (xtr, ytr), _ = synthetic_mnist(num_train=64, num_test=1)
+    model = MLP6()
+    params = init_params(model, (1, 28, 28))
+    with pytest.raises(ValueError, match="accum_steps"):
+        AllReduceSGDEngine(make_loss_fn(model), params, accum_steps=0)
+    eng = AllReduceSGDEngine(
+        make_loss_fn(model), params, optimizer=optax.sgd(0.1), accum_steps=3
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        # per-rank batch 8 not divisible by accum_steps 3
+        eng.train_resident(xtr, ytr, 8, max_epochs=1)
+
+
 def test_engine_fsdp_step_and_eval():
     p = mpi.size()
     (xtr, ytr), (xte, yte) = synthetic_mnist(num_train=512, num_test=128)

@@ -141,6 +141,7 @@ class AllReduceSGDEngine:
         batch_format: str = "auto",
         model_state=None,
         param_sharding: str = "replicated",
+        accum_steps: int = 1,
     ):
         """``model_state``: optional mutable-collection pytree (e.g. flax
         ``batch_stats``). When given, ``loss_fn`` must have the signature
@@ -155,7 +156,18 @@ class AllReduceSGDEngine:
         collectives). fsdp requires mode='sync' and
         average_gradients=True (the loss is a global-batch mean, so
         gradients are means by construction); it is a capability
-        extension — the reference has no sharded-optimizer mode."""
+        extension — the reference has no sharded-optimizer mode.
+
+        ``accum_steps``: gradient accumulation — each step's batch is cut
+        into this many microbatches processed sequentially (a scan, so
+        only ONE microbatch's activations are live at a time) and the
+        averaged gradient drives a single optimizer update. Trades step
+        latency for activation memory: the effective batch stays the
+        caller's batch. Per-rank batch sizes must be divisible by it.
+        Stateless models follow the k=1 trajectory exactly; mutable state
+        (batch-norm statistics) gets k microbatch-sized updates per step,
+        standard accumulation semantics. Capability extension (the
+        reference predates accumulation)."""
         if comm is None:
             from .. import runtime_state
 
@@ -176,6 +188,11 @@ class AllReduceSGDEngine:
                 "average_gradients=True (the global-batch loss already "
                 "yields mean gradients; XLA schedules the overlap)"
             )
+        if not isinstance(accum_steps, int) or accum_steps < 1:
+            raise ValueError(
+                f"accum_steps must be a positive int, got {accum_steps!r}"
+            )
+        self.accum_steps = accum_steps
         self.param_sharding = param_sharding
         self.batch_format = batch_format
         self.comm = comm
@@ -246,11 +263,65 @@ class AllReduceSGDEngine:
         self._eval_data: Dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------------
+    def _accum_value_and_grad(self, params, model_state, batch, split_fn):
+        """Microbatched value_and_grad: ``split_fn`` cuts each batch leaf
+        into ``accum_steps`` equal microbatches (leading axis k), a scan
+        accumulates gradients/loss (one microbatch's activations live at a
+        time — the memory point of accumulation), and the mean is returned.
+        Equal microbatch sizes make mean-of-means == full-batch mean, so
+        for stateless models accum_steps=k follows the k=1 trajectory
+        exactly (tested). Models with mutable state (e.g. batch-norm
+        statistics) apply k sequential microbatch-sized state updates per
+        step instead of one full-batch update — standard accumulation
+        semantics, NOT bit-identical to k=1 for the state."""
+        k = self.accum_steps
+        loss_fn = self.loss_fn
+        has_state = model_state is not None
+        micro = jax.tree_util.tree_map(split_fn, batch)
+
+        def body(carry, mb):
+            gsum, state = carry
+            if has_state:
+                (loss, state), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, state, mb
+                )
+            else:
+                loss, g = jax.value_and_grad(loss_fn)(params, mb)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            # loss rides the scan OUTPUT (stacked [k]), not the carry: a
+            # carry accumulator would need the loss dtype up front
+            return (gsum, state), loss
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (gsum, new_state), losses = jax.lax.scan(
+            body, (zeros, model_state), micro
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+        return jnp.mean(losses), new_state, grads
+
     def _step_core(self, params, opt_state, model_state, batch):
         """Per-rank step body (inside shard_map): grad, sync, update."""
         loss_fn, optimizer = self.loss_fn, self.optimizer
         has_state = model_state is not None
-        if has_state:
+        k = self.accum_steps
+        if k > 1:
+
+            def split(a):
+                if a.shape[0] % k:
+                    raise ValueError(
+                        f"per-rank batch {a.shape[0]} not divisible by "
+                        f"accum_steps={k}"
+                    )
+                return a.reshape((k, a.shape[0] // k) + a.shape[1:])
+
+            loss, new_state, grads = self._accum_value_and_grad(
+                params, model_state, batch, split
+            )
+            if has_state:
+                new_state = jax.tree_util.tree_map(
+                    lambda s: jax.lax.pmean(s, _AXIS), new_state
+                )
+        elif has_state:
             (loss, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params, model_state, batch)
@@ -279,7 +350,29 @@ class AllReduceSGDEngine:
         use and reduce-scatter the gradients — ZeRO-3 for free from the
         sharding annotations."""
         loss_fn, optimizer = self.loss_fn, self.optimizer
-        if model_state is not None:
+        k = self.accum_steps
+        if k > 1:
+            p = self.comm.size
+
+            def split(a):
+                n = a.shape[0]
+                if n % (p * k):
+                    raise ValueError(
+                        f"global batch {n} not divisible by world size x "
+                        f"accum_steps = {p}x{k}"
+                    )
+                # rank-major [p, k, b, ...]: each microbatch takes b rows
+                # from EVERY rank's contiguous shard, so the batch axis
+                # stays evenly sharded through the scan
+                b = n // (p * k)
+                a = a.reshape((p, k, b) + a.shape[1:])
+                a = jnp.moveaxis(a, 1, 0)  # [k, p, b, ...]
+                return a.reshape((k, p * b) + a.shape[3:])
+
+            loss, new_state, grads = self._accum_value_and_grad(
+                params, model_state, batch, split
+            )
+        elif model_state is not None:
             (loss, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params, model_state, batch)
