@@ -317,20 +317,17 @@ class AllReduceSGDEngine:
             loss, new_state, grads = self._accum_value_and_grad(
                 params, model_state, batch, split
             )
-            if has_state:
-                new_state = jax.tree_util.tree_map(
-                    lambda s: jax.lax.pmean(s, _AXIS), new_state
-                )
         elif has_state:
             (loss, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params, model_state, batch)
-            new_state = jax.tree_util.tree_map(
-                lambda s: jax.lax.pmean(s, _AXIS), new_state
-            )
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             new_state = model_state
+        if has_state:
+            new_state = jax.tree_util.tree_map(
+                lambda s: jax.lax.pmean(s, _AXIS), new_state
+            )
         if self.mode == "async":
             grads = mpinn.in_graph_synchronize_gradients_bucketed(
                 grads, self.buckets, _AXIS, average=self.average_gradients

@@ -5,11 +5,12 @@ is a capability extension in the modern taxonomy, built TPU-first:
 
 - each device along the ``ep`` mesh axis owns ONE expert's parameters and
   a shard of the tokens;
-- top-1 routing with a fixed per-expert **capacity** keeps every shape
-  static (XLA requirement): token t goes to expert ``argmax(logits[t])``
-  unless that expert's capacity is exhausted, in which case the token is
-  dropped (its output contribution is zero — the standard Switch-style
-  overflow rule);
+- top-k routing (k=1 Switch-style, k=2 the GShard default) with a fixed
+  per-expert **capacity** keeps every shape static (XLA requirement):
+  token t goes to its k highest-scoring experts unless an expert's
+  capacity is exhausted, in which case that route is dropped (its output
+  contribution is zero — the standard overflow rule; first choices queue
+  before second choices);
 - dispatch/combine are einsums against a boolean ``[T, E, C]`` dispatch
   tensor (the Mesh-TensorFlow formulation), and the cross-device exchange
   is a single ``lax.all_to_all`` each way — the ICI-native analog of the
@@ -34,8 +35,10 @@ def moe_dispatch_combine(
     expert_params,
     axis: str = "ep",
     capacity: int | None = None,
+    top_k: int = 1,
+    renormalize: bool = True,
 ):
-    """Route each token to its top-1 expert across the ``axis`` devices.
+    """Route each token to its top-k experts across the ``axis`` devices.
 
     Parameters
     ----------
@@ -44,37 +47,63 @@ def moe_dispatch_combine(
     expert_fn : ``expert_fn(params, tokens[N, d]) -> [N, d]`` — THIS
         device's expert computation.
     expert_params : this device's expert parameter pytree.
-    capacity : per-expert slots per source device (default: 2 * ceil(T/E),
-        the usual capacity-factor-2 headroom).
+    capacity : per-expert slots per source device (default:
+        2 * ceil(k*T/E), the usual capacity-factor-2 headroom scaled by
+        the routing multiplicity).
+    top_k : experts per token. 1 = Switch-style; 2 = the GShard default.
+        Capacity is charged in choice priority order: every token's first
+        choice queues before any token's second choice, so under pressure
+        primary routes survive and secondary routes drop first.
+    renormalize : for ``top_k > 1``, rescale the selected gate
+        probabilities to sum to 1 per token (GShard semantics). Ignored
+        for ``top_k=1``, which keeps the raw softmax probability
+        (Switch semantics, and round-2 behavior).
 
-    Returns ``[T, d]`` combined outputs (dropped tokens contribute zeros).
+    Returns ``[T, d]`` combined outputs (dropped routes contribute zeros).
     """
     E = lax.axis_size(axis)
     T, d = x.shape
+    k = top_k
+    if not 1 <= k <= E:
+        raise ValueError(f"top_k must be in [1, {E}], got {k}")
     if router_logits.shape != (T, E):
         raise ValueError(
             f"router_logits must be [T={T}, E={E}], got "
             f"{tuple(router_logits.shape)}"
         )
-    C = capacity if capacity is not None else 2 * (-(-T // E))
+    C = capacity if capacity is not None else 2 * (-(-(k * T) // E))
     if C <= 0:
         raise ValueError(f"capacity must be positive, got {C}")
 
     gates = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
-    expert_idx = jnp.argmax(router_logits, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)  # [T, E]
-    gate_val = jnp.sum(gates * onehot, axis=-1)  # [T] top-1 prob
+    _, idxs = lax.top_k(router_logits, k)  # [T, k]
+    onehots = jax.nn.one_hot(idxs, E, dtype=x.dtype)  # [T, k, E]
+    gate_vals = jnp.einsum("te,tke->tk", gates, onehots)  # [T, k]
+    if k > 1 and renormalize:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+        )
 
-    # position of each token within its expert's queue; overflow dropped
-    pos = jnp.cumsum(onehot, axis=0) - onehot  # [T, E] pre-count
-    my_pos = jnp.sum(pos * onehot, axis=-1)  # [T]
-    keep = my_pos < C
-    # dispatch tensor [T, E, C]
-    disp = (
-        onehot[:, :, None]
-        * jax.nn.one_hot(my_pos, C, dtype=x.dtype)[:, None, :]
-        * keep[:, None, None].astype(x.dtype)
+    # per-expert queue positions, choice-major: all first choices count
+    # before any second choice (GShard's priority rule). Counted in int32,
+    # NOT x.dtype: a bf16 cumsum cannot represent queue positions past 256
+    # (257 rounds to 256), which would silently blend tokens into shared
+    # capacity slots.
+    oh_i = jax.nn.one_hot(idxs, E, dtype=jnp.int32)  # [T, k, E]
+    oh_cm = oh_i.transpose(1, 0, 2).reshape(k * T, E)
+    pos_cm = jnp.cumsum(oh_cm, axis=0) - oh_cm
+    my_pos = (
+        jnp.sum(pos_cm * oh_cm, axis=-1).reshape(k, T).T
+    )  # [T, k] int32
+    keep = (my_pos < C).astype(x.dtype)
+    # per-choice dispatch [T, k, E, C]; slots are disjoint by construction
+    disp_k = (
+        onehots[:, :, :, None]
+        * jax.nn.one_hot(my_pos, C, dtype=x.dtype)[:, :, None, :]
+        * keep[:, :, None, None]
     )
+    disp = jnp.sum(disp_k, axis=1)  # [T, E, C] dispatch mask
+    comb = jnp.einsum("tkec,tk->tec", disp_k, gate_vals)  # gate-weighted
 
     # [E, C, d]: slot (e, c) holds the token bound for expert e
     expert_inputs = jnp.einsum("tec,td->ecd", disp, x)
@@ -90,20 +119,26 @@ def moe_dispatch_combine(
     returned = lax.all_to_all(
         outs, axis, split_axis=0, concat_axis=0, tiled=True
     )
-    # combine: scatter back to token order, weighted by the gate prob
-    y = jnp.einsum("tec,ecd->td", disp, returned)
-    return y * gate_val[:, None]
+    # combine: scatter back to token order, gate-weighted per route
+    return jnp.einsum("tec,ecd->td", comb, returned)
 
 
-def moe_load_stats(router_logits, axis: str = "ep"):
+def moe_load_stats(router_logits, axis: str = "ep", top_k: int = 1):
     """(tokens_per_expert[E], aux_load_balance_loss) — the standard
     mean-gate x mean-assignment auxiliary loss that discourages expert
-    collapse."""
+    collapse. With ``top_k > 1`` the assignment fraction counts every
+    selected route (each token contributes to k experts)."""
     E = lax.axis_size(axis)
     gates = jax.nn.softmax(router_logits, axis=-1)
-    assign = jax.nn.one_hot(
-        jnp.argmax(router_logits, axis=-1), E, dtype=gates.dtype
-    )
+    if top_k > 1:
+        _, idxs = lax.top_k(router_logits, top_k)
+        assign = jnp.sum(
+            jax.nn.one_hot(idxs, E, dtype=gates.dtype), axis=1
+        )
+    else:
+        assign = jax.nn.one_hot(
+            jnp.argmax(router_logits, axis=-1), E, dtype=gates.dtype
+        )
     # global statistics across every device's token shard
     tokens_per_expert = lax.psum(jnp.sum(assign, axis=0), axis)
     me = lax.pmean(jnp.mean(gates, axis=0), axis)
