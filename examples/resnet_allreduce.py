@@ -36,6 +36,17 @@ def main(argv=None):
     ap.add_argument("--mode", default="sync", choices=["sync", "async"])
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     ap.add_argument(
+        "--fsdp",
+        action="store_true",
+        help="ZeRO-3: shard params + optimizer state over the data axis",
+    )
+    ap.add_argument(
+        "--accum-steps",
+        type=int,
+        default=1,
+        help="gradient accumulation microbatches per step",
+    )
+    ap.add_argument(
         "--cpu-mesh",
         type=int,
         default=0,
@@ -91,6 +102,8 @@ def main(argv=None):
         optimizer=optax.sgd(args.lr, momentum=args.momentum),
         mode=args.mode,
         model_state=batch_stats,
+        param_sharding="fsdp" if args.fsdp else "replicated",
+        accum_steps=args.accum_steps,
     )
 
     def log_epoch(epoch, loss, secs):

@@ -376,6 +376,35 @@ def test_ring_backend_routes_hierarchical():
     ), "hierarchical path not taken"
 
 
+def test_reducescatter_alltoall_on_hierarchical_comm():
+    """The new ops have no hierarchical composition (flat-only, like the
+    reference's internal-only use); on a pushed cartesian communicator
+    they must still run correctly through the flat path."""
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks")
+    mpi.push_communicator(lambda r: str(r // 2), name="rs-pairs")
+    comm = mpi.current_communicator()
+    assert comm.cartesian
+
+    x = jnp.asarray(
+        np.arange(p * 2 * p, dtype=np.float32).reshape(p, 2 * p)
+    )
+    out = np.asarray(mpi.ring.reducescatter_tensor(x, comm=comm))
+    total = np.asarray(x).sum(axis=0)
+    for r in range(p):
+        np.testing.assert_array_equal(out[r], total[2 * r : 2 * (r + 1)])
+
+    r_idx = np.arange(p, dtype=np.float32)
+    a = jnp.asarray(
+        (100.0 * r_idx[:, None, None] + r_idx[None, :, None])
+        * np.ones((1, 1, 3), np.float32)
+    )
+    out = np.asarray(mpi.alltoall_tensor(a, comm=comm))
+    expected = 100.0 * r_idx[None, :, None] + r_idx[:, None, None]
+    np.testing.assert_array_equal(out, expected * np.ones((1, 1, 3)))
+
+
 @pytest.mark.parametrize("backend", ["xla", "ring"])
 def test_allgatherv_ragged_matches_numpy_concat(backend):
     """Variable-size allgather (Allgatherv parity, collectives.cpp:245-290):
