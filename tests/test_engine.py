@@ -287,6 +287,52 @@ def test_engine_fsdp_matches_replicated():
     ), "fsdp shard holds the full leaf"
 
 
+def test_engine_zero1_matches_replicated():
+    """ZeRO-1: sharded optimizer state, replicated params — must follow
+    the replicated trajectory exactly, with opt-state leaves actually
+    sharded and params actually replicated after stepping."""
+    p = mpi.size()
+    (xtr, ytr), _ = synthetic_mnist(num_train=256, num_test=1)
+    model = MLP6(features=8 * p)
+    params = init_params(model, (1, 28, 28))
+
+    states, engines = {}, {}
+    for sharding in ("replicated", "zero1"):
+        eng = AllReduceSGDEngine(
+            make_loss_fn(model),
+            params,
+            optimizer=optax.adam(1e-2),  # adam: REAL optimizer moments
+            param_sharding=sharding,
+        )
+        states[sharding] = eng.train_resident(
+            xtr, ytr, 8, max_epochs=2, shuffle=False
+        )
+        engines[sharding] = eng
+    np.testing.assert_allclose(
+        states["zero1"]["losses"], states["replicated"]["losses"], rtol=1e-4
+    )
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(jax.device_get(engines["replicated"].params)),
+        jax.tree_util.tree_leaves(jax.device_get(engines["zero1"].params)),
+    ):
+        np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-6)
+    # params stay replicated...
+    for leaf in jax.tree_util.tree_leaves(engines["zero1"].params):
+        assert all(s is None for s in leaf.sharding.spec), leaf.sharding
+    # ...while at least one optimizer moment is genuinely sharded
+    sharded = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(engines["zero1"].opt_state)
+        if hasattr(leaf, "sharding")
+        and any(s is not None for s in leaf.sharding.spec)
+    ]
+    assert sharded, "no zero1 opt-state leaf ended up sharded"
+    one = sharded[0]
+    assert (
+        one.addressable_shards[0].data.shape != one.shape or p == 1
+    ), "zero1 shard holds the full leaf"
+
+
 @pytest.mark.parametrize("sharding", ["replicated", "fsdp"])
 def test_engine_accum_steps_matches_unaccumulated(sharding):
     """accum_steps=k must follow the k=1 trajectory exactly: equal

@@ -739,7 +739,12 @@ def test_eager_pallas_bidir_dispatch():
         rk._LAST_STEP_COUNTS.clear()
         out = np.asarray(eager.run("allreduce", x, comm, backend="pallas"))
         np.testing.assert_array_equal(out, p * (p - 1) / 2)
-        assert "allreduce_bidir" in rk._LAST_STEP_COUNTS
+        if p >= 3:
+            assert "allreduce_bidir" in rk._LAST_STEP_COUNTS
+        elif p == 2:
+            # two devices share one link: the kernel intentionally
+            # delegates to the unidirectional schedule
+            assert "allreduce" in rk._LAST_STEP_COUNTS
         keys = [
             k for k in comm._collective_resources
             if k[0] == "allreduce" and k[1] == "pallas" and "bidir" in k[3]

@@ -150,13 +150,17 @@ class AllReduceSGDEngine:
         statistics).
 
         ``param_sharding``: 'replicated' (the reference's model — every
-        rank holds full params, gradients allreduced) or 'fsdp' (ZeRO-3
+        rank holds full params, gradients allreduced), 'fsdp' (ZeRO-3
         style: params/optimizer state SHARDED over the data axis, one
         logical copy; XLA/GSPMD inserts the gather/reduce-scatter
-        collectives). fsdp requires mode='sync' and
-        average_gradients=True (the loss is a global-batch mean, so
-        gradients are means by construction); it is a capability
-        extension — the reference has no sharded-optimizer mode.
+        collectives), or 'zero1' (ZeRO-1: ONLY the optimizer state is
+        sharded — the memory win of sharded moments without per-layer
+        parameter gathers; the update math runs sharded and the applied
+        updates are gathered once per step). fsdp/zero1 require
+        mode='sync' and average_gradients=True (the loss is a
+        global-batch mean, so gradients are means by construction); both
+        are capability extensions — the reference has no sharded-optimizer
+        mode.
 
         ``accum_steps``: gradient accumulation — each step's batch is cut
         into this many microbatches processed sequentially (a scan, so
@@ -178,13 +182,16 @@ class AllReduceSGDEngine:
             raise ValueError(
                 f"batch_format must be auto/flat/stacked, got {batch_format!r}"
             )
-        if param_sharding not in ("replicated", "fsdp"):
+        if param_sharding not in ("replicated", "fsdp", "zero1"):
             raise ValueError(
-                f"param_sharding must be replicated/fsdp, got {param_sharding!r}"
+                "param_sharding must be replicated/fsdp/zero1, got "
+                f"{param_sharding!r}"
             )
-        if param_sharding == "fsdp" and (mode != "sync" or not average_gradients):
+        if param_sharding in ("fsdp", "zero1") and (
+            mode != "sync" or not average_gradients
+        ):
             raise ValueError(
-                "param_sharding='fsdp' requires mode='sync' and "
+                f"param_sharding={param_sharding!r} requires mode='sync' and "
                 "average_gradients=True (the global-batch loss already "
                 "yields mean gradients; XLA schedules the overlap)"
             )
@@ -212,11 +219,9 @@ class AllReduceSGDEngine:
         self.batch_sharding = NamedSharding(self.mesh, P(_AXIS))
         self.replicated = NamedSharding(self.mesh, P())
 
-        def _leaf_sharding(a) -> NamedSharding:
-            if self.param_sharding == "replicated":
-                return self.replicated
-            # fsdp: shard each leaf along its first axis divisible by the
-            # world size (falls back to replication for small/odd leaves)
+        def _sharded_leaf(a) -> NamedSharding:
+            # shard along the first axis divisible by the world size
+            # (falls back to replication for small/odd leaves)
             p = self.comm.size
             for i, dim in enumerate(np.shape(a)):
                 if dim >= p and dim % p == 0:
@@ -225,21 +230,30 @@ class AllReduceSGDEngine:
                     )
             return self.replicated
 
-        # Place initial params/opt state (replicated, or fsdp-sharded).
-        # Copy defensively: device_put may alias the caller's buffers when
-        # the sharding already matches (single device), and the jitted step
-        # DONATES its inputs — without the copy, the caller's params would
-        # be deleted by the first step.
-        def _own(tree):
+        def _leaf_sharding(a, shard: bool) -> NamedSharding:
+            return _sharded_leaf(a) if shard else self.replicated
+
+        # Which trees are sharded: fsdp shards params + optimizer state
+        # (ZeRO-3); zero1 shards ONLY the optimizer state (ZeRO-1 — the
+        # memory win of sharded moments without per-layer param gathers).
+        shard_params = self.param_sharding == "fsdp"
+        shard_opt = self.param_sharding in ("fsdp", "zero1")
+
+        # Place initial params/opt state. Copy defensively: device_put may
+        # alias the caller's buffers when the sharding already matches
+        # (single device), and the jitted step DONATES its inputs —
+        # without the copy, the caller's params would be deleted by the
+        # first step.
+        def _own(tree, shard: bool):
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(
-                    jnp.array(a, copy=True), _leaf_sharding(a)
+                    jnp.array(a, copy=True), _leaf_sharding(a, shard)
                 ),
                 tree,
             )
 
         if (
-            self.param_sharding == "fsdp"
+            self.param_sharding in ("fsdp", "zero1")
             and broadcast_parameters
             and jax.process_count() > 1
         ):
@@ -253,9 +267,31 @@ class AllReduceSGDEngine:
             if model_state is not None:
                 model_state = multihost_utils.broadcast_one_to_all(model_state)
 
-        self.params = _own(params)
-        self.model_state = _own(model_state) if model_state is not None else None
-        self.opt_state = _own(self.optimizer.init(params))
+        self.params = _own(params, shard_params)
+        self.model_state = (
+            _own(model_state, shard_params)
+            if model_state is not None
+            else None
+        )
+        self.opt_state = _own(self.optimizer.init(params), shard_opt)
+        # Pin output shardings for the GSPMD step: without the constraint,
+        # propagation from the sharded optimizer math could migrate the
+        # (zero1) replicated params to a sharded layout after one step.
+        # Read them off the just-placed trees so placement and constraint
+        # can never diverge.
+        def _shardings_of(tree):
+            return jax.tree_util.tree_map(lambda a: a.sharding, tree)
+
+        self._out_shardings = (
+            _shardings_of(self.params),
+            _shardings_of(self.opt_state),
+            (
+                _shardings_of(self.model_state)
+                if self.model_state is not None
+                else None
+            ),
+            self.replicated,
+        )
         self._step_fn = self._build_step()
         self._bcast_fn = self._build_broadcast()
         self._epoch_fns: Dict[tuple, Callable] = {}
@@ -381,8 +417,12 @@ class AllReduceSGDEngine:
         return params, opt_state, new_state, loss
 
     def _build_step(self):
-        if self.param_sharding == "fsdp":
-            return jax.jit(self._fsdp_step_core, donate_argnums=(0, 1, 2))
+        if self.param_sharding in ("fsdp", "zero1"):
+            return jax.jit(
+                self._fsdp_step_core,
+                donate_argnums=(0, 1, 2),
+                out_shardings=self._out_shardings,
+            )
         shmapped = jax.shard_map(
             self._step_core,
             mesh=self.mesh,
@@ -393,10 +433,10 @@ class AllReduceSGDEngine:
         return jax.jit(shmapped, donate_argnums=(0, 1, 2))
 
     def _build_broadcast(self):
-        if self.param_sharding == "fsdp":
-            # one logical (sharded) copy: nothing to equalize at step time
-            # (multi-process init divergence was reconciled host-side in
-            # __init__ before sharding)
+        if self.param_sharding in ("fsdp", "zero1"):
+            # one logical (sharded or replicated-under-GSPMD) copy:
+            # nothing to equalize at step time (multi-process init
+            # divergence was reconciled host-side in __init__)
             return lambda p: p
         bcast = jax.shard_map(
             lambda p: mpinn.in_graph_synchronize_parameters(p, _AXIS, 0),
@@ -462,7 +502,7 @@ class AllReduceSGDEngine:
             return fn
         B, nb = per_rank, num_batches
 
-        if self.param_sharding == "fsdp":
+        if self.param_sharding in ("fsdp", "zero1"):
             p = self.comm.size
 
             def fsdp_epoch(params, opt_state, model_state, xs, ys, rngkey):
@@ -514,7 +554,11 @@ class AllReduceSGDEngine:
                 )
                 return params, opt_state, model_state, losses
 
-            fn = jax.jit(fsdp_epoch, donate_argnums=(0, 1, 2))
+            fn = jax.jit(
+                fsdp_epoch,
+                donate_argnums=(0, 1, 2),
+                out_shardings=self._out_shardings,
+            )
             self._epoch_fns[key] = fn
             return fn
 
