@@ -60,6 +60,12 @@ from .runtime_state import (
     stop,
 )
 
+# Submodules as attributes, matching the reference's surface (torchmpi.nn,
+# torchmpi.parameterserver, ...): `import torchmpi_tpu as mpi; mpi.nn.*`
+# must work without a separate import. Imported LAST — each pulls from
+# `collectives`/`runtime_state` above, so the order avoids cycles.
+from . import engine, nn, parallel, parameterserver, utils  # noqa: E402
+
 __version__ = "0.1.0"
 
 __all__ = [
