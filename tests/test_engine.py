@@ -554,3 +554,35 @@ def test_engine_evaluate_keys_on_captured_values():
     v0 = engine.evaluate(apply_fn, xte, yte, metric_for(0.0))
     v1 = engine.evaluate(apply_fn, xte, yte, metric_for(10.0))
     assert abs((v1 - v0) - 10.0) < 1e-5
+
+
+def test_engine_evaluate_observes_single_element_mutation():
+    """A ONE-element in-place write to a cached eval array must be seen
+    (restaged), not served stale — the round-3 strided fingerprint could
+    miss sub-stride writes; the full-buffer checksum cannot."""
+    (xtr, ytr), (xte, yte) = synthetic_mnist(num_train=64, num_test=64)
+    model = LogisticRegression()
+    params = init_params(model, (1, 28, 28))
+    engine = AllReduceSGDEngine(
+        make_loss_fn(model), params, optimizer=optax.sgd(0.1)
+    )
+    engine.broadcast_parameters_now()
+
+    apply_fn = lambda prm, x: model.apply({"params": prm}, x)  # noqa: E731
+    mean_logit = lambda logits, y: jnp.mean(logits)  # noqa: E731
+    v0 = engine.evaluate(apply_fn, xte, yte, mean_logit)
+    assert engine.evaluate(apply_fn, xte, yte, mean_logit) == v0  # cached
+    xte[3, 7, 7] += 1000.0  # single element: sub-stride for any sampling
+    v1 = engine.evaluate(apply_fn, xte, yte, mean_logit)
+    assert v1 != v0, "mutated eval array served from stale cache"
+
+    # explicit invalidation drops the staged slot outright
+    engine.invalidate_eval_cache(xte, yte)
+    assert (id(xte), id(yte)) not in engine._eval_data
+    assert engine.evaluate(apply_fn, xte, yte, mean_logit) == v1
+    # x-only form drops every slot staged for that array
+    engine.invalidate_eval_cache(xte)
+    assert all(k[0] != id(xte) for k in engine._eval_data)
+    assert engine.evaluate(apply_fn, xte, yte, mean_logit) == v1
+    engine.invalidate_eval_cache()
+    assert not engine._eval_data

@@ -24,6 +24,7 @@ Reference behaviors preserved, re-designed for XLA:
 from __future__ import annotations
 
 import time
+import zlib
 from functools import partial
 from typing import Any, Callable, Dict, Optional
 
@@ -87,25 +88,22 @@ def _fn_key(fn) -> Any:
 
 
 def _array_fingerprint(a) -> tuple:
-    """Cheap content fingerprint (shape, dtype, strided sample hashes) used
-    to detect in-place mutation of cached eval arrays without hashing the
-    whole buffer. Two samples: ~16 leading-axis rows (catches whole-row
-    updates) plus a ~4096-point stride across the flattened buffer
-    (catches scattered writes anywhere, at that granularity — mutations
-    smaller than one stride cell can still slip through; callers mutating
-    cached arrays in place should not rely on sub-stride edits being
-    seen)."""
+    """Exact content fingerprint (shape, dtype, full-buffer CRC32) used to
+    detect in-place mutation of cached eval arrays. Round 3 sampled a
+    stride across the buffer, which admitted silent staleness for
+    sub-stride writes; a full checksum observes EVERY mutation. crc32
+    streams at ~GB/s over the buffer protocol (no copy for contiguous
+    arrays) and ``evaluate`` runs once per epoch, so exactness costs
+    milliseconds per GB — not a restage, not a recompile."""
     arr = np.asarray(a)
     if arr.size == 0:
-        return (arr.shape, arr.dtype.str, 0, 0)
-    rows = arr[:: max(1, len(arr) // 16)]
-    flat = arr.reshape(-1) if arr.flags.c_contiguous else arr.ravel()
-    pts = flat[:: max(1, flat.size // 4096)]
+        return (arr.shape, arr.dtype.str, 0)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
     return (
         arr.shape,
         arr.dtype.str,
-        hash(np.ascontiguousarray(rows).tobytes()),
-        hash(np.ascontiguousarray(pts).tobytes()),
+        zlib.crc32(memoryview(arr).cast("B")),
     )
 
 
@@ -784,6 +782,23 @@ class AllReduceSGDEngine:
             batch,
         )
 
+    def invalidate_eval_cache(self, x=None, y=None) -> None:
+        """Drop staged eval data — every slot (no arguments), every slot
+        staged for array ``x`` (``y`` omitted), or exactly the ``(x, y)``
+        slot. Mutations of cached host arrays are already observed
+        automatically (``_array_fingerprint`` checksums the full buffer on
+        every ``evaluate`` call — including after an invalidation, since
+        the fingerprint is also what a restaged slot is stored under);
+        this exists for callers who replace datasets wholesale and want
+        the staged HBM back before the next ``evaluate``."""
+        if x is None:
+            self._eval_data.clear()
+        elif y is None:
+            for key in [k for k in self._eval_data if k[0] == id(x)]:
+                del self._eval_data[key]
+        else:
+            self._eval_data.pop((id(x), id(y)), None)
+
     def evaluate(self, apply_fn: Callable, x, y, metric: Callable) -> float:
         """Device-resident evaluation of ``metric(apply_fn(...), y)``.
 
@@ -801,8 +816,10 @@ class AllReduceSGDEngine:
         n = (len(x) // p) * p
         # Stage-once cache: per-epoch evaluation on the same arrays must not
         # re-cross the host tunnel every call. Multi-slot (train/test sets
-        # alternate) and fingerprinted: in-place mutation of a cached array
-        # restages instead of returning stale results.
+        # alternate) and fingerprinted with a FULL-buffer checksum: any
+        # in-place mutation of a cached array — however small — restages
+        # instead of returning stale results. ``invalidate_eval_cache``
+        # force-drops slots without waiting for the checksum to notice.
         dkey = (id(x), id(y))
         fp = (_array_fingerprint(x), _array_fingerprint(y))
         cached = self._eval_data.get(dkey)
