@@ -126,21 +126,18 @@ def moe_dispatch_combine(
 def moe_load_stats(router_logits, axis: str = "ep", top_k: int = 1):
     """(tokens_per_expert[E], aux_load_balance_loss) — the standard
     mean-gate x mean-assignment auxiliary loss that discourages expert
-    collapse. With ``top_k > 1`` the assignment fraction counts every
-    selected route (each token contributes to k experts)."""
+    collapse. ``tokens_per_expert`` counts every selected route (each
+    token occupies capacity at k experts), but the aux loss uses the
+    GShard dispatch fraction — FIRST choice only — for any ``top_k``, so
+    its magnitude matches the standard formulation and load-balance
+    coefficients tuned on GShard/Switch setups transfer unchanged."""
     E = lax.axis_size(axis)
     gates = jax.nn.softmax(router_logits, axis=-1)
-    if top_k > 1:
-        _, idxs = lax.top_k(router_logits, top_k)
-        assign = jnp.sum(
-            jax.nn.one_hot(idxs, E, dtype=gates.dtype), axis=1
-        )
-    else:
-        assign = jax.nn.one_hot(
-            jnp.argmax(router_logits, axis=-1), E, dtype=gates.dtype
-        )
+    _, idxs = lax.top_k(router_logits, top_k)
+    routes = jnp.sum(jax.nn.one_hot(idxs, E, dtype=gates.dtype), axis=1)
+    first = jax.nn.one_hot(idxs[:, 0], E, dtype=gates.dtype)
     # global statistics across every device's token shard
-    tokens_per_expert = lax.psum(jnp.sum(assign, axis=0), axis)
+    tokens_per_expert = lax.psum(jnp.sum(routes, axis=0), axis)
     me = lax.pmean(jnp.mean(gates, axis=0), axis)
-    ce = lax.pmean(jnp.mean(assign, axis=0), axis)
+    ce = lax.pmean(jnp.mean(first, axis=0), axis)
     return tokens_per_expert, E * jnp.sum(me * ce)
