@@ -219,19 +219,21 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(tmp_path, source: str, ok_marker: str, extra_args=()) -> None:
+def _run_workers(
+    tmp_path, source: str, ok_marker: str, extra_args=(), nproc: int = 2
+) -> None:
     worker = tmp_path / "worker.py"
     worker.write_text(source)
     port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), "2", str(port)]
+            [sys.executable, str(worker), str(i), str(nproc), str(port)]
             + [str(a) for a in extra_args],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     outs = []
     for p in procs:
@@ -248,8 +250,12 @@ def _run_workers(tmp_path, source: str, ok_marker: str, extra_args=()) -> None:
 
 
 @pytest.mark.slow
-def test_two_process_allreduce(tmp_path):
-    _run_workers(tmp_path, _WORKER, "proc {pid} OK")
+@pytest.mark.parametrize("nproc", [2, 3, 4])
+def test_multiprocess_allreduce(tmp_path, nproc):
+    """2/3/4 REAL processes — odd counts included, the reference's
+    ``mpirun -n {1..37}`` sweep discipline (scripts/test_cpu.sh:14-33)
+    scaled to what localhost affords."""
+    _run_workers(tmp_path, _WORKER, "proc {pid} OK", nproc=nproc)
 
 
 @pytest.mark.slow
@@ -269,6 +275,87 @@ def test_two_process_parameterserver_downpour(tmp_path):
     center as the single-process oracle (the reference's whole point,
     parameterserver.cpp:309-400)."""
     _run_workers(tmp_path, _PS_WORKER, "ps proc {pid} OK")
+
+
+_EASGD_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["TORCHMPI_TPU_PS_HOST"] = "localhost"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import parameterserver as ps
+    from torchmpi_tpu.runtime_state import local_ranks
+
+    mpi.start(
+        coordinator_address=f"localhost:{{port}}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    comm = mpi.current_communicator()
+    p = comm.size
+    N, beta, rounds = 48, 0.9, 4
+    alpha = beta / p
+    init = np.linspace(-1.0, 1.0, N).astype(np.float32)
+
+    def replica0(client):
+        rs = np.random.RandomState(31 * client + 7)
+        return (init + rs.randn(N)).astype(np.float32)
+
+    center = ps.ParameterServer(init, comm=comm)
+    x = {{c: replica0(c) for c in local_ranks()}}
+
+    # synchronous EASGD rounds (easgdupdate.lua:46-82's math, made
+    # deterministic across processes): every client fetches the SAME
+    # center (barrier), then all elastic differences land with the
+    # commutative 'add' rule (barrier) — so the center's trajectory is
+    # order-independent and a numpy oracle can replay it exactly
+    for _ in range(rounds):
+        fetched = {{c: center.receive(client=c).wait() for c in x}}
+        mpi.barrier()
+        for c, xc in x.items():
+            old = fetched[c] - xc
+            x[c] = xc + alpha * old
+            center.send(-alpha * old, rule="add", client=c).wait()
+        mpi.barrier()
+
+    got = center.receive(client=local_ranks()[0]).wait()
+    mpi.barrier()
+
+    # single-process oracle of the same synchronous schedule
+    ec = init.copy()
+    ex = {{c: replica0(c) for c in range(p)}}
+    for _ in range(rounds):
+        fetched = ec.copy()
+        delta = np.zeros_like(ec)
+        for c in range(p):
+            old = fetched - ex[c]
+            ex[c] = ex[c] + alpha * old
+            delta += -alpha * old
+        ec = ec + delta
+    np.testing.assert_allclose(got, ec, rtol=1e-5, atol=1e-6)
+    for c in x:
+        np.testing.assert_allclose(x[c], ex[c], rtol=1e-5, atol=1e-6)
+    mpi.barrier()
+    center.free()
+    mpi.stop()
+    print(f"easgd proc {{pid}} OK")
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_three_process_parameterserver_easgd(tmp_path):
+    """Cross-process EASGD over THREE controller processes (odd count):
+    elastic-averaging rounds must reproduce the numpy oracle exactly —
+    the elastic difference depends on the fetched center, so this also
+    proves the barrier/applied-before-ack ordering the transport
+    guarantees (easgdupdate.lua:46-82; parameterserver.cpp:339-347)."""
+    _run_workers(tmp_path, _EASGD_WORKER, "easgd proc {pid} OK", nproc=3)
 
 
 _SCALAR_WORKER = textwrap.dedent(
