@@ -29,6 +29,14 @@ def main():
     ap.add_argument("--sp", type=int, default=4)
     ap.add_argument("--cpu-mesh", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--sp-backend",
+        default="xla",
+        choices=["xla", "pallas", "pallas_interpret", "auto"],
+        help="ring-attention transport: XLA ppermute ring, the Pallas "
+        "RDMA kernel (real multi-chip TPU), its interpret mode (CPU "
+        "mesh), or auto selection",
+    )
     args = ap.parse_args()
 
     if args.cpu_mesh:
@@ -61,7 +69,10 @@ def main():
     print(f"ranks={p} mesh=dp{dp} x sp{sp} seq={args.seq}")
 
     model = LongContextTransformer(
-        sp_axis="sp" if sp > 1 else None, max_len=args.seq, num_layers=2
+        sp_axis="sp" if sp > 1 else None,
+        sp_backend=args.sp_backend,
+        max_len=args.seq,
+        num_layers=2,
     )
     opt = optax.adam(args.lr)
 

@@ -753,3 +753,181 @@ def test_eager_pallas_bidir_dispatch():
     finally:
         rk._FORCE_INTERPRET = False
         mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# ring attention kernel
+# ---------------------------------------------------------------------------
+
+
+def _ra_mesh(p):
+    return Mesh(np.array(jax.devices()[:p]), ("sp",))
+
+
+@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_ring_attention_interpret(p, causal):
+    """The RDMA ring-attention kernel (interpret mode) == full attention
+    over the gathered sequence, causal and not, p = 2..8."""
+    from torchmpi_tpu.ops import ring_attention_pallas
+    from torchmpi_tpu.parallel.ring_attention import full_self_attention
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    rng = np.random.RandomState(100 * p + causal)
+    b, t, h, d = 2, 8 * p, 2, 16
+    q = rng.randn(b, t, h, d).astype(np.float32)
+    k = rng.randn(b, t, h, d).astype(np.float32)
+    v = rng.randn(b, t, h, d).astype(np.float32)
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention_pallas(
+                q, k, v, "sp", causal=causal, axis_size=p, interpret=True
+            ),
+            mesh=_ra_mesh(p),
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(q, k, v))
+    expect = np.asarray(full_self_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(out, expect, atol=2e-5)
+
+
+def test_pallas_ring_attention_bf16():
+    from torchmpi_tpu.ops import ring_attention_pallas
+    from torchmpi_tpu.parallel.ring_attention import full_self_attention
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    rng = np.random.RandomState(7)
+    b, t, h, d = 1, 32, 2, 8
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)  # noqa: E731
+    q, k, v = mk(), mk(), mk()
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention_pallas(
+                q, k, v, "sp", causal=True, axis_size=4, interpret=True
+            ),
+            mesh=_ra_mesh(4),
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    out = f(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    expect = full_self_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(expect), atol=0.05
+    )
+
+
+def test_pallas_ring_attention_grad_matches_xla():
+    """backend='pallas_interpret' must train: its custom VJP (XLA-ring
+    backward) produces the same loss AND gradients as the pure XLA ring."""
+    from torchmpi_tpu.parallel.ring_attention import ring_self_attention
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    p = 4
+    rng = np.random.RandomState(11)
+    b, t, h, d = 1, 8 * p, 2, 8
+    q = rng.randn(b, t, h, d).astype(np.float32)
+    k = rng.randn(b, t, h, d).astype(np.float32)
+    v = rng.randn(b, t, h, d).astype(np.float32)
+
+    def make(backend):
+        def loss(q, k, v):
+            o = ring_self_attention(
+                q, k, v, "sp", causal=True, backend=backend
+            )
+            return jax.lax.pmean(jnp.mean(o**2), "sp")
+
+        return jax.jit(
+            jax.shard_map(
+                jax.value_and_grad(loss, argnums=(0, 1, 2)),
+                mesh=_ra_mesh(p),
+                in_specs=(P(None, "sp"),) * 3,
+                out_specs=(P(), (P(None, "sp"),) * 3),
+                check_vma=False,
+            )
+        )
+
+    l0, g0 = make("xla")(q, k, v)
+    l1, g1 = make("pallas_interpret")(q, k, v)
+    np.testing.assert_allclose(float(l1), float(l0), atol=1e-6)
+    for a, b_ in zip(g0, g1):
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), atol=2e-5
+        )
+
+
+def test_pallas_ring_attention_vmem_envelope():
+    """Working sets beyond the VMEM budget are rejected loudly (callers
+    use backend='auto' for silent fallback to the XLA ring)."""
+    from torchmpi_tpu.ops import ring_attention_pallas
+    from torchmpi_tpu.ops.ring_attention_kernel import (
+        ring_attention_vmem_bytes,
+    )
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    big = (8, 2048, 8, 64)  # ~billions of bytes with slots + accumulators
+    assert ring_attention_vmem_bytes(big, jnp.bfloat16) > 10 * 1024 * 1024
+    q = jnp.zeros(big, jnp.bfloat16)
+    with pytest.raises(ValueError, match="VMEM envelope"):
+        jax.eval_shape(
+            lambda q: jax.shard_map(
+                lambda q: ring_attention_pallas(
+                    q, q, q, "sp", axis_size=2, interpret=True
+                ),
+                mesh=_ra_mesh(2),
+                in_specs=P(None, "sp"),
+                out_specs=P(None, "sp"),
+                check_vma=False,
+            )(q),
+            q,
+        )
+
+
+def test_long_context_transformer_pallas_backend():
+    """The model's sp_backend switch routes attention through the kernel:
+    forward logits match the XLA-ring backend."""
+    from torchmpi_tpu.models import LongContextTransformer
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    p = 4
+    rng = np.random.RandomState(5)
+    tokens = rng.randint(0, 64, (2, 8 * p)).astype(np.int32)
+
+    def run(backend):
+        lm = LongContextTransformer(
+            vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+            d_model=32, max_len=64, sp_axis="sp", sp_backend=backend,
+        )
+
+        def fwd(tok):
+            params = lm.init(jax.random.PRNGKey(0), tok)["params"]
+            return lm.apply({"params": params}, tok)
+
+        return np.asarray(
+            jax.jit(
+                jax.shard_map(
+                    fwd,
+                    mesh=_ra_mesh(p),
+                    in_specs=P(None, "sp"),
+                    out_specs=P(None, "sp"),
+                    check_vma=False,
+                )
+            )(tokens)
+        )
+
+    np.testing.assert_allclose(
+        run("pallas_interpret"), run("xla"), atol=2e-4
+    )

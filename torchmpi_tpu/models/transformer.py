@@ -46,6 +46,7 @@ class RingAttentionBlock(fnn.Module):
     head_dim: int
     mlp_ratio: int = 4
     sp_axis: Optional[str] = None  # None = full attention (single shard)
+    sp_backend: str = "xla"  # 'xla' | 'pallas' | 'pallas_interpret' | 'auto'
     dtype: Any = jnp.float32
 
     @fnn.compact
@@ -58,7 +59,10 @@ class RingAttentionBlock(fnn.Module):
         shape = x.shape[:2] + (self.num_heads, self.head_dim)
         q, k, v = (a.reshape(shape) for a in (q, k, v))
         if self.sp_axis is not None:
-            attn = ring_self_attention(q, k, v, axis=self.sp_axis, causal=True)
+            attn = ring_self_attention(
+                q, k, v, axis=self.sp_axis, causal=True,
+                backend=self.sp_backend,
+            )
         else:
             attn = full_self_attention(q, k, v, causal=True)
         attn = attn.reshape(x.shape[:2] + (-1,))
@@ -83,6 +87,7 @@ class LongContextTransformer(fnn.Module):
     d_model: int = 128
     max_len: int = 4096
     sp_axis: Optional[str] = None
+    sp_backend: str = "xla"  # ring-attention transport (see RingAttentionBlock)
     dtype: Any = jnp.float32
 
     @fnn.compact
@@ -101,6 +106,7 @@ class LongContextTransformer(fnn.Module):
                 num_heads=self.num_heads,
                 head_dim=self.head_dim,
                 sp_axis=self.sp_axis,
+                sp_backend=self.sp_backend,
                 dtype=self.dtype,
             )(x)
         x = fnn.LayerNorm(dtype=jnp.float32)(x)

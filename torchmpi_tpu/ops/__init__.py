@@ -1,6 +1,7 @@
 """Pallas TPU kernels: fused reduction, ring collectives over ICI RDMA."""
 
 from .reduce_kernel import accumulate, scale_accumulate
+from .ring_attention_kernel import ring_attention, ring_attention_pallas
 from .ring_kernels import (
     available,
     ring_allgather_pallas,
@@ -16,6 +17,8 @@ __all__ = [
     "accumulate",
     "scale_accumulate",
     "available",
+    "ring_attention",
+    "ring_attention_pallas",
     "ring_allgather_pallas",
     "ring_allreduce_bidir_pallas",
     "ring_allreduce_pallas",

@@ -1,0 +1,392 @@
+"""Pallas ring-attention kernel: double-buffered K/V RDMA ring with the
+streaming-softmax merge fused in-kernel.
+
+The sequence-parallel capability extension (SURVEY.md §5: "ICI ring =
+natural fit for ring attention") taken down to the transport the custom
+ring collectives already use: queries stay resident in VMEM, K/V blocks
+rotate around the mesh axis via inter-chip RDMA
+(``pltpu.make_async_remote_copy``) into double-buffered VMEM slots, and
+each ring step's block attention + flash-style online-softmax merge
+(running max ``m``, normalizer ``l``, f32 accumulator ``o``) executes
+while the next block is in flight — the same communication/compute
+overlap the XLA ``ppermute`` path (``parallel/ring_attention.py``) asks
+the compiler for, made explicit.
+
+Transport discipline mirrors ``ring_kernels._ring_phases_kernel`` (the
+reference's receive-centric ring, ``lib/detail/collectives_cuda.cpp:
+202-388``): a neighbor barrier before the first push, per-step
+``copy.wait()`` (send landed + symmetric incoming block arrived), and a
+capacity semaphore closing the fast-sender/slow-consumer race — slot
+``s%2`` is re-written by the LEFT neighbor at step s+1, so the consumer
+signals left after its step-s compute and a sender waits for that signal
+before pushing (signals stop two steps early so every semaphore ends the
+kernel drained).
+
+Numerics are the flash-attention contract: scores and accumulators in
+float32 regardless of input dtype; outputs cast back. Causal masking
+uses the static ring schedule — the block visiting at step s originated
+on rank ``(r - s) mod p``, so global key positions are known in-kernel.
+
+Differentiation: ``pallas_call`` has no autodiff, so the public
+:func:`ring_attention` wraps the kernel in a ``jax.custom_vjp``. The
+kernel saves the flash residuals — the output and the global
+log-sum-exp — and the backward is the ANALYTIC flash-attention gradient
+over a second K/V ring (``_ring_attention_bwd_xla``, ppermute
+transport): ``P = exp(S - lse)``, ``dS = P (dP - rowsum(dO∘O))``, with
+dK/dV accumulators riding the ring home. No forward recompute on the
+gradient path — training with the pallas backend costs one kernel
+forward plus one analytic backward, the same step economics as the XLA
+ring's autodiff.
+
+With one local chip this path cannot execute on hardware; correctness is
+validated in TPU interpret mode on the virtual CPU mesh (p = 2..8,
+causal x dtypes, vs gathered-sequence full attention), the same evidence
+discipline as the ring collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# VMEM footprint bound for one kernel invocation (q/k/v/o + 2x2 kv slots
+# + f32 accumulators must fit well under the ~16MB/core VMEM).
+_VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+
+
+def _ring_attn_kernel(
+    p: int,
+    axis: str,
+    causal: bool,
+    scale: float,
+    n: int,
+    my_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    kbuf,
+    vbuf,
+    oacc,
+    macc,
+    lacc,
+    send_k,
+    recv_k,
+    send_v,
+    recv_v,
+    cap_sem,
+):
+    """One device's program. ``q/k/v/o_ref``: [bh, n, d] VMEM (batch*heads
+    flattened to the leading dim; every cell's math is 2D for the MXU).
+    ``lse_ref``: [bh, n, 1] f32 log-sum-exp of the global scores — the
+    residual the analytic backward needs. ``kbuf/vbuf``: [2, bh, n, d]
+    double-buffered ring slots. ``oacc``: [bh, n, d] f32; ``macc/lacc``:
+    [bh, n, 1] f32 (2D per cell)."""
+    my = my_ref[0]
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my + p - 1, p)
+    bh = q_ref.shape[0]
+
+    oacc[:] = jnp.zeros_like(oacc)
+    macc[:] = jnp.full_like(macc, NEG_INF)
+    lacc[:] = jnp.zeros_like(lacc)
+    kbuf[0] = k_ref[:]
+    vbuf[0] = v_ref[:]
+
+    # neighbor barrier: nobody pushes until both neighbors arrived
+    barrier = pltpu.get_barrier_semaphore()
+    for nbr in (left, right):
+        pltpu.semaphore_signal(
+            barrier,
+            inc=1,
+            device_id={axis: nbr},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+    pltpu.semaphore_wait(barrier, 2)
+
+    def block_merge(s: int, slot: int):
+        """Attention of resident q against the slot's K/V block, merged
+        into the running (o, m, l) — one 2D flash step per (b, h) cell."""
+        src = lax.rem(my - s + p, p)  # rank whose shard this block is
+
+        def cell(i, _):
+            qi = q_ref[i].astype(jnp.float32)  # [n, d]
+            ki = kbuf[slot, i].astype(jnp.float32)
+            vi = vbuf[slot, i].astype(jnp.float32)
+            sij = (
+                lax.dot_general(
+                    qi, ki, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [n(q), n(k)]
+            if causal:
+                qpos = lax.broadcasted_iota(jnp.int32, (n, n), 0) + my * n
+                kpos = lax.broadcasted_iota(jnp.int32, (n, n), 1) + src * n
+                sij = jnp.where(qpos >= kpos, sij, NEG_INF)
+            mb = jnp.max(sij, axis=1, keepdims=True)  # [n, 1]
+            pexp = jnp.exp(sij - mb)
+            lb = jnp.sum(pexp, axis=1, keepdims=True)  # [n, 1]
+            ob = lax.dot_general(
+                pexp, vi, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [n, d]
+            m_old = macc[i]  # [n, 1]
+            m_new = jnp.maximum(m_old, mb)
+            alpha = jnp.exp(m_old - m_new)
+            beta = jnp.exp(mb - m_new)
+            lacc[i] = lacc[i] * alpha + lb * beta
+            oacc[i] = oacc[i] * alpha + ob * beta
+            macc[i] = m_new
+            return 0
+
+        lax.fori_loop(0, bh, cell, 0)
+
+    for s in range(p):
+        slot = s % 2
+        nslot = 1 - slot
+        copies = ()
+        if s < p - 1:
+            # the RIGHT neighbor computes on its slot ``nslot`` at step
+            # s-1; wait for its consumed-signal before overwriting
+            if s >= 1:
+                pltpu.semaphore_wait(cap_sem.at[nslot], 1)
+            copies = tuple(
+                pltpu.make_async_remote_copy(
+                    src_ref=buf.at[slot],
+                    dst_ref=buf.at[nslot],
+                    send_sem=ssem.at[slot],
+                    recv_sem=rsem.at[slot],
+                    device_id={axis: right},
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+                for buf, ssem, rsem in (
+                    (kbuf, send_k, recv_k),
+                    (vbuf, send_v, recv_v),
+                )
+            )
+            for c in copies:
+                c.start()
+        block_merge(s, slot)  # compute overlaps the in-flight DMA
+        for c in copies:
+            c.wait()  # our send landed + next block fully arrived
+        if s < p - 2:
+            # tell LEFT our slot is consumed (left overwrites it at its
+            # step s+1). Strictly after the wait above: the outgoing DMA
+            # reads this slot until the send completes, so an earlier
+            # signal would let left clobber bytes still in flight. No
+            # signal for the last two steps so cap_sem ends drained.
+            pltpu.semaphore_signal(
+                cap_sem.at[slot],
+                inc=1,
+                device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+    def finalize(i, _):
+        li = jnp.maximum(lacc[i], 1e-30)
+        o_ref[i] = (oacc[i] / li).astype(o_ref.dtype)
+        lse_ref[i] = macc[i] + jnp.log(li)
+        return 0
+
+    lax.fori_loop(0, bh, finalize, 0)
+
+
+def ring_attention_pallas(
+    q,
+    k,
+    v,
+    axis: str = "sp",
+    causal: bool = False,
+    axis_size: Optional[int] = None,
+    interpret: bool = False,
+    return_lse: bool = False,
+):
+    """Forward ring attention via the RDMA kernel. Call inside
+    ``shard_map``; q/k/v are the local shards ``[b, n_local, h, d]``.
+    Not differentiable — training uses :func:`ring_attention` (custom
+    VJP). ``return_lse=True`` additionally returns the global
+    log-sum-exp ``[b, h, n_local]`` f32 (the backward's residual).
+    Raises when the working set exceeds the VMEM envelope; callers
+    wanting automatic fallback use ``ring_self_attention(backend='auto')``.
+    """
+    p = axis_size or lax.axis_size(axis)
+    b, n, h, d = q.shape
+    if p == 1:
+        from ..parallel.ring_attention import full_self_attention
+
+        out = full_self_attention(q, k, v, causal=causal)
+        if return_lse:
+            lse = _full_lse(q, k, causal)
+            return out, lse
+        return out
+    bytes_needed = ring_attention_vmem_bytes(q.shape, q.dtype)
+    if bytes_needed > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"ring-attention working set {bytes_needed} B exceeds the VMEM "
+            f"envelope {_VMEM_BUDGET_BYTES} B; shard the batch/heads "
+            "further or use the XLA ppermute backend"
+        )
+    bh = b * h
+    # [b, n, h, d] -> [bh, n, d]: per-cell 2D math on the MXU
+    to_cells = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, n, d)  # noqa: E731
+    scale = 1.0 / math.sqrt(d)
+    my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
+    kernel = functools.partial(
+        _ring_attn_kernel, p, axis, causal, scale, n
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, bh, n, d), k.dtype),
+            pltpu.VMEM((2, bh, n, d), v.dtype),
+            pltpu.VMEM((bh, n, d), jnp.float32),
+            pltpu.VMEM((bh, n, 1), jnp.float32),
+            pltpu.VMEM((bh, n, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=11),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(my, to_cells(q), to_cells(k), to_cells(v))
+    out = out.reshape(b, h, n, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse.reshape(b, h, n)
+    return out
+
+
+def ring_attention_vmem_bytes(local_shape, dtype) -> int:
+    """Kernel working-set estimate for the given local q shape: q/k/v/o
+    plus the 2x2 double-buffered slots in ``dtype``, the f32 accumulator,
+    and the [.., n, 1] m/l columns."""
+    b, n, h, d = local_shape
+    cells = b * h * n * d
+    itemsize = jnp.dtype(dtype).itemsize
+    return cells * (8 * itemsize + 4) + 2 * 4 * b * h * n
+
+
+def _full_lse(q, k, causal):
+    """Single-shard log-sum-exp of the (scaled, optionally masked) scores:
+    ``[b, h, n]`` f32 — the p == 1 degenerate of the kernel's residual."""
+    n = q.shape[1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) / math.sqrt(q.shape[-1])
+    if causal:
+        mask = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    return jax.nn.logsumexp(s, axis=-1)
+
+
+def _ring_attention_bwd_xla(q, k, v, o, lse, do, axis, causal, p):
+    """Analytic flash-attention backward over a second K/V ring (XLA
+    ppermute transport). The forward's residuals make recomputing the
+    forward unnecessary: per visiting block, the true probabilities are
+    ``P = exp(S - lse)`` and ``dS = P * (dP - D)`` with
+    ``D = rowsum(dO * O)``; dK/dV accumulators ride the ring WITH their
+    blocks and are home after the p-th rotation. All accumulation in f32.
+    """
+    b, n, h, d = q.shape
+    r = lax.axis_index(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    scale = 1.0 / math.sqrt(d)
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # D_i = sum_d dO * O  -> [b, h, n]
+    D = jnp.einsum("bqhd,bqhd->bhq", dof, o.astype(jnp.float32))
+    q_pos = r * n + jnp.arange(n)
+
+    def step(s, carry):
+        dq, kb, vb, dkb, dvb = carry
+        src = (r - s) % p
+        k_pos = src * n + jnp.arange(n)
+        sij = jnp.einsum("bqhd,bkhd->bhqk", qf, kb) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            sij = jnp.where(mask[None, None], sij, NEG_INF)
+        pij = jnp.exp(sij - lse[..., None])  # true softmax probs
+        dvb = dvb + jnp.einsum("bhqk,bqhd->bkhd", pij, dof)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vb)
+        ds = pij * (dp - D[..., None])
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kb) * scale
+        dkb = dkb + jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        rot = lambda t: lax.ppermute(t, axis, perm)  # noqa: E731
+        return dq, rot(kb), rot(vb), rot(dkb), rot(dvb)
+
+    zeros = jnp.zeros((b, n, h, d), jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(
+        0,
+        p,
+        step,
+        (zeros, k.astype(jnp.float32), v.astype(jnp.float32), zeros, zeros),
+    )
+    # p rotations = identity: dk/dv finished the loop back on the rank
+    # that owns their block
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention(q, k, v, axis, causal=False, axis_size=None, interpret=False):
+    """Differentiable ring attention: RDMA-kernel forward, analytic
+    flash-attention ring backward from the saved (o, lse) residuals — no
+    forward recompute on the gradient path."""
+    return ring_attention_pallas(
+        q, k, v, axis=axis, causal=causal, axis_size=axis_size,
+        interpret=interpret,
+    )
+
+
+def _ra_fwd(q, k, v, axis, causal, axis_size, interpret):
+    out, lse = ring_attention_pallas(
+        q, k, v, axis=axis, causal=causal, axis_size=axis_size,
+        interpret=interpret, return_lse=True,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ra_bwd(axis, causal, axis_size, interpret, res, g):
+    q, k, v, o, lse = res
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        # no ring to walk: differentiate the local full attention
+        from ..parallel.ring_attention import full_self_attention
+
+        _, vjp = jax.vjp(
+            lambda q, k, v: full_self_attention(q, k, v, causal=causal),
+            q, k, v,
+        )
+        return vjp(g)
+    return _ring_attention_bwd_xla(q, k, v, o, lse, g, axis, causal, p)
+
+
+ring_attention.defvjp(_ra_fwd, _ra_bwd)

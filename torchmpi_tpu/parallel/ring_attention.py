@@ -8,9 +8,13 @@ the per-block attention compute, and no device ever materialises the full
 sequence. Flash-style streaming softmax (running max + normalizer) keeps
 the math exact.
 
-Pure-XLA implementation (works on the CPU test mesh and lowers ppermute to
-ICI collective-permute on TPU); a Pallas kernel variant with explicit
-double-buffered RDMA lives in ``ops/`` once the XLA path is the bottleneck.
+Two backends behind one function: the pure-XLA path (``backend='xla'``,
+works on the CPU test mesh and lowers ppermute to ICI collective-permute
+on TPU) and the Pallas kernel with explicit double-buffered K/V RDMA and
+the streaming-softmax merge in-kernel
+(``backend='pallas'``/``'pallas_interpret'``, ``ops/ring_attention_kernel
+.py``). ``backend='auto'`` picks the kernel on real multi-chip TPU when
+the working set fits its VMEM envelope, the XLA path otherwise.
 
 Derived from the ring-attention pattern in the public pallas guide and the
 scaling-book recipe: shift-K/V ring + online softmax.
@@ -54,6 +58,7 @@ def ring_self_attention(
     axis: str = "sp",
     causal: bool = False,
     axis_size: Optional[int] = None,
+    backend: str = "xla",
 ):
     """Exact self-attention over a sequence sharded along ``axis``.
 
@@ -62,10 +67,38 @@ def ring_self_attention(
     identical (up to float error) to full attention over the gathered
     sequence.
 
+    ``backend``: ``'xla'`` (ppermute ring), ``'pallas'`` (RDMA kernel,
+    differentiable via its custom VJP), ``'pallas_interpret'`` (kernel in
+    interpret mode — CPU-mesh validation), or ``'auto'`` (kernel on real
+    multi-chip TPU when it fits VMEM, else the XLA ring).
+
     Causal masking accounts for the global positions: the k/v block visiting
     at ring step s originated on rank ``(r - s) mod p``, so its global
     offset is known statically per step.
     """
+    if backend != "xla":
+        from ..ops.ring_attention_kernel import (
+            _VMEM_BUDGET_BYTES,
+            ring_attention,
+            ring_attention_vmem_bytes,
+        )
+
+        if backend in ("pallas", "pallas_interpret"):
+            return ring_attention(
+                q, k, v, axis, causal, axis_size,
+                backend == "pallas_interpret",
+            )
+        if backend == "auto":
+            from ..ops.ring_kernels import available
+
+            if (
+                available()
+                and ring_attention_vmem_bytes(q.shape, q.dtype)
+                <= _VMEM_BUDGET_BYTES
+            ):
+                return ring_attention(q, k, v, axis, causal, axis_size, False)
+        else:
+            raise ValueError(f"unknown ring-attention backend {backend!r}")
     p = axis_size or lax.axis_size(axis)
     b, n_local, h, d = q.shape
     r = lax.axis_index(axis)
