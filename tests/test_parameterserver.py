@@ -482,3 +482,160 @@ def test_transport_poisoned_multi_frame_not_reapplied():
         s2.close()
     finally:
         lst.close()
+
+
+def test_transport_pipelined_demux_correlation():
+    """Concurrent TRIGGERs through ONE pipelined channel must each get
+    their own rank's shard back — the FIFO demux correlates replies to
+    requests without request ids because the listener answers a
+    connection's frames in order."""
+    import threading
+
+    from concurrent.futures import Future
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            if msg.kind == "trigger":
+                msg.reply.set_result(np.full(4, float(rank), np.float32))
+            else:
+                msg.done.set()
+
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        results = {}
+        errors = []
+
+        def one(rank):
+            try:
+                results[rank] = ch.request(T._KIND_TRIGGER, 1, rank, 0)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=one, args=(r,)) for r in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        for r in range(16):
+            np.testing.assert_array_equal(
+                results[r], np.full(4, float(r), np.float32)
+            )
+    finally:
+        ch.close()
+        lst.close()
+
+
+def test_transport_channel_replay_applies_exactly_once():
+    """Killing the connection mid-pipeline must not lose or double-apply
+    updates: the channel replays un-answered frames in order and the
+    listener's seq dedup absorbs replays of already-applied ones."""
+    import threading
+    import time
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applies = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                time.sleep(0.05)  # slow enough to keep a pipeline in flight
+                applies.append(float(np.asarray(msg.payload).sum()))
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        errors = []
+
+        def one(i):
+            try:
+                ch.request(
+                    T._KIND_UPDATE, 1, 0, i, use_seq=True, rule="add",
+                    payload_arr=np.full(2, float(i), np.float32),
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.12)  # several applies done, several still in flight
+        ch._kick()  # sever the connection mid-pipeline
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        # every update applied EXACTLY once (replays of applied seqs are
+        # deduped; un-applied ones are replayed in order)
+        assert sorted(applies) == [2.0 * i for i in range(12)], sorted(applies)
+    finally:
+        ch.close()
+        lst.close()
+
+
+def test_transport_watchdog_measures_silence_not_queueing():
+    """With a watchdog configured, a deep pipeline of slow-but-live
+    applies must NOT trip it: replies keep landing, so the connection is
+    live even though late waiters queue for longer than one window.
+    (The watchdog bounds connection silence, not queue position.)"""
+    import threading
+    import time
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                time.sleep(0.3)  # live but slower than pipeline depth/wd
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    prev = constants.get("deadlock_timeout_seconds")
+    constants.set("deadlock_timeout_seconds", 2)
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        errors = []
+
+        def one(i):
+            try:
+                ch.request(
+                    T._KIND_UPDATE, 1, 0, i, use_seq=True, rule="add",
+                    payload_arr=np.ones(2, np.float32),
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        # 12 x 0.3s sequential applies = ~3.6s total queue, watchdog 2s:
+        # every reply gap is ~0.3s so the connection is never silent for
+        # a full window and nothing may fail
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+    finally:
+        constants.set("deadlock_timeout_seconds", prev)
+        ch.close()
+        lst.close()

@@ -20,7 +20,11 @@ Design:
 - an UPDATE is acked only after the server thread *applied* the rule (the
   Ssend happens-before guarantee, strengthened to applied — matching the
   in-process transport); a TRIGGER replies with the shard bytes;
-- clients keep one pooled persistent connection per peer process;
+- clients keep one persistent connection per peer process, PIPELINED:
+  senders hold the channel lock only to put a frame on the wire, replies
+  demux FIFO (the listener answers a connection's frames in order, so
+  TCP order is the request id) — many shard updates ride one connection
+  concurrently instead of lock-stepping a round trip each;
 - addresses are exchanged once via ``multihost_utils.process_allgather``
   (the runtime's coordination service), the analog of MPI's out-of-band
   bootstrap.
@@ -32,6 +36,8 @@ import os
 import socket
 import struct
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -129,6 +135,25 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _frame_bytes(
+    kind: int,
+    inst: int = 0,
+    rank: int = 0,
+    client: int = 0,
+    seq: int = 0,
+    fp: int = 0,
+    rule: str = "",
+    dtype: str = "",
+    payload: bytes = b"",
+) -> bytes:
+    rule_b, dtype_b = rule.encode(), dtype.encode()
+    header = _HEADER.pack(
+        _MAGIC, kind, inst, rank, client, seq, fp, _auth_token(),
+        len(rule_b), len(dtype_b), len(payload),
+    )
+    return header + rule_b + dtype_b + payload
+
+
 def _send_frame(
     sock: socket.socket,
     kind: int,
@@ -141,12 +166,9 @@ def _send_frame(
     dtype: str = "",
     payload: bytes = b"",
 ) -> None:
-    rule_b, dtype_b = rule.encode(), dtype.encode()
-    header = _HEADER.pack(
-        _MAGIC, kind, inst, rank, client, seq, fp, _auth_token(),
-        len(rule_b), len(dtype_b), len(payload),
+    sock.sendall(
+        _frame_bytes(kind, inst, rank, client, seq, fp, rule, dtype, payload)
     )
-    sock.sendall(header + rule_b + dtype_b + payload)
 
 
 def _recv_frame(sock: socket.socket):
@@ -463,53 +485,182 @@ class _Listener:
             pass
 
 
-class _PeerPool:
-    """One persistent, lock-serialized connection per peer process."""
+class _Waiter:
+    """One in-flight request: the raw frame (retained so a reconnect can
+    replay it in original order) and the completion slot."""
 
-    def __init__(self, addresses: Dict[int, Tuple[str, int]]):
+    __slots__ = ("event", "frame", "reply", "error")
+
+    def __init__(self, frame: bytes):
+        self.event = threading.Event()
+        self.frame = frame
+        self.reply = None
+        self.error: Optional[Exception] = None
+
+
+class _PeerChannel:
+    """One persistent connection to a peer, PIPELINED: a sender holds the
+    channel lock only while assigning its seq and putting the frame on
+    the wire — never for the round trip — so many requests ride the
+    connection concurrently. A demux reader thread completes waiters
+    strictly FIFO, which is a valid correlation because the listener
+    serves each connection's frames in order and replies in order: TCP
+    order IS the request id (no wire-format change).
+
+    Reconnects are CHANNEL-level, not caller-level: on a broken
+    connection the channel reconnects once and replays every un-answered
+    frame in original order. Caller-side retries would be wrong here —
+    two pipelined updates of one (inst, rank, client) could be resent in
+    swapped order, and the server's monotone seq dedup would then drop
+    the earlier one as "already applied" (silent update loss). In-order
+    replay preserves exactly the assignment-order == wire-order
+    invariant the dedup was designed around; replayed frames whose
+    original apply DID land are answered from the dedup/in-flight
+    tables, never re-applied."""
+
+    def __init__(self, addresses: Dict[int, Tuple[str, int]], proc: int):
         self.addresses = addresses
-        self._conns: Dict[int, socket.socket] = {}
-        self._locks: Dict[int, threading.Lock] = {
-            p: threading.Lock() for p in addresses
-        }
-        # per-PEER update sequence counters, incremented under that peer's
-        # lock: assignment order == wire order per peer (and thus per dedup
-        # key, since a key's shard lives on exactly one peer), and no
-        # cross-peer sharing that a racing increment could roll back
-        self._seqs: Dict[int, int] = {p: 0 for p in addresses}
+        self.proc = proc
+        self.lock = threading.Lock()
+        self.pending: "deque[_Waiter]" = deque()
+        self.sock: Optional[socket.socket] = None
+        self.gen = 0  # connection generation (stale-reader guard)
+        self.seq = 0
+        # replay attempts since the last successful reply; bounds the
+        # reconnect loop to ONE outstanding replay (the old pool's "one
+        # reconnect attempt" budget)
+        self._unacked_replays = 0
+        # liveness marker for the waiter watchdog: monotonic time of the
+        # last reply (or connect). A pipelined waiter may legitimately
+        # queue for many windows behind slow-but-live applies; only a
+        # connection with NO traffic for a full window is wedged.
+        self._last_reply = time.monotonic()
+        self.closed = False
 
-    def _connect(self, proc: int) -> socket.socket:
-        host, port = self.addresses[proc]
+    def _connect(self) -> socket.socket:
+        host, port = self.addresses[self.proc]
         last_err: Optional[Exception] = None
         for candidate in (host, "localhost"):
             try:
                 sock = socket.create_connection((candidate, port), timeout=30)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                # The 30s above bounds only the CONNECT. Established
-                # sockets must not inherit it: a server apply slower than
-                # 30s would raise timeout, reconnect, and resend — racing
-                # the still-in-flight first apply (double-apply risk for
-                # non-idempotent rules). Block indefinitely, or for the
-                # explicit deadlock watchdog when one is configured —
-                # with TCP keepalive as the liveness bound: a crashed or
-                # partitioned peer surfaces as a ConnectionError in
-                # ~75s instead of hanging forever, while a merely SLOW
-                # apply (live peer) never trips it.
+                # The 30s above bounds only the CONNECT. The established
+                # socket's RECV blocks indefinitely: slow applies are
+                # bounded by the waiter liveness check (deadlock
+                # watchdog), dead peers by TCP keepalive (~75s) — a recv
+                # timeout would tear down a healthy pipelined connection
+                # that simply had no traffic. SENDs, which happen under
+                # the channel lock, get the watchdog as SO_SNDTIMEO: a
+                # peer that stops reading while the TCP buffer is full
+                # would otherwise block sendall forever WITH the lock
+                # held — wedging the reader's demux and the _kick escape
+                # hatch along with it.
                 _enable_keepalive(sock)
-                sock.settimeout(
-                    constants.get("deadlock_timeout_seconds") or None
-                )
+                sock.settimeout(None)
+                wd = constants.get("deadlock_timeout_seconds") or 0
+                if wd > 0:
+                    sock.setsockopt(
+                        socket.SOL_SOCKET,
+                        socket.SO_SNDTIMEO,
+                        struct.pack("ll", int(wd), 0),
+                    )
                 return sock
             except OSError as e:  # try localhost fallback (single-host test)
                 last_err = e
         raise ConnectionError(
-            f"cannot reach parameter-server peer process {proc} at "
+            f"cannot reach parameter-server peer process {self.proc} at "
             f"{host}:{port}: {last_err}"
         )
 
+    def _connected_locked(self) -> socket.socket:
+        """Ensure a live connection + reader (caller holds ``self.lock``)."""
+        if self.sock is None:
+            self.sock = self._connect()
+            self.gen += 1
+            self._last_reply = time.monotonic()  # fresh liveness window
+            threading.Thread(
+                target=self._read_loop,
+                args=(self.sock, self.gen),
+                name=f"tm-ps-reader-{self.proc}",
+                daemon=True,
+            ).start()
+        return self.sock
+
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
+        while True:
+            try:
+                frame = _recv_frame(sock)
+            except Exception as e:  # noqa: BLE001 - includes auth/magic
+                self._on_broken(gen, e)
+                return
+            with self.lock:
+                w = self.pending.popleft() if self.pending else None
+                self._unacked_replays = 0  # traffic flows: reset budget
+                self._last_reply = time.monotonic()
+            if w is not None:
+                w.reply = frame
+                w.event.set()
+
+    def _fail_pending_locked(self, err: Exception) -> None:
+        while self.pending:
+            w = self.pending.popleft()
+            w.error = err
+            w.event.set()
+
+    def _on_broken(self, gen: int, err: Exception) -> None:
+        """Reader-side failure path: reconnect once and replay the
+        un-answered frames in order, or fail them all."""
+        with self.lock:
+            if gen != self.gen or self.closed:
+                return  # a newer connection already took over
+            if self.sock is not None:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
+            if not self.pending:
+                return  # nothing outstanding: reconnect lazily
+            if self._unacked_replays >= 1:
+                # the previous replay produced no reply before breaking
+                # again: peer is gone, stop looping
+                self._fail_pending_locked(
+                    ConnectionError(
+                        f"parameter-server peer {self.proc} unreachable "
+                        f"after replay: {err}"
+                    )
+                )
+                return
+            self._unacked_replays += 1
+            try:
+                sock = self._connected_locked()
+                for w in self.pending:
+                    sock.sendall(w.frame)
+            except (ConnectionError, OSError) as e2:
+                if self.sock is not None:
+                    try:
+                        self.sock.close()
+                    except OSError:
+                        pass
+                    self.sock = None
+                self._fail_pending_locked(
+                    ConnectionError(
+                        f"parameter-server peer {self.proc} reconnect "
+                        f"failed: {e2}"
+                    )
+                )
+
+    def _kick(self) -> None:
+        """Force the failure/replay path (used by waiter timeouts)."""
+        with self.lock:
+            if self.sock is not None:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+
     def request(
         self,
-        proc: int,
         kind: int,
         inst: int,
         rank: int,
@@ -521,54 +672,99 @@ class _PeerPool:
         payload_raw: bytes = b"",
         dtype_str: str = "",
     ):
-        """Synchronous request/response on the pooled connection. Safe to
-        retry on connection loss: UPDATEs carry ``seq`` (``use_seq``),
-        drawn from the per-peer counter UNDER the per-peer lock —
-        assignment order == wire order, so concurrent sends cannot be
-        misdeduped as retries."""
-        seq = 0
+        """Pipelined request/response. UPDATEs carry ``seq`` (``use_seq``),
+        drawn from the per-peer counter UNDER the channel lock together
+        with the send — assignment order == wire order, so the server's
+        dedup can never confuse concurrent sends with retries."""
         if payload_arr is not None:
             payload_raw = payload_arr.tobytes()
             dtype_str = payload_arr.dtype.str
-
-        def _do(sock):
-            _send_frame(
-                sock, kind, inst, rank, client, seq, fp, rule,
-                dtype_str, payload_raw,
-            )
-            return _recv_frame(sock)
-
-        with self._locks[proc]:
+        with self.lock:
+            if self.closed:
+                raise ConnectionError("parameter-server transport closed")
+            seq = 0
             if use_seq:
-                self._seqs[proc] += 1
-                seq = self._seqs[proc]
-            sock = self._conns.get(proc)
-            if sock is None:
-                sock = self._conns[proc] = self._connect(proc)
+                self.seq += 1
+                seq = self.seq
+            w = _Waiter(
+                _frame_bytes(
+                    kind, inst, rank, client, seq, fp, rule, dtype_str,
+                    payload_raw,
+                )
+            )
+            sock = self._connected_locked()  # raises if unreachable
+            self.pending.append(w)
             try:
-                rkind, _, _, _, _, _, rrule, rdtype, rpayload = _do(sock)
-            except (ConnectionError, OSError):
-                # one reconnect attempt (peer may have restarted its
-                # listener between requests)
+                sock.sendall(w.frame)
+            except OSError:
+                # leave w in pending and close: the reader's replay path
+                # resends it (in order) on the next connection
                 try:
                     sock.close()
                 except OSError:
                     pass
-                sock = self._conns[proc] = self._connect(proc)
-                rkind, _, _, _, _, _, rrule, rdtype, rpayload = _do(sock)
+        timeout = constants.get("deadlock_timeout_seconds") or None
+        # The watchdog bounds CONNECTION silence, not this waiter's queue
+        # position: a pipelined request may legitimately wait many
+        # windows behind slow-but-live applies (the server handles a
+        # connection's frames sequentially), and that was never a
+        # deadlock under the old lock-step pool either. Only when NO
+        # reply lands for a full window is the peer wedged: then force
+        # one reconnect+replay, and give it one more silent window
+        # before declaring it dead.
+        kicked = False
+        while not w.event.wait(timeout):
+            with self.lock:
+                silent = time.monotonic() - self._last_reply
+            if silent < (timeout or 0):
+                continue  # traffic is flowing; we're just queued
+            if not kicked:
+                kicked = True
+                self._kick()
+                continue
+            raise ConnectionError(
+                f"parameter-server peer {self.proc} sent nothing for "
+                f"{int(silent)}s (watchdog {timeout}s, after replay)"
+            )
+        if w.error is not None:
+            raise w.error
+        rkind, _, _, _, _, _, rrule, rdtype, rpayload = w.reply
         if rkind == _KIND_ERROR:
             raise RuntimeError(f"parameter-server peer error: {rrule}")
         if rkind == _KIND_SHARD:
             return np.frombuffer(rpayload, np.dtype(rdtype)).copy()
         return None  # ACK
 
+    def close(self) -> None:
+        with self.lock:
+            self.closed = True
+            if self.sock is not None:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
+            self._fail_pending_locked(
+                ConnectionError("parameter-server transport closed")
+            )
+
+
+class _PeerPool:
+    """Pipelined persistent channels, one per peer process."""
+
+    def __init__(self, addresses: Dict[int, Tuple[str, int]]):
+        self.addresses = addresses
+        self._channels: Dict[int, _PeerChannel] = {
+            p: _PeerChannel(addresses, p) for p in addresses
+        }
+
+    def request(self, proc: int, kind: int, inst: int, rank: int,
+                client: int, **kw):
+        return self._channels[proc].request(kind, inst, rank, client, **kw)
+
     def close(self):
-        for sock in self._conns.values():
-            try:
-                sock.close()
-            except OSError:
-                pass
-        self._conns.clear()
+        for ch in self._channels.values():
+            ch.close()
 
 
 class Transport:
