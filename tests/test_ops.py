@@ -1,11 +1,22 @@
 """Pallas kernel tests (interpret mode on the CPU mesh; the same kernels
-run natively on real TPU meshes)."""
+run natively on real TPU meshes).
+
+Hardware sweep: on a real multi-chip TPU mesh, set
+``TORCHMPI_TPU_HW_KERNELS=1`` to run this exact file with interpret mode
+OFF — the kernels lower through Mosaic and move real ICI traffic, so the
+interpret-validated schedules get their hardware parity evidence from
+the same closed-form assertions (see docs/PARITY.md "Evidence status").
+"""
+
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
+
+INTERPRET = os.environ.get("TORCHMPI_TPU_HW_KERNELS", "") != "1"
 
 from torchmpi_tpu.ops.reduce_kernel import accumulate, scale_accumulate
 from torchmpi_tpu.ops.ring_kernels import available, ring_allreduce_pallas
@@ -15,7 +26,7 @@ def test_accumulate_matches_add():
     rng = np.random.RandomState(0)
     a = jnp.asarray(rng.randn(317, 53).astype(np.float32))  # ragged shape
     b = jnp.asarray(rng.randn(317, 53).astype(np.float32))
-    out = accumulate(a, b, interpret=True)
+    out = accumulate(a, b, interpret=INTERPRET)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(a) + np.asarray(b), rtol=1e-6
     )
@@ -25,7 +36,7 @@ def test_scale_accumulate():
     rng = np.random.RandomState(1)
     a = jnp.asarray(rng.randn(1000).astype(np.float32))
     b = jnp.asarray(rng.randn(1000).astype(np.float32))
-    out = scale_accumulate(a, b, -0.25, interpret=True)
+    out = scale_accumulate(a, b, -0.25, interpret=INTERPRET)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(a) - 0.25 * np.asarray(b), rtol=1e-5
     )
@@ -35,7 +46,7 @@ def test_accumulate_large_multiblock():
     n = 3 * 1024 * 128 + 17  # multiple grid blocks + ragged tail
     a = jnp.ones((n,), jnp.float32)
     b = jnp.full((n,), 2.0, jnp.float32)
-    out = accumulate(a, b, interpret=True)
+    out = accumulate(a, b, interpret=INTERPRET)
     np.testing.assert_array_equal(np.asarray(out), 3.0)
 
 
@@ -52,7 +63,7 @@ def test_pallas_ring_allreduce_interpret(p, n):
     f = jax.jit(
         jax.shard_map(
             lambda b: ring_allreduce_pallas(
-                b, "mpi", axis_size=p, interpret=True
+                b, "mpi", axis_size=p, interpret=INTERPRET
             ),
             mesh=mesh,
             in_specs=P("mpi"),
@@ -74,7 +85,7 @@ def test_pallas_ring_multidim_and_dtype():
     x = rng.randn(p, 6, 50).astype(np.float32)
     f = jax.jit(
         jax.shard_map(
-            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=p, interpret=True),
+            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=p, interpret=INTERPRET),
             mesh=mesh,
             in_specs=P("mpi"),
             out_specs=P("mpi"),
@@ -92,7 +103,7 @@ def test_pallas_singleton_axis_passthrough():
     x = jnp.ones((1, 16))
     out = jax.jit(
         jax.shard_map(
-            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=1, interpret=True),
+            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=1, interpret=INTERPRET),
             mesh=mesh,
             in_specs=P("mpi"),
             out_specs=P("mpi"),
@@ -116,7 +127,7 @@ def test_pallas_ring_2d_mesh():
     x = np.random.RandomState(1).randn(2, 4, 500).astype(np.float32)
     f = jax.jit(
         jax.shard_map(
-            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=4, interpret=True),
+            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=4, interpret=INTERPRET),
             mesh=mesh,
             in_specs=P("x", "mpi"),
             out_specs=P("x", "mpi"),
@@ -145,7 +156,7 @@ def test_pallas_ring_vmem_segmentation():
         x = np.random.RandomState(2).randn(p, n).astype(np.float32)
         f = jax.jit(
             jax.shard_map(
-                lambda b: ring_allreduce_pallas(b, "mpi", axis_size=p, interpret=True),
+                lambda b: ring_allreduce_pallas(b, "mpi", axis_size=p, interpret=INTERPRET),
                 mesh=mesh,
                 in_specs=P("mpi"),
                 out_specs=P("mpi"),
@@ -182,7 +193,7 @@ def test_pallas_ring_dtype_preserving(dtype):
         expect = x.sum(axis=0).astype(dtype)
     f = jax.jit(
         jax.shard_map(
-            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=p, interpret=True),
+            lambda b: ring_allreduce_pallas(b, "mpi", axis_size=p, interpret=INTERPRET),
             mesh=mesh,
             in_specs=P("mpi"),
             out_specs=P("mpi"),
@@ -217,7 +228,7 @@ def test_pallas_ring_broadcast_interpret(p, root, k):
     f = jax.jit(
         jax.shard_map(
             lambda b: ring_broadcast_pallas(
-                b, root, "mpi", axis_size=p, num_chunks=k, interpret=True
+                b, root, "mpi", axis_size=p, num_chunks=k, interpret=INTERPRET
             ),
             mesh=mesh,
             in_specs=P("mpi"),
@@ -245,7 +256,7 @@ def test_pallas_reduce_scatter_interpret(p):
     f = jax.jit(
         jax.shard_map(
             lambda b: ring_reduce_scatter_pallas(
-                b.reshape(p * seg), "mpi", axis_size=p, interpret=True
+                b.reshape(p * seg), "mpi", axis_size=p, interpret=INTERPRET
             ),
             mesh=mesh,
             in_specs=P("mpi"),
@@ -284,7 +295,7 @@ def test_pallas_reduce_scatter_rejects_indivisible():
         jax.jit(
             jax.shard_map(
                 lambda b: ring_reduce_scatter_pallas(
-                    b.reshape(-1), "mpi", axis_size=p, interpret=True
+                    b.reshape(-1), "mpi", axis_size=p, interpret=INTERPRET
                 ),
                 mesh=mesh,
                 in_specs=P("mpi"),
@@ -317,7 +328,7 @@ def test_pallas_broadcast_vmem_segmentation_and_bitcast():
         f = jax.jit(
             jax.shard_map(
                 lambda b: rk.ring_broadcast_pallas(
-                    b, 2, "mpi", axis_size=p, interpret=True
+                    b, 2, "mpi", axis_size=p, interpret=INTERPRET
                 ),
                 mesh=mesh,
                 in_specs=P("mpi"),
@@ -346,7 +357,7 @@ def test_pallas_reduce_scatter_vmem_segmentation():
         f = jax.jit(
             jax.shard_map(
                 lambda b: rk.ring_reduce_scatter_pallas(
-                    b.reshape(-1), "mpi", axis_size=p, interpret=True
+                    b.reshape(-1), "mpi", axis_size=p, interpret=INTERPRET
                 ),
                 mesh=mesh,
                 in_specs=P("mpi"),
@@ -373,7 +384,7 @@ def test_pallas_broadcast_bool_rides_as_uint8():
     f = jax.jit(
         jax.shard_map(
             lambda b: rk.ring_broadcast_pallas(
-                b, 1, "mpi", axis_size=p, interpret=True
+                b, 1, "mpi", axis_size=p, interpret=INTERPRET
             ),
             mesh=mesh,
             in_specs=P("mpi"),
@@ -406,7 +417,7 @@ def test_pallas_allgather_interpret(p, dtype):
     f = jax.jit(
         jax.shard_map(
             lambda b: ring_allgather_pallas(
-                b[0], "mpi", axis_size=p, interpret=True
+                b[0], "mpi", axis_size=p, interpret=INTERPRET
             )[None],
             mesh=mesh,
             in_specs=P("mpi"),
@@ -434,7 +445,7 @@ def test_eager_pallas_allgather_dispatch():
     from torchmpi_tpu.ops import ring_kernels as rk
 
     mpi.start()
-    rk._FORCE_INTERPRET = True
+    rk._FORCE_INTERPRET = INTERPRET
     try:
         p = mpi.size()
         comm = mpi.current_communicator()
@@ -457,7 +468,7 @@ def test_eager_pallas_reducescatter_dispatch():
     from torchmpi_tpu.ops import ring_kernels as rk
 
     mpi.start()
-    rk._FORCE_INTERPRET = True
+    rk._FORCE_INTERPRET = INTERPRET
     try:
         p = mpi.size()
         comm = mpi.current_communicator()
@@ -489,7 +500,7 @@ def test_eager_pallas_backend_dispatch():
     from torchmpi_tpu.ops import ring_kernels as rk
 
     mpi.start()
-    rk._FORCE_INTERPRET = True
+    rk._FORCE_INTERPRET = INTERPRET
     try:
         mpi.constants.set("small_allreduce_size_cpu", 1)  # stay on pallas
         p = mpi.size()
@@ -511,7 +522,7 @@ def test_eager_pallas_broadcast_dispatch():
     from torchmpi_tpu.ops import ring_kernels as rk
 
     mpi.start()
-    rk._FORCE_INTERPRET = True
+    rk._FORCE_INTERPRET = INTERPRET
     try:
         mpi.constants.set("small_broadcast_size_cpu", 1)
         mpi.constants.set("broadcast_size_tree_based_cpu", 64)  # pipeline
@@ -540,7 +551,7 @@ def test_eager_pallas_dtype_fallback():
     from torchmpi_tpu.ops import ring_kernels as rk
 
     mpi.start()
-    rk._FORCE_INTERPRET = True
+    rk._FORCE_INTERPRET = INTERPRET
     try:
         mpi.constants.set("small_allreduce_size_cpu", 1)
         mpi.constants.set("use_hierarchical_collectives", False)
@@ -589,7 +600,7 @@ def test_pallas_ring_reduce_interpret(p, root, dtype):
     f = jax.jit(
         jax.shard_map(
             lambda b: ring_reduce_pallas(
-                b, root, "mpi", axis_size=p, interpret=True
+                b, root, "mpi", axis_size=p, interpret=INTERPRET
             ),
             mesh=mesh,
             in_specs=P("mpi"),
@@ -634,19 +645,19 @@ def test_pallas_ring_step_counts():
         )(x)
 
     run(lambda b: rk.ring_allgather_pallas(
-        b[0], "mpi", axis_size=p, interpret=True)[None])
+        b[0], "mpi", axis_size=p, interpret=INTERPRET)[None])
     assert rk._LAST_STEP_COUNTS["allgather"] == p - 1
 
     run(lambda b: rk.ring_allreduce_pallas(
-        b, "mpi", axis_size=p, interpret=True))
+        b, "mpi", axis_size=p, interpret=INTERPRET))
     assert rk._LAST_STEP_COUNTS["allreduce"] == 2 * (p - 1)
 
     run(lambda b: rk.ring_reduce_pallas(
-        b, 0, "mpi", axis_size=p, interpret=True))
+        b, 0, "mpi", axis_size=p, interpret=INTERPRET))
     assert rk._LAST_STEP_COUNTS["reduce"] == 2 * (p - 1)
 
     run(lambda b: rk.ring_reduce_scatter_pallas(
-        b.reshape(-1), "mpi", axis_size=p, interpret=True))
+        b.reshape(-1), "mpi", axis_size=p, interpret=INTERPRET))
     assert rk._LAST_STEP_COUNTS["reduce_scatter"] == p - 1
 
 
@@ -658,7 +669,7 @@ def test_eager_pallas_reduce_dispatch():
     from torchmpi_tpu.ops import ring_kernels as rk
 
     mpi.start()
-    rk._FORCE_INTERPRET = True
+    rk._FORCE_INTERPRET = INTERPRET
     try:
         p = mpi.size()
         comm = mpi.current_communicator()
@@ -698,7 +709,7 @@ def test_pallas_bidir_allreduce_interpret(p, dtype):
     f = jax.jit(
         jax.shard_map(
             lambda b: ring_allreduce_bidir_pallas(
-                b, "mpi", axis_size=p, interpret=True
+                b, "mpi", axis_size=p, interpret=INTERPRET
             ),
             mesh=mesh,
             in_specs=P("mpi"),
@@ -728,7 +739,7 @@ def test_eager_pallas_bidir_dispatch():
     from torchmpi_tpu.ops import ring_kernels as rk
 
     mpi.start()
-    rk._FORCE_INTERPRET = True
+    rk._FORCE_INTERPRET = INTERPRET
     try:
         mpi.constants.set("small_allreduce_size_cpu", 1)
         mpi.constants.set("use_hierarchical_collectives", False)
@@ -782,7 +793,7 @@ def test_pallas_ring_attention_interpret(p, causal):
     f = jax.jit(
         jax.shard_map(
             lambda q, k, v: ring_attention_pallas(
-                q, k, v, "sp", causal=causal, axis_size=p, interpret=True
+                q, k, v, "sp", causal=causal, axis_size=p, interpret=INTERPRET
             ),
             mesh=_ra_mesh(p),
             in_specs=(P(None, "sp"),) * 3,
@@ -808,7 +819,7 @@ def test_pallas_ring_attention_bf16():
     f = jax.jit(
         jax.shard_map(
             lambda q, k, v: ring_attention_pallas(
-                q, k, v, "sp", causal=True, axis_size=4, interpret=True
+                q, k, v, "sp", causal=True, axis_size=4, interpret=INTERPRET
             ),
             mesh=_ra_mesh(4),
             in_specs=(P(None, "sp"),) * 3,
@@ -884,7 +895,7 @@ def test_pallas_ring_attention_vmem_envelope():
         jax.eval_shape(
             lambda q: jax.shard_map(
                 lambda q: ring_attention_pallas(
-                    q, q, q, "sp", axis_size=2, interpret=True
+                    q, q, q, "sp", axis_size=2, interpret=INTERPRET
                 ),
                 mesh=_ra_mesh(2),
                 in_specs=P(None, "sp"),
@@ -931,3 +942,43 @@ def test_long_context_transformer_pallas_backend():
     np.testing.assert_allclose(
         run("pallas_interpret"), run("xla"), atol=2e-4
     )
+
+
+def test_pallas_ring_attention_grad_singleton_axis():
+    """backend='pallas' on a size-1 sp axis: the custom VJP's p==1 branch
+    (single score matrix for out + lse, local full-attention backward)
+    must match plain autodiff of full attention."""
+    from torchmpi_tpu.ops import ring_attention
+    from torchmpi_tpu.parallel.ring_attention import full_self_attention
+
+    rng = np.random.RandomState(13)
+    b, t, h, d = 2, 16, 2, 8
+    q = rng.randn(b, t, h, d).astype(np.float32)
+    k = rng.randn(b, t, h, d).astype(np.float32)
+    v = rng.randn(b, t, h, d).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.mean(fn(q, k, v) ** 2)
+
+    ring_fn = lambda q, k, v: ring_attention(  # noqa: E731
+        q, k, v, "sp", True, 1, INTERPRET
+    )
+    l1, g1 = jax.jit(
+        jax.shard_map(
+            jax.value_and_grad(loss(ring_fn), argnums=(0, 1, 2)),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=(P(), (P(None, "sp"),) * 3),
+            check_vma=False,
+        )
+    )(q, k, v)
+    full_fn = lambda q, k, v: full_self_attention(  # noqa: E731
+        q, k, v, causal=True
+    )
+    l0, g0 = jax.value_and_grad(loss(full_fn), argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    )
+    np.testing.assert_allclose(float(l1), float(l0), atol=1e-6)
+    for a, b_ in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=2e-5)

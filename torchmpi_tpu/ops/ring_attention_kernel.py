@@ -223,13 +223,12 @@ def ring_attention_pallas(
     p = axis_size or lax.axis_size(axis)
     b, n, h, d = q.shape
     if p == 1:
+        if return_lse:
+            # one score matrix serves both the output and the residual
+            return _full_attention_with_lse(q, k, v, causal)
         from ..parallel.ring_attention import full_self_attention
 
-        out = full_self_attention(q, k, v, causal=causal)
-        if return_lse:
-            lse = _full_lse(q, k, causal)
-            return out, lse
-        return out
+        return full_self_attention(q, k, v, causal=causal)
     bytes_needed = ring_attention_vmem_bytes(q.shape, q.dtype)
     if bytes_needed > _VMEM_BUDGET_BYTES:
         raise ValueError(
@@ -292,9 +291,9 @@ def ring_attention_vmem_bytes(local_shape, dtype) -> int:
     return cells * (8 * itemsize + 4) + 2 * 4 * b * h * n
 
 
-def _full_lse(q, k, causal):
-    """Single-shard log-sum-exp of the (scaled, optionally masked) scores:
-    ``[b, h, n]`` f32 — the p == 1 degenerate of the kernel's residual."""
+def _full_attention_with_lse(q, k, v, causal):
+    """Single-shard attention returning ``(out, lse[b, h, n])`` from ONE
+    score matrix — the p == 1 degenerate of the kernel + its residual."""
     n = q.shape[1]
     s = jnp.einsum(
         "bqhd,bkhd->bhqk",
@@ -304,7 +303,10 @@ def _full_lse(q, k, causal):
     if causal:
         mask = jnp.tril(jnp.ones((n, n), bool))
         s = jnp.where(mask[None, None], s, NEG_INF)
-    return jax.nn.logsumexp(s, axis=-1)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    w = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
 
 
 def _ring_attention_bwd_xla(q, k, v, o, lse, do, axis, causal, p):
