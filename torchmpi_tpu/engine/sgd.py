@@ -140,6 +140,7 @@ class AllReduceSGDEngine:
         model_state=None,
         param_sharding: str = "replicated",
         accum_steps: int = 1,
+        remat: bool = False,
     ):
         """``model_state``: optional mutable-collection pytree (e.g. flax
         ``batch_stats``). When given, ``loss_fn`` must have the signature
@@ -169,7 +170,13 @@ class AllReduceSGDEngine:
         Stateless models follow the k=1 trajectory exactly; mutable state
         (batch-norm statistics) gets k microbatch-sized updates per step,
         standard accumulation semantics. Capability extension (the
-        reference predates accumulation)."""
+        reference predates accumulation).
+
+        ``remat``: wrap the loss in ``jax.checkpoint`` — backward
+        recomputes the forward instead of keeping its activations live
+        (HBM traded for one extra forward). Composes with ``accum_steps``
+        (remat within each microbatch) and with models' own per-layer
+        remat; gradients are bit-identical by construction."""
         if comm is None:
             from .. import runtime_state
 
@@ -201,7 +208,8 @@ class AllReduceSGDEngine:
         self.param_sharding = param_sharding
         self.batch_format = batch_format
         self.comm = comm
-        self.loss_fn = loss_fn
+        self.loss_fn = jax.checkpoint(loss_fn) if remat else loss_fn
+        self.remat = remat
         self.optimizer = optimizer or optax.sgd(0.2)
         self.mode = mode
         self.average_gradients = average_gradients

@@ -88,6 +88,7 @@ class LongContextTransformer(fnn.Module):
     max_len: int = 4096
     sp_axis: Optional[str] = None
     sp_backend: str = "xla"  # ring-attention transport (see RingAttentionBlock)
+    remat: bool = False  # rematerialize each block on backward (HBM for FLOPs)
     dtype: Any = jnp.float32
 
     @fnn.compact
@@ -101,13 +102,23 @@ class LongContextTransformer(fnn.Module):
             pos = jnp.arange(t_local)
         x = fnn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
         x = x + fnn.Embed(self.max_len, self.d_model, dtype=self.dtype)(pos)[None]
-        for _ in range(self.num_layers):
-            x = RingAttentionBlock(
+        # remat: drop each block's activations and recompute them during
+        # backward — long-context HBM is dominated by per-layer
+        # activations ([B, T, D] x layers), so this trades one extra
+        # forward per block for an O(num_layers) -> O(1) activation
+        # footprint (the standard long-sequence memory lever on TPU)
+        block_cls = fnn.remat(RingAttentionBlock) if self.remat else RingAttentionBlock
+        for i in range(self.num_layers):
+            # explicit name: the remat wrapper would otherwise rename the
+            # module path (Checkpoint...), making remat and non-remat
+            # checkpoints incompatible — same params must drive both
+            x = block_cls(
                 num_heads=self.num_heads,
                 head_dim=self.head_dim,
                 sp_axis=self.sp_axis,
                 sp_backend=self.sp_backend,
                 dtype=self.dtype,
+                name=f"RingAttentionBlock_{i}",
             )(x)
         x = fnn.LayerNorm(dtype=jnp.float32)(x)
         return fnn.Dense(self.vocab_size, dtype=jnp.float32)(x)
