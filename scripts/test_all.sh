@@ -23,6 +23,8 @@ for cmd in \
   "examples/mnist_parameterserver.py --cpu-mesh 8 --epochs 1 --variant dsgd" \
   "examples/mnist_modelparallel.py --cpu-mesh 8 --epochs 2" \
   "examples/long_context.py --cpu-mesh 8 --seq 128 --steps 10" \
+  "examples/long_context.py --cpu-mesh 4 --sp 2 --seq 64 --batch 2 --steps 2 --sp-backend pallas_interpret" \
+  "examples/pipeline_stages.py --cpu-mesh 8 --schedule 1f1b" \
   "examples/mnist_sequential.py --cpu --train 2048 --epochs 2" \
   "examples/resnet_allreduce.py --cpu-mesh 8 --model resnet18 --classes 10 --image-size 32 --train 128 --test 32 --per-rank-batch 4 --epochs 1" \
   "examples/blocksequential_2host.py --cpu-mesh 8 --train 512 --epochs 2" \
