@@ -159,3 +159,22 @@ def test_stop_resets():
     assert not mpi.started()
     mpi.start()  # restartable
     assert mpi.size() == len(jax.devices())
+
+
+def test_stack_describe_topology_dump():
+    """mpi.describe() dumps every stack level with the current marker and
+    span (analog of the reference's startup topology print,
+    torch_mpi.cpp:105-127)."""
+    import torchmpi_tpu as mpi
+
+    mpi.start()
+    try:
+        lvl = mpi.push_communicator(lambda r: str(r // 2), name="pairs")
+        out = mpi.describe()
+        assert f"current level={lvl}" in out
+        assert "'global'" in out and "'pairs'" in out
+        assert f"*[{lvl}]" in out  # current marker on the pushed level
+        mpi.set_communicator(0)
+        assert "current level=0" in mpi.describe()
+    finally:
+        mpi.stop()

@@ -46,6 +46,7 @@ from .runtime.communicator import Communicator, split_by_keys
 from .runtime.handles import SyncHandle, sync_all
 from .runtime_state import (
     communicator_names,
+    describe,
     current_communicator,
     num_nodes_in_communicator,
     num_processes,
@@ -80,6 +81,7 @@ __all__ = [
     "set_communicator",
     "set_collective_span",
     "communicator_names",
+    "describe",
     "num_nodes_in_communicator",
     "current_communicator",
     "stack",

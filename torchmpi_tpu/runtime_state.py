@@ -277,6 +277,24 @@ def communicator_names() -> List[str]:
     return _require_stack().names()
 
 
+def describe() -> str:
+    """Multi-line topology dump of the whole communicator stack — the
+    analog of the reference's startup topology print
+    (``torch_mpi.cpp:105-127``, ``init.lua:456-459``). Marks the current
+    level and the hierarchical collective span."""
+    st = _require_stack()
+    begin, end = st.span
+    lines = [
+        f"communicator stack (depth={st.depth}, current level={end}, "
+        f"span=[{begin}, {end}])"
+    ]
+    for level in range(st.depth):
+        marker = "*" if level == end else " "
+        desc = st.at(level).describe().replace("\n", "\n      ")
+        lines.append(f" {marker}[{level}] {desc}")
+    return "\n".join(lines)
+
+
 def num_nodes_in_communicator(level: Optional[int] = None) -> int:
     st = _require_stack()
     comm = st.current if level is None else st.at(level)
