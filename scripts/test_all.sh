@@ -20,6 +20,7 @@ for cmd in \
   "examples/mnist_allreduce.py --cpu-mesh 8 --epochs 2 --mode async" \
   "examples/mnist_parameterserver.py --cpu-mesh 8 --epochs 1 --variant downpour" \
   "examples/mnist_parameterserver.py --cpu-mesh 8 --epochs 1 --variant easgd" \
+  "examples/mnist_parameterserver.py --cpu-mesh 8 --epochs 1 --variant easgd --dataparallel" \
   "examples/mnist_parameterserver.py --cpu-mesh 8 --epochs 1 --variant dsgd" \
   "examples/mnist_modelparallel.py --cpu-mesh 8 --epochs 2" \
   "examples/long_context.py --cpu-mesh 8 --seq 128 --steps 10" \

@@ -1,0 +1,123 @@
+"""Unit tests for the bench launcher's evidence protocol.
+
+Two rounds of TPU perf evidence were lost to launcher kills and dead
+tunnels (BENCH_r02 rc=1, BENCH_r03 rc=124), so the launcher's contract
+is now load-bearing: the FIRST stdout line is the stale last-good TPU
+capture, the LAST line is the best available evidence (fresh TPU
+measurement > stale TPU capture > error record), and CPU fallbacks must
+never masquerade as hardware records. These tests pin that contract
+without any backend: probes and workers are monkeypatched.
+"""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    """A fresh bench module instance with its state pointed at tmp."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", _REPO / "bench.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "LAST_GOOD_FILE", tmp_path / "last_good.json")
+    # ample: _measure refuses to start an attempt with < 60s remaining
+    monkeypatch.setattr(mod, "TOTAL_DEADLINE_S", 3600)
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)  # no backoffs
+    return mod
+
+
+def _stale_record():
+    return {
+        "metric": "MNIST LeNet AllReduceSGD samples/sec/chip",
+        "value": 397277.1,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 2.765,
+        "platform": "tpu",
+        "captured_at": "2026-07-29T13:53:00Z",
+    }
+
+
+def _lines(capsys):
+    return [
+        json.loads(l)
+        for l in capsys.readouterr().out.splitlines()
+        if l.startswith("{")
+    ]
+
+
+def test_dead_tunnel_emits_stale_evidence_first_and_last(bench, capsys):
+    bench.LAST_GOOD_FILE.write_text(json.dumps({"mnist": _stale_record()}))
+    bench._PROBE_FAILURES = bench.MAX_PROBE_FAILURES  # tunnel declared dead
+    assert bench._launcher(["resnet50", "lm", "mnist"]) == 0
+    lines = _lines(capsys)
+    assert lines[0]["stale"] is True and lines[0]["value"] == 397277.1
+    assert lines[-1]["stale"] is True and lines[-1]["value"] == 397277.1
+    # the fresh-measurement attempt is on the record as an error line
+    errs = [l for l in lines if l.get("value") is None]
+    assert len(errs) == 3  # mnist + resnet50 + lm
+    assert errs[0]["last_good_capture"]["value"] == 397277.1
+
+
+def test_dead_tunnel_without_history_still_parseable(bench, capsys):
+    bench._PROBE_FAILURES = bench.MAX_PROBE_FAILURES
+    assert bench._launcher(["mnist"]) == 0
+    lines = _lines(capsys)
+    assert lines, "no parseable line on stdout"
+    assert lines[-1]["metric"] == bench._metric_name("mnist")
+    assert lines[-1]["value"] is None and "error" in lines[-1]
+
+
+def test_fresh_tpu_capture_wins_and_is_saved(bench, capsys, monkeypatch):
+    bench.LAST_GOOD_FILE.write_text(json.dumps({"mnist": _stale_record()}))
+    fresh = dict(_stale_record(), value=500000.0, vs_baseline=3.48)
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_run_worker", lambda m, t: (dict(fresh), None))
+    assert bench._launcher(["mnist"]) == 0
+    lines = _lines(capsys)
+    assert lines[0].get("stale") is True  # history still opens stdout
+    assert lines[-1]["value"] == 500000.0 and "stale" not in lines[-1]
+    saved = json.loads(bench.LAST_GOOD_FILE.read_text())["mnist"]
+    assert saved["value"] == 500000.0  # fresh TPU capture became last-good
+
+
+def test_cpu_fallback_never_overrides_tpu_evidence(bench, capsys, monkeypatch):
+    """A CPU dev-run measurement must neither be saved as last-good nor
+    outrank the stale TPU capture as the driver's last line."""
+    bench.LAST_GOOD_FILE.write_text(json.dumps({"mnist": _stale_record()}))
+    cpu = dict(_stale_record(), value=9000.0, platform="cpu")
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_run_worker", lambda m, t: (dict(cpu), None))
+    assert bench._launcher(["mnist"]) == 0
+    lines = _lines(capsys)
+    assert lines[-1]["platform"] == "tpu" and lines[-1]["stale"] is True
+    saved = json.loads(bench.LAST_GOOD_FILE.read_text())["mnist"]
+    assert saved["value"] == 397277.1  # unchanged
+
+
+def test_probe_failure_budget_is_global(bench, monkeypatch):
+    """After MAX_PROBE_FAILURES failed probes, later models skip straight
+    to their error records instead of re-burning the deadline."""
+    calls = []
+
+    def failing_probe(timeout_s=0):
+        calls.append(timeout_s)
+        bench._PROBE_FAILURES += 1
+        return False
+
+    monkeypatch.setattr(bench, "_probe_backend", failing_probe)
+    t0 = __import__("time").monotonic()
+    first = bench._measure("mnist", t0, max_attempts=4)
+    assert first["value"] is None
+    n_after_first = len(calls)
+    assert n_after_first <= bench.MAX_PROBE_FAILURES + 1
+    second = bench._measure("resnet50", t0, max_attempts=2)
+    assert second["value"] is None
+    assert len(calls) == n_after_first  # no further probe attempts
