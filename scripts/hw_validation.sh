@@ -49,19 +49,24 @@ echo "=== 5. training captures (north-star + compute-bound lines) ==="
 python bench.py | tee /tmp/hw_bench.out
 python - <<'EOF'
 import json
-recs = {}
+recs, last = {}, None
 for l in open("/tmp/hw_bench.out"):
     if l.startswith("{"):
-        rec = json.loads(l)
+        last = json.loads(l)
         # keep the best line per metric (a fresh capture supersedes the
         # stale opener the launcher prints first)
-        if not rec.get("stale") or rec["metric"] not in recs:
-            recs[rec["metric"]] = rec
+        if not last.get("stale") or last["metric"] not in recs:
+            recs[last["metric"]] = last
 assert recs, "bench printed no parseable line"
 for metric, rec in recs.items():
     assert rec.get("value") is not None and "error" not in rec, rec
     assert rec.get("platform") == "tpu" and not rec.get("stale"), rec
     print(f"fresh TPU capture ok: {metric} = {rec['value']} {rec['unit']}")
+# the DRIVER parses only the last stdout line: it too must be a fresh
+# on-TPU measurement, or the recorded evidence is stale/wrong even
+# though captures succeeded
+assert last.get("value") is not None and not last.get("stale"), last
+assert last.get("platform") == "tpu", last
 EOF
 
 echo "Success"

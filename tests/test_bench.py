@@ -11,7 +11,6 @@ without any backend: probes and workers are monkeypatched.
 
 import importlib.util
 import json
-import sys
 from pathlib import Path
 
 import pytest

@@ -50,7 +50,7 @@ def test_accumulate_large_multiblock():
     np.testing.assert_array_equal(np.asarray(out), 3.0)
 
 
-@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
 @pytest.mark.parametrize("n", [1024, 1000, 8 * 128 * 8 + 3])
 def test_pallas_ring_allreduce_interpret(p, n):
     """The RDMA ring allreduce (interpret mode) must equal the sum across
@@ -212,7 +212,7 @@ def test_pallas_ring_dtype_preserving(dtype):
         )
 
 
-@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
 @pytest.mark.parametrize("root", [0, 1])
 @pytest.mark.parametrize("k", [None, 4])
 def test_pallas_ring_broadcast_interpret(p, root, k):
@@ -240,7 +240,7 @@ def test_pallas_ring_broadcast_interpret(p, root, k):
     np.testing.assert_array_equal(out, np.tile(x[root], (p, 1)))
 
 
-@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
 def test_pallas_reduce_scatter_interpret(p):
     """psum_scatter semantics: device r gets the sum of every device's
     segment r."""
@@ -397,7 +397,7 @@ def test_pallas_broadcast_bool_rides_as_uint8():
     np.testing.assert_array_equal(out, np.tile(x[1], (p, 1)))
 
 
-@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32, jnp.bfloat16])
 def test_pallas_allgather_interpret(p, dtype):
     """Pallas ring allgather: every device gets [p, ...] stacked in rank
@@ -578,7 +578,7 @@ def test_eager_pallas_dtype_fallback():
         mpi.stop()
 
 
-@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
 @pytest.mark.parametrize("root", [0, 1])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32, jnp.bfloat16])
 def test_pallas_ring_reduce_interpret(p, root, dtype):
@@ -775,7 +775,7 @@ def _ra_mesh(p):
     return Mesh(np.array(jax.devices()[:p]), ("sp",))
 
 
-@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
 @pytest.mark.parametrize("causal", [False, True])
 def test_pallas_ring_attention_interpret(p, causal):
     """The RDMA ring-attention kernel (interpret mode) == full attention
