@@ -7,7 +7,6 @@ communicator level reports 2 nodes, and a cross-process eager allreduce
 produces the closed-form value on every process.
 """
 
-import socket
 import subprocess
 import sys
 import textwrap
@@ -214,9 +213,9 @@ _CKPT_WORKER = textwrap.dedent(
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+    from torchmpi_tpu.launch import _free_port as fp
+
+    return fp()
 
 
 def _run_workers(
@@ -399,3 +398,83 @@ def test_two_process_scalar_collectives(tmp_path):
     parity with the reference's per-C-type scalar surface
     (torchmpi/init.lua:125-134)."""
     _run_workers(tmp_path, _SCALAR_WORKER, "scalar proc {pid} OK")
+
+
+_LAUNCHED_WORKER = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import torchmpi_tpu as mpi
+
+    mpi.start()  # NO arguments: the launcher's env provides the world
+    p = mpi.size()
+    assert p == 4, p
+    assert mpi.num_processes() == 2
+    comm = mpi.current_communicator()
+    arr = jax.make_array_from_callback(
+        (p, 8), NamedSharding(comm.flat_mesh("mpi"), P("mpi")),
+        lambda idx: np.full((1, 8), float(idx[0].start or 0), np.float32))
+    out = mpi.allreduce_tensor(arr)
+    local = np.asarray(out.addressable_shards[0].data)
+    assert (local == p * (p - 1) / 2).all(), local
+    print(f"launched rank={{mpi.rank()}} OK")
+    mpi.stop()
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_launcher_runs_unmodified_script(tmp_path):
+    """python -m torchmpi_tpu.launch (the mpirun/wrap.sh analog): an
+    UNMODIFIED mpi.start() script becomes rank i of N via the launcher's
+    environment, with per-rank log files (wrap.sh's LOG_TO_FILE)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_LAUNCHED_WORKER)
+    log_dir = tmp_path / "logs"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "2",
+            "--log-dir", str(log_dir), str(worker),
+        ],
+        cwd=str(_REPO),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    logs = (log_dir / "rank_0.log").read_text() + (
+        log_dir / "rank_1.log"
+    ).read_text()
+    for rank in (0, 2):  # first local rank of each process
+        assert f"launched rank={rank} OK" in logs
+
+
+@pytest.mark.slow
+def test_launcher_kills_survivors_and_propagates_exit(tmp_path):
+    """One rank failing terminates the rest (the reference needed manual
+    pkill, dependencies/README.md:46-49) and the launcher exits with the
+    failing rank's code."""
+    crasher = tmp_path / "crasher.py"
+    crasher.write_text(
+        "import os, sys, time\n"
+        "if os.environ['TORCHMPI_TPU_PROCESS_ID'] == '1':\n"
+        "    sys.exit(7)\n"
+        "time.sleep(120)\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "1", str(crasher),
+        ],
+        cwd=str(_REPO),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=60,  # far below the survivor's sleep: proves the kill
+    )
+    assert proc.returncode == 7, proc.stdout[-1000:]

@@ -1,0 +1,181 @@
+"""Multi-process launcher — the ``mpirun`` / ``scripts/wrap.sh`` analog.
+
+The reference's whole UX is ``mpirun -n N wrap.sh luajit script.lua``
+(``scripts/wrap.sh``, ``scripts/ompirun.sh``): N identical processes, the
+world discovered from the environment, per-rank log redirection, and
+manual ``pkill`` when a rank died (``dependencies/README.md:46-49``).
+This is that launcher, TPU-native:
+
+    python -m torchmpi_tpu.launch --nproc 4 examples/mnist_allreduce.py
+    python -m torchmpi_tpu.launch --nproc 2 --cpu-devices 2 train.py -- --lr 0.1
+
+- spawns ``--nproc`` copies of the script (or ``-m module``) with
+  ``TORCHMPI_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID`` set;
+  ``mpi.start()`` reads them, so an unmodified script becomes rank i of N
+  (the MPI_Init-reads-mpirun's-env contract);
+- ``--cpu-devices K`` gives each process a K-device virtual CPU mesh
+  (XLA_FLAGS + TORCHMPI_TPU_FORCE_CPU) — the "multi-node without a
+  cluster" test mode (SURVEY.md §4);
+- ``--log-dir DIR`` writes ``rank_<i>.log`` per process (wrap.sh's
+  ``LOG_TO_FILE``); default streams every line prefixed ``[i]``;
+- one rank failing kills the rest (no manual pkill) and the launcher
+  exits with that rank's code; ``--nnodes/--node-rank/--coordinator``
+  extend the same contract across hosts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, rank: int) -> None:
+    for line in proc.stdout:  # type: ignore[union-attr]
+        sys.stdout.write(f"[{rank}] {line}")
+        sys.stdout.flush()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m torchmpi_tpu.launch",
+        description="spawn N torchmpi_tpu controller processes (mpirun analog)",
+    )
+    ap.add_argument("--nproc", type=int, required=True,
+                    help="processes to launch on THIS host")
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="give each process a K-device virtual CPU mesh")
+    ap.add_argument("--log-dir", default=None,
+                    help="write rank_<i>.log files instead of streaming")
+    ap.add_argument("--nnodes", type=int, default=1,
+                    help="total hosts in the job")
+    ap.add_argument("--node-rank", type=int, default=0,
+                    help="this host's index in [0, nnodes)")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (required when nnodes > 1; "
+                    "default: localhost:<free port>)")
+    ap.add_argument("-m", "--module", default=None,
+                    help="run a module (python -m) instead of a script")
+    ap.add_argument("script", nargs="?", default=None,
+                    help="script path (omit when using --module)")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="arguments passed through to the script")
+    args = ap.parse_args(argv)
+
+    if args.module is not None and args.script is not None:
+        # with -m, the `script` positional greedily eats the first
+        # passthrough token — everything positional belongs to the module
+        args.script_args = [args.script] + args.script_args
+        args.script = None
+    if (args.script is None) == (args.module is None):
+        ap.error("exactly one of a script path or --module is required")
+    if args.nproc < 1:
+        ap.error(f"--nproc must be >= 1, got {args.nproc}")
+    if args.nnodes > 1 and args.coordinator is None:
+        ap.error("--coordinator host:port is required when nnodes > 1")
+    if not 0 <= args.node_rank < args.nnodes:
+        ap.error(f"--node-rank {args.node_rank} outside [0, {args.nnodes})")
+
+    coordinator = args.coordinator or f"localhost:{_free_port()}"
+    world = args.nnodes * args.nproc
+    base = args.node_rank * args.nproc
+    target = (
+        [sys.executable, "-m", args.module]
+        if args.module
+        else [sys.executable, args.script]
+    )
+    # argparse.REMAINDER keeps a leading "--" separator; drop it
+    extra = args.script_args
+    if extra and extra[0] == "--":
+        extra = extra[1:]
+
+    procs: List[subprocess.Popen] = []
+    logs = []
+    log_dir = Path(args.log_dir) if args.log_dir else None
+    if log_dir is not None:
+        log_dir.mkdir(parents=True, exist_ok=True)
+    for i in range(args.nproc):
+        rank = base + i
+        env = dict(
+            os.environ,
+            TORCHMPI_TPU_COORDINATOR=coordinator,
+            TORCHMPI_TPU_NUM_PROCESSES=str(world),
+            TORCHMPI_TPU_PROCESS_ID=str(rank),
+        )
+        if args.cpu_devices:
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.cpu_devices}"
+            ).strip()
+            env["TORCHMPI_TPU_FORCE_CPU"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+        if log_dir is not None:
+            out = open(log_dir / f"rank_{rank}.log", "w")
+            logs.append(out)
+            proc = subprocess.Popen(
+                target + extra, env=env, stdout=out,
+                stderr=subprocess.STDOUT,
+            )
+        else:
+            proc = subprocess.Popen(
+                target + extra, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            threading.Thread(
+                target=_stream, args=(proc, rank), daemon=True
+            ).start()
+        procs.append(proc)
+
+    # one rank failing kills the rest (the reference needed manual pkill)
+    rc = 0
+    try:
+        remaining = set(range(args.nproc))
+        while remaining and rc == 0:
+            for i in [i for i in remaining if procs[i].poll() is not None]:
+                remaining.discard(i)
+                code = procs[i].returncode
+                if code != 0 and rc == 0:
+                    # signal deaths (segfault/OOM-kill) surface as the
+                    # conventional 128+signum, not Popen's negative code
+                    # (sys.exit(-9) would report 247)
+                    rc = 128 - code if code < 0 else code
+                    print(
+                        f"[launch] rank {base + i} exited with {code}; "
+                        "terminating remaining ranks",
+                        file=sys.stderr,
+                    )
+            if rc == 0 and remaining:
+                try:
+                    procs[sorted(remaining)[0]].wait(timeout=0.2)
+                except subprocess.TimeoutExpired:
+                    pass
+    except KeyboardInterrupt:
+        rc = rc or 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs:
+            f.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

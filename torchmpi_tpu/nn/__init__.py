@@ -297,8 +297,28 @@ def check_with_allreduce(
     stats = jnp.stack(
         [jnp.abs(jnp.mean(buf, axis=1)), jnp.abs(jnp.var(buf, axis=1))], axis=1
     )
-    reduced = np.asarray(collectives.allreduce_tensor(stats, comm=comm))
-    local = np.asarray(stats)
+
+    def _rows(a):
+        # multi-controller: fetching the global array would raise (rows
+        # on remote processes are non-addressable); map global row index
+        # -> row for whatever THIS process can see — each process checks
+        # the invariant on its ranks' rows, together covering all p
+        if getattr(a, "is_fully_addressable", True):
+            arr = np.asarray(a)
+            return {i: arr[i] for i in range(arr.shape[0])}
+        out = {}
+        for s in a.addressable_shards:
+            start = s.index[0].start or 0
+            d = np.asarray(s.data)
+            for j in range(d.shape[0]):
+                out[start + j] = d[j]
+        return out
+
+    red = _rows(collectives.allreduce_tensor(stats, comm=comm))
+    loc = _rows(stats)
+    common = sorted(set(red) & set(loc))
+    reduced = np.stack([red[i] for i in common])
+    local = np.stack([loc[i] for i in common])
     err = np.abs(reduced / p - local).max()
     if err > tol * max(1.0, np.abs(local).max()):
         raise AssertionError(

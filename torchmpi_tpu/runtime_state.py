@@ -7,6 +7,7 @@ lifecycle (``torch_mpi.cpp:233-306``).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, List, Optional, Sequence, Union
 
@@ -67,6 +68,31 @@ def start(
     with _lock:
         if _started:
             raise RuntimeError("torchmpi_tpu.start() called twice")
+    if with_tpu is False or os.environ.get(
+        "TORCHMPI_TPU_FORCE_CPU", ""
+    ).lower() in ("1", "true", "yes", "on"):
+        # must land BEFORE the first backend touch (devices/distributed
+        # init below): the environment's TPU plugin (sitecustomize) wins
+        # over the JAX_PLATFORMS env var, and probing a dead accelerator
+        # tunnel hangs rather than raising
+        jax.config.update("jax_platforms", "cpu")
+    if coordinator_address is None and "TORCHMPI_TPU_COORDINATOR" in os.environ:
+        # launcher-provided topology (``python -m torchmpi_tpu.launch``):
+        # an unmodified single-process script becomes rank i of N, the
+        # way MPI_Init reads its world from mpirun's environment
+        coordinator_address = os.environ["TORCHMPI_TPU_COORDINATOR"]
+        try:
+            if num_processes is None:
+                num_processes = int(os.environ["TORCHMPI_TPU_NUM_PROCESSES"])
+            if process_id is None:
+                process_id = int(os.environ["TORCHMPI_TPU_PROCESS_ID"])
+        except KeyError as e:
+            raise ValueError(
+                "TORCHMPI_TPU_COORDINATOR is set but its companion "
+                f"variable {e.args[0]} is missing — export all three "
+                "(the launcher sets them together) or pass "
+                "coordinator_address/num_processes/process_id explicitly"
+            ) from None
     if coordinator_address is None and (
         num_processes is not None or process_id is not None
     ):
