@@ -34,6 +34,10 @@ for cmd in \
   python $cmd || fails=$((fails+1))
 done
 
+echo "=== launcher (mpirun analog): unmodified example, 2 controllers ==="
+python -m torchmpi_tpu.launch --nproc 2 --cpu-devices 2 \
+  examples/mnist_allreduce.py -- --epochs 1 || fails=$((fails+1))
+
 echo "=== driver entry points ==="
 TORCHMPI_TPU_FORCE_CPU=1 python __graft_entry__.py 8 || fails=$((fails+1))
 

@@ -478,3 +478,43 @@ def test_launcher_kills_survivors_and_propagates_exit(tmp_path):
         timeout=60,  # far below the survivor's sleep: proves the kill
     )
     assert proc.returncode == 7, proc.stdout[-1000:]
+
+
+@pytest.mark.slow
+def test_launcher_multihost_contract(tmp_path):
+    """Two launcher invocations with --nnodes 2 --node-rank {0,1} and a
+    shared --coordinator behave as one job — the multi-host launch shape
+    (reference: mpirun with HOSTFILE) played out on localhost."""
+    from torchmpi_tpu.launch import _free_port
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_LAUNCHED_WORKER)
+    port = _free_port()
+    launchers = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "torchmpi_tpu.launch",
+                "--nproc", "1", "--cpu-devices", "2",
+                "--nnodes", "2", "--node-rank", str(nr),
+                "--coordinator", f"localhost:{port}", str(worker),
+            ],
+            cwd=str(_REPO),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for nr in (0, 1)
+    ]
+    outs = []
+    for p in launchers:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in launchers:
+                q.kill()
+            pytest.fail("multi-host launchers timed out")
+        outs.append(out)
+    for nr, (p, out) in enumerate(zip(launchers, outs)):
+        assert p.returncode == 0, f"node {nr} failed:\n{out[-2000:]}"
+    assert "launched rank=0 OK" in outs[0]
+    assert "launched rank=2 OK" in outs[1]
