@@ -485,8 +485,6 @@ def test_launcher_multihost_contract(tmp_path):
     """Two launcher invocations with --nnodes 2 --node-rank {0,1} and a
     shared --coordinator behave as one job — the multi-host launch shape
     (reference: mpirun with HOSTFILE) played out on localhost."""
-    from torchmpi_tpu.launch import _free_port
-
     worker = tmp_path / "worker.py"
     worker.write_text(_LAUNCHED_WORKER)
     port = _free_port()

@@ -48,6 +48,18 @@ class PSGroup:
         leaves, self.treedef = tree_util.tree_flatten(params)
         self.servers: List[ParameterServer] = []
         for leaf in leaves:
+            if not getattr(leaf, "is_fully_addressable", True):
+                # the PSGroup/Update convenience layer works on
+                # process-local rank-stacked replicas (the reference's
+                # per-rank Lua tables); a global array spanning
+                # controllers cannot be host-fetched. Multi-controller PS
+                # drives per-process clients against ParameterServer
+                # directly — the tests/test_multiprocess.py pattern.
+                raise ValueError(
+                    "PSGroup leaves must be process-local (rank-stacked "
+                    "host replicas); for multi-controller jobs use "
+                    "ParameterServer with per-process clients instead"
+                )
             arr = np.asarray(leaf)
             if arr.shape[0] != self.p:
                 raise ValueError(
