@@ -516,3 +516,29 @@ def test_launcher_multihost_contract(tmp_path):
         assert p.returncode == 0, f"node {nr} failed:\n{out[-2000:]}"
     assert "launched rank=0 OK" in outs[0]
     assert "launched rank=2 OK" in outs[1]
+
+
+@pytest.mark.slow
+def test_launcher_maps_signal_death_to_128_plus_signum(tmp_path):
+    """A rank killed by a signal (segfault/OOM-kill class) surfaces as the
+    conventional 128+signum, not Popen's negative code wrapped by
+    sys.exit into an arbitrary status."""
+    killer = tmp_path / "killer.py"
+    killer.write_text(
+        "import os, signal, time\n"
+        "if os.environ['TORCHMPI_TPU_PROCESS_ID'] == '1':\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        "time.sleep(120)\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "1", str(killer),
+        ],
+        cwd=str(_REPO),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 137, (proc.returncode, proc.stdout[-500:])
