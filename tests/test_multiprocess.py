@@ -542,3 +542,60 @@ def test_launcher_maps_signal_death_to_128_plus_signum(tmp_path):
         timeout=60,
     )
     assert proc.returncode == 137, (proc.returncode, proc.stdout[-500:])
+
+
+_STAGED_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["TORCHMPI_TPU_PS_HOST"] = "localhost"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torchmpi_tpu as mpi
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mpi.start(
+        coordinator_address=f"localhost:{{port}}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    hcomm = mpi.stack().at(1)          # per-node level: nproc groups of 2
+    p = hcomm.size
+    assert hcomm.cartesian and hcomm.num_intra_groups == nproc
+    mpi.constants.set("use_staged_collectives", True)
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+    big = jax.make_array_from_callback(
+        (p, 700),
+        NamedSharding(hcomm.flat_mesh("mpi"), P("mpi")),
+        lambda idx: np.full((1, 700), float(idx[0].start or 0), np.float32),
+    )
+    out = mpi.ring.allreduce_tensor(big, comm=hcomm)
+    local = np.asarray(out.addressable_shards[0].data)
+    assert (local == p * (p - 1) / 2).all(), local
+    assert any(
+        k[0] == "staged_allreduce" for k in hcomm._collective_resources
+    ), "staged path not taken"
+    # second round on the same executable: exercises the gather-tag
+    # epoch (distinct tags per exchange) and the cached intra_fn
+    out2 = mpi.ring.allreduce_tensor(out, comm=hcomm)
+    local2 = np.asarray(out2.addressable_shards[0].data)
+    assert (local2 == p * p * (p - 1) / 2).all(), local2
+    mpi.barrier()
+    mpi.stop()
+    print(f"staged proc {{pid}} OK")
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_multiprocess_staged_hierarchical_allreduce(tmp_path, nproc):
+    """use_staged_collectives=True across REAL controller processes: the
+    intra rings reduce on-device, the inter hop crosses processes over the
+    PS socket transport's host allgather — the cross-node deployment the
+    staged path exists for (collectives_cuda.cpp:390-683). Guards the
+    round-4 regression where jax.device_get touched non-addressable rows."""
+    _run_workers(tmp_path, _STAGED_WORKER, "staged proc {pid} OK", nproc=nproc)

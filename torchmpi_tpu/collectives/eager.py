@@ -698,19 +698,87 @@ def _run_staged_hierarchical_allreduce(x, comm: Communicator):
             check_vma=False,
         )
         perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
+        # pin the output to the rank-stacked sharding: the multi-controller
+        # fetch below maps shard -> rank from shard.index, which is only
+        # partition-exact (no replicated rows double-counted) when row r
+        # lives exactly on comm._devices[r]
         intra_fn = jax.jit(
-            lambda a: jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
+            lambda a: jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0),
+            out_shardings=_rank_sharding(comm, x.ndim),
         )
         reps = np.asarray([g[0] for g in comm._groups], np.int32)
         entry = (intra_fn, reps)
         cache[key] = entry
     intra_fn, reps = entry
     reduced = intra_fn(x)  # every rank holds its group's sum
-    # host-staged inter reduction
-    host = np.asarray(jax.device_get(reduced[np.asarray(reps)]))
-    total = host.sum(axis=0).astype(host.dtype)
+    # host-staged inter reduction (the DCN hop)
+    procs = sorted({d.process_index for d in comm._devices})
+    if len(procs) > 1:
+        # Multi-controller: jax.device_get of the full representative set
+        # would raise — most rep rows are non-addressable here. Instead
+        # each process sums the rep rows it OWNS (partition-exact thanks to
+        # the pinned rank sharding) and the partials meet over the PS
+        # socket transport: host wires, no inter-group device link — the
+        # point of the staged path (collectives_cuda.cpp:390-683).
+        rep_set = {int(r) for r in reps}
+        rows = {}
+        for shard in reduced.addressable_shards:
+            r = shard.index[0].start or 0
+            if r in rep_set and r not in rows:
+                rows[r] = np.asarray(shard.data)[0]
+        dt = np.dtype(reduced.dtype)
+        per_row = tuple(x.shape[1:])
+        partial = np.zeros(per_row, dt)
+        for row in rows.values():
+            partial = partial + row
+        partial = np.ascontiguousarray(partial, dt)
+        from ..parameterserver import transport as ps_transport
+
+        if ps_transport._transport is None and len(procs) < jax.process_count():
+            # Bootstrapping the transport does a JOB-global address
+            # exchange; entering it from a collective only a subset of
+            # processes runs would hang the subset forever. Bootstrap is
+            # a job-global act — demand it happen at one.
+            raise RuntimeError(
+                "staged hierarchical allreduce on a communicator spanning "
+                f"processes {procs} of {jax.process_count()}: the PS socket "
+                "transport is not bootstrapped, and bootstrapping is "
+                "job-global. Call torchmpi_tpu.parameterserver.transport."
+                "ensure_transport() once on EVERY process (e.g. right "
+                "after start()) before staged collectives on subset "
+                "communicators."
+            )
+        # distinct gather tag per exchange, scoped to the PARTICIPATING
+        # process set: SPMD program order is only guaranteed among the
+        # processes that actually run this collective, so a process-global
+        # counter would desync when subset communicators overlap
+        pkey = tuple(procs)
+        epoch = _staged_exchange_epochs.get(pkey, 0) + 1
+        _staged_exchange_epochs[pkey] = epoch
+        tag = f"staged-allreduce:{','.join(map(str, pkey))}:{epoch}"
+        blobs = ps_transport.ensure_transport().allgather_blob(
+            procs, tag, partial.tobytes(),
+            timeout=constants.get("deadlock_timeout_seconds") or None,
+        )
+        total = np.zeros(per_row, dt)
+        for blob in blobs.values():
+            total = total + np.frombuffer(blob, dt).reshape(per_row)
+        total = total.astype(dt, copy=False)
+    else:
+        host = np.asarray(jax.device_get(reduced[np.asarray(reps)]))
+        total = host.sum(axis=0).astype(host.dtype)
     stacked = np.broadcast_to(total, (comm.size,) + total.shape)
-    return jax.device_put(stacked, _rank_sharding(comm, x.ndim))
+    # make_array_from_callback works on single- AND multi-controller
+    # meshes (device_put with a global sharding does not on the latter)
+    return jax.make_array_from_callback(
+        stacked.shape, _rank_sharding(comm, x.ndim), lambda idx: stacked[idx]
+    )
+
+
+# monotone counters giving every staged exchange a distinct gather tag,
+# one per participating process set (SPMD program order holds within a
+# set, not across overlapping subset communicators)
+_staged_exchange_epochs: dict = {}
 
 
 def _hier_compile(comm: Communicator, key, ndim: int, donate: bool, kernel,

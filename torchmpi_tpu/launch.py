@@ -103,6 +103,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     procs: List[subprocess.Popen] = []
     logs = []
+    readers: List[threading.Thread] = []
     log_dir = Path(args.log_dir) if args.log_dir else None
     if log_dir is not None:
         log_dir.mkdir(parents=True, exist_ok=True)
@@ -133,9 +134,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 target + extra, env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True,
             )
-            threading.Thread(
+            reader = threading.Thread(
                 target=_stream, args=(proc, rank), daemon=True
-            ).start()
+            )
+            reader.start()
+            readers.append(reader)
         procs.append(proc)
 
     # one rank failing kills the rest (the reference needed manual pkill)
@@ -172,6 +175,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        # drain the stream readers before returning: daemon threads die
+        # with the interpreter, and the undrained tail of a failed rank's
+        # output is exactly the part that explains the failure
+        for reader in readers:
+            reader.join(timeout=5)
         for f in logs:
             f.close()
     return rc

@@ -51,6 +51,10 @@ _KIND_ACK = 3
 _KIND_SHARD = 4
 _KIND_ERROR = 5
 _KIND_BARRIER = 6
+# host-blob allgather frame: rule = tag, client = origin process,
+# payload = opaque bytes. Powers host-staged collectives (the DCN hop of
+# use_staged_collectives) without touching device links.
+_KIND_GATHER = 8
 # one frame carrying updates for SEVERAL shard ranks owned by the same
 # peer: payload = u32 count, then count x (u32 rank, u64 nbytes) headers,
 # then the concatenated slice bytes. One round trip (and one applied-ack)
@@ -260,6 +264,20 @@ class _Listener:
         # one; counting generations keeps that early arrival banked for
         # the next wait instead of silently discarding it.
         self._barrier_seen: Dict[str, Dict[int, int]] = {}
+        # BARRIER dedup: last applied barrier seq per origin. A channel
+        # replay of a barrier whose original delivery landed (ACK lost on
+        # the broken connection) must not increment the arrival counter a
+        # second time — barrier_wait banks surplus generations, so the
+        # double-count would let a LATER barrier with the same tag pass
+        # before that origin actually arrives. Seqs are channel-monotone
+        # (shared counter with UPDATEs), so (origin, seq) identifies the
+        # frame and a high-water mark per origin suffices.
+        self._barrier_applied: Dict[int, int] = {}
+        # host-blob allgather bookkeeping: tag -> origin -> payload QUEUE
+        # (generations, same banking rationale as the barrier counters)
+        # plus the replay-dedup high-water mark per origin.
+        self._gather_seen: Dict[str, Dict[int, "deque[bytes]"]] = {}
+        self._gather_applied: Dict[int, int] = {}
         self._barrier_cv = threading.Condition()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -293,6 +311,44 @@ class _Listener:
                     self._barrier_seen.pop(tag, None)
             return ok
 
+    def _fresh_seq(self, applied: Dict[int, int], client: int, seq: int) -> bool:
+        """Replay dedup for out-of-band frames (BARRIER/GATHER): True iff
+        ``seq`` advances ``client``'s high-water mark in ``applied``. Seqs
+        are channel-monotone (shared counter with UPDATEs), so a channel
+        replay of an already-delivered frame is recognised by its seq; a
+        re-banked arrival would satisfy a LATER wait with the same tag
+        spuriously. Takes the condition's lock itself."""
+        with self._barrier_cv:
+            if applied.get(client, 0) >= seq:
+                return False
+            applied[client] = seq
+            return True
+
+    def gather_arrived(self, tag: str, origin: int, payload: bytes) -> None:
+        with self._barrier_cv:
+            per = self._gather_seen.setdefault(tag, {})
+            per.setdefault(origin, deque()).append(payload)
+            self._barrier_cv.notify_all()
+
+    def gather_wait(self, tag: str, expect: set, timeout=None):
+        """Collect one payload per origin in ``expect`` (None on timeout)."""
+
+        def _ready() -> bool:
+            per = self._gather_seen.get(tag, {})
+            return all(per.get(o) for o in expect)
+
+        with self._barrier_cv:
+            if not self._barrier_cv.wait_for(_ready, timeout):
+                return None
+            per = self._gather_seen.get(tag, {})
+            out = {o: per[o].popleft() for o in expect}
+            for o in list(per):
+                if not per[o]:
+                    per.pop(o)
+            if not per:
+                self._gather_seen.pop(tag, None)
+            return out
+
     def _accept_loop(self):
         while not self._stop.is_set():
             try:
@@ -317,8 +373,21 @@ class _Listener:
                     _recv_frame(conn)
                 )
                 if kind == _KIND_BARRIER:
-                    # subset barrier: record (tag, origin) and ack receipt
-                    self.barrier_arrived(rule, client)
+                    # subset barrier: record (tag, origin) and ack receipt;
+                    # a replayed frame (seq already applied) is ACKed
+                    # without re-counting the arrival
+                    if not seq or self._fresh_seq(
+                        self._barrier_applied, client, seq
+                    ):
+                        self.barrier_arrived(rule, client)
+                    _send_frame(conn, _KIND_ACK)
+                    continue
+                if kind == _KIND_GATHER:
+                    # host-blob allgather contribution, same replay dedup
+                    if not seq or self._fresh_seq(
+                        self._gather_applied, client, seq
+                    ):
+                        self.gather_arrived(rule, client, payload)
                     _send_frame(conn, _KIND_ACK)
                     continue
                 inst = self._lookup(inst_id)
@@ -559,11 +628,25 @@ class _PeerChannel:
                 sock.settimeout(None)
                 wd = constants.get("deadlock_timeout_seconds") or 0
                 if wd > 0:
-                    sock.setsockopt(
-                        socket.SOL_SOCKET,
-                        socket.SO_SNDTIMEO,
-                        struct.pack("ll", int(wd), 0),
-                    )
+                    # struct timeval layout is platform-specific (Windows
+                    # wants a DWORD of milliseconds); a wrong-size value
+                    # can raise or set a garbage timeout, so degrade to
+                    # no send-timeout rather than break connect
+                    try:
+                        import sys as _sys
+
+                        if _sys.platform == "win32":
+                            sock.setsockopt(
+                                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                                struct.pack("@L", int(wd) * 1000),
+                            )
+                        else:
+                            sock.setsockopt(
+                                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                                struct.pack("@ll", int(wd), 0),
+                            )
+                    except OSError:
+                        pass
                 return sock
             except OSError as e:  # try localhost fallback (single-host test)
                 last_err = e
@@ -676,6 +759,31 @@ class _PeerChannel:
         drawn from the per-peer counter UNDER the channel lock together
         with the send — assignment order == wire order, so the server's
         dedup can never confuse concurrent sends with retries."""
+        return self.complete(
+            self.submit(
+                kind, inst, rank, client, use_seq=use_seq, fp=fp, rule=rule,
+                payload_arr=payload_arr, payload_raw=payload_raw,
+                dtype_str=dtype_str,
+            )
+        )
+
+    def submit(
+        self,
+        kind: int,
+        inst: int,
+        rank: int,
+        client: int,
+        use_seq: bool = False,
+        fp: int = 0,
+        rule: str = "",
+        payload_arr: Optional[np.ndarray] = None,
+        payload_raw: bytes = b"",
+        dtype_str: str = "",
+    ) -> _Waiter:
+        """Put one frame on the wire and return its waiter WITHOUT waiting
+        for the reply — fan-out callers (allgather_blob, barrier) submit to
+        every peer first, then :meth:`complete` each, so P-1 exchanges cost
+        ~1 round trip instead of P-1 serialized ones."""
         if payload_arr is not None:
             payload_raw = payload_arr.tobytes()
             dtype_str = payload_arr.dtype.str
@@ -703,6 +811,10 @@ class _PeerChannel:
                     sock.close()
                 except OSError:
                     pass
+        return w
+
+    def complete(self, w: _Waiter):
+        """Wait for a submitted frame's reply and decode it."""
         timeout = constants.get("deadlock_timeout_seconds") or None
         # The watchdog bounds CONNECTION silence, not this waiter's queue
         # position: a pipelined request may legitimately wait many
@@ -761,6 +873,13 @@ class _PeerPool:
     def request(self, proc: int, kind: int, inst: int, rank: int,
                 client: int, **kw):
         return self._channels[proc].request(kind, inst, rank, client, **kw)
+
+    def submit(self, proc: int, kind: int, inst: int, rank: int,
+               client: int, **kw):
+        return self._channels[proc].submit(kind, inst, rank, client, **kw)
+
+    def complete(self, proc: int, waiter):
+        return self._channels[proc].complete(waiter)
 
     def close(self):
         for ch in self._channels.values():
@@ -847,16 +966,53 @@ class Transport:
         servers living on sub-communicators."""
         procs = set(int(p) for p in procs)
         me = self.process_index
-        for p in sorted(procs - {me}):
-            self.pool.request(
-                p, _KIND_BARRIER, 0, 0, me, rule=tag
-            )
+        waiters = [
+            (p, self.pool.submit(p, _KIND_BARRIER, 0, 0, me,
+                                 use_seq=True, rule=tag))
+            for p in sorted(procs - {me})
+        ]
+        for p, w in waiters:
+            self.pool.complete(p, w)
         expect = procs - {me}
         if expect and not self.listener.barrier_wait(tag, expect, timeout):
             raise RuntimeError(
                 f"parameter-server barrier {tag!r} timed out waiting for "
                 f"{sorted(expect)}"
             )
+
+    def allgather_blob(
+        self, procs, tag: str, payload: bytes, timeout=None
+    ) -> Dict[int, bytes]:
+        """Host allgather of opaque bytes among the process subset
+        ``procs`` (all must call with the same tag): send the local
+        payload to every peer, collect one from each. The host-wire
+        exchange behind staged collectives — the analog of the
+        reference's staged-via-pinned-CPU MPI hop
+        (``lib/detail/collectives_cuda.cpp:390-683``), which moves
+        cross-node data over the host fabric precisely because no
+        inter-group device link is assumed."""
+        procs = set(int(p) for p in procs)
+        me = self.process_index
+        # fan-out: all frames on the wire first, THEN collect the acks —
+        # P-1 peers cost ~1 round trip, not P-1 serialized ones
+        waiters = [
+            (p, self.pool.submit(p, _KIND_GATHER, 0, 0, me,
+                                 use_seq=True, rule=tag, payload_raw=payload))
+            for p in sorted(procs - {me})
+        ]
+        for p, w in waiters:
+            self.pool.complete(p, w)
+        out = {me: payload}
+        expect = procs - {me}
+        if expect:
+            got = self.listener.gather_wait(tag, expect, timeout)
+            if got is None:
+                raise RuntimeError(
+                    f"host allgather {tag!r} timed out waiting for "
+                    f"{sorted(expect)}"
+                )
+            out.update(got)
+        return out
 
     def close(self):
         self.pool.close()
