@@ -563,7 +563,7 @@ def test_transport_channel_replay_applies_exactly_once():
         def one(i):
             try:
                 ch.request(
-                    T._KIND_UPDATE, 1, 0, i, use_seq=True, rule="add",
+                    T._KIND_UPDATE, 1, 0, i, rule="add",
                     payload_arr=np.full(2, float(i), np.float32),
                 )
             except Exception as e:  # noqa: BLE001
@@ -618,7 +618,7 @@ def test_transport_watchdog_measures_silence_not_queueing():
         def one(i):
             try:
                 ch.request(
-                    T._KIND_UPDATE, 1, 0, i, use_seq=True, rule="add",
+                    T._KIND_UPDATE, 1, 0, i, rule="add",
                     payload_arr=np.ones(2, np.float32),
                 )
             except Exception as e:  # noqa: BLE001
@@ -637,5 +637,105 @@ def test_transport_watchdog_measures_silence_not_queueing():
         assert not errors, errors
     finally:
         constants.set("deadlock_timeout_seconds", prev)
+        ch.close()
+        lst.close()
+
+
+def test_transport_slow_shard_does_not_block_other_shard():
+    """Server-side concurrency: one artificially slow shard apply must not
+    head-of-line-block another shard's traffic on the SAME connection —
+    replies are correlated by the echoed frame seq and applies run on a
+    worker pool, the per-instance independence of the reference's Iprobe
+    dispatch (parameterserver.cpp:404-541)."""
+    import threading
+    import time
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    order = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                if rank == 0:
+                    time.sleep(1.0)  # the slow shard
+                order.append(rank)
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        done = {}
+
+        def one(rank):
+            ch.request(
+                T._KIND_UPDATE, 1, rank, 7, rule="add",
+                payload_arr=np.ones(2, np.float32),
+            )
+            done[rank] = time.monotonic()
+
+        t0 = time.monotonic()
+        slow = threading.Thread(target=one, args=(0,))
+        slow.start()
+        time.sleep(0.05)  # the slow frame is on the wire first
+        fast = threading.Thread(target=one, args=(1,))
+        fast.start()
+        fast.join(30)
+        assert 1 in done, "fast shard never acked"
+        fast_latency = done[1] - t0
+        assert fast_latency < 0.8, (
+            f"fast shard waited {fast_latency:.2f}s behind the slow one"
+        )
+        slow.join(30)
+        assert 0 in done, "slow shard never acked"
+        assert order == [1, 0], order  # fast applied (and acked) first
+    finally:
+        ch.close()
+        lst.close()
+
+
+def test_transport_trigger_overtakes_slow_update_on_other_rank():
+    """A TRIGGER for one rank is answered while another rank's update is
+    still applying on the same connection (out-of-order replies)."""
+    import threading
+    import time
+
+    from concurrent.futures import Future
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                if msg.kind == "trigger":
+                    msg.reply.set_result(np.full(3, 9.0, np.float32))
+                    return
+                time.sleep(1.0)
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        t0 = time.monotonic()
+        upd = threading.Thread(
+            target=ch.request,
+            args=(T._KIND_UPDATE, 1, 0, 7),
+            kwargs=dict(rule="add", payload_arr=np.ones(2, np.float32)),
+        )
+        upd.start()
+        time.sleep(0.05)
+        shard = ch.request(T._KIND_TRIGGER, 1, 1, 7)
+        assert time.monotonic() - t0 < 0.8, "trigger blocked behind update"
+        np.testing.assert_array_equal(shard, np.full(3, 9.0, np.float32))
+        upd.join(30)
+    finally:
         ch.close()
         lst.close()

@@ -21,10 +21,12 @@ Design:
   Ssend happens-before guarantee, strengthened to applied — matching the
   in-process transport); a TRIGGER replies with the shard bytes;
 - clients keep one persistent connection per peer process, PIPELINED:
-  senders hold the channel lock only to put a frame on the wire, replies
-  demux FIFO (the listener answers a connection's frames in order, so
-  TCP order is the request id) — many shard updates ride one connection
-  concurrently instead of lock-stepping a round trip each;
+  senders hold the channel lock only to put a frame on the wire; every
+  frame carries a channel-monotone seq which the listener ECHOES on the
+  reply, and the demux matches replies by that seq — the listener
+  applies a connection's frames concurrently (worker pool) and may
+  reply out of order, so one slow shard apply does not head-of-line
+  block the others;
 - addresses are exchanged once via ``multihost_utils.process_allgather``
   (the runtime's coordination service), the analog of MPI's out-of-band
   bootstrap.
@@ -37,7 +39,7 @@ import socket
 import struct
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -70,9 +72,11 @@ _MULTI_RANK = 0xFFFFFFFF
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
 #        fp u32, token u32, rule_len u16, dtype_len u16, payload_len u64
 #
-# - seq: per-(transport, client) monotone sequence for UPDATEs; the
-#   listener dedups on (inst, rank, client, seq) so a reconnect retry
-#   after a lost ACK cannot double-apply a non-idempotent rule.
+# - seq: per-channel monotone sequence on EVERY frame; echoed on the
+#   reply (the client demux correlates by it — the server replies out
+#   of order), and for UPDATE/BARRIER/GATHER frames also the dedup key
+#   ((inst, rank, client, seq) / per-origin high-water) so a reconnect
+#   retry after a lost ACK cannot double-apply or double-count.
 # - fp: instance fingerprint (shape/dtype/size/owners); catches
 #   process-local instance-id desync loudly instead of applying updates
 #   to the wrong tensor.
@@ -363,8 +367,29 @@ class _Listener:
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket):
+        """Per-connection reader. Frames are READ and POSTED in wire order
+        on this thread (per-(inst, rank) apply order is mailbox order, so
+        a client's updates to one shard still apply in its program order),
+        but the applied-WAITS and replies run on a small worker pool:
+        replies are correlated by the echoed frame seq, not FIFO, so one
+        slow shard apply no longer head-of-line-blocks every later frame
+        on the connection — the per-instance independence of the
+        reference's Iprobe dispatch (``parameterserver.cpp:404-541``)."""
         import threading as _threading
-        from concurrent.futures import Future
+        from concurrent.futures import Future, ThreadPoolExecutor
+
+        send_lock = _threading.Lock()
+        pool = ThreadPoolExecutor(
+            max_workers=constants.get("parameterserver_thread_pool_size") * 2,
+            thread_name_prefix="tm-ps-apply",
+        )
+
+        def reply(kind: int, seq: int, **kw) -> None:
+            try:
+                with send_lock:
+                    _send_frame(conn, kind, seq=seq, **kw)
+            except (ConnectionError, OSError):
+                pass  # the reader sees the broken socket and exits
 
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -380,7 +405,7 @@ class _Listener:
                         self._barrier_applied, client, seq
                     ):
                         self.barrier_arrived(rule, client)
-                    _send_frame(conn, _KIND_ACK)
+                    reply(_KIND_ACK, seq)
                     continue
                 if kind == _KIND_GATHER:
                     # host-blob allgather contribution, same replay dedup
@@ -388,12 +413,12 @@ class _Listener:
                         self._gather_applied, client, seq
                     ):
                         self.gather_arrived(rule, client, payload)
-                    _send_frame(conn, _KIND_ACK)
+                    reply(_KIND_ACK, seq)
                     continue
                 inst = self._lookup(inst_id)
                 if inst is None:
-                    _send_frame(
-                        conn, _KIND_ERROR,
+                    reply(
+                        _KIND_ERROR, seq,
                         rule=f"unknown parameter-server instance {inst_id}",
                     )
                     continue
@@ -401,8 +426,8 @@ class _Listener:
                     # instance-id desync (processes created PSs in
                     # different orders): fail loudly, never apply to the
                     # wrong tensor
-                    _send_frame(
-                        conn, _KIND_ERROR,
+                    reply(
+                        _KIND_ERROR, seq,
                         rule=(
                             f"instance {inst_id} fingerprint mismatch "
                             "(parameter servers must be created in the "
@@ -429,7 +454,7 @@ class _Listener:
                         # re-post a non-idempotent rule.
                         if seq and self._applied.get(dkey, 0) >= seq:
                             # retry of an already-applied update: ack only
-                            _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
+                            reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
                             continue
                         if seq:
                             poisoned = self._failed.get(ikey)
@@ -443,23 +468,17 @@ class _Listener:
                         # retry of a partially-applied multi frame whose
                         # ERROR response was lost: re-report, never
                         # re-apply (items that succeeded would double)
-                        _send_frame(conn, _KIND_ERROR, rule=poisoned)
+                        reply(_KIND_ERROR, seq, rule=poisoned)
                         continue
                     if not owner:
                         # a reconnect retry racing the FIRST apply (its
                         # seq not yet recorded): wait for that apply and
                         # report ITS outcome — re-posting would apply a
                         # non-idempotent rule ('add') twice.
-                        pending.wait(timeout)
-                        with self._applied_lock:
-                            done = self._applied.get(dkey, 0) >= seq
-                        if done:
-                            _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
-                        else:
-                            _send_frame(
-                                conn, _KIND_ERROR,
-                                rule="original update apply did not complete",
-                            )
+                        pool.submit(
+                            self._await_other_apply, reply, dkey, seq,
+                            pending, inst_id, rank, timeout,
+                        )
                         continue
                     try:
                         dt = np.dtype(dtype)
@@ -467,9 +486,21 @@ class _Listener:
                             items = _parse_multi_payload(payload, dt)
                         else:
                             items = [(rank, np.frombuffer(payload, dt))]
-                        from .server import _CancelToken
+                    except Exception as e:  # noqa: BLE001 - bad wire payload
+                        if seq:
+                            with self._applied_lock:
+                                done_ev = self._inflight.pop(ikey, None)
+                            if done_ev is not None:
+                                done_ev.set()
+                        reply(_KIND_ERROR, seq, rule=f"bad update payload: {e}")
+                        continue
+                    from .server import _CancelToken
 
-                        posted = []
+                    # posting happens HERE, on the reader thread, so the
+                    # per-rank mailboxes see this connection's updates in
+                    # wire order; only the waits/replies are offloaded
+                    posted = []
+                    try:
                         for r, values in items:
                             ev = _threading.Event()
                             token = _CancelToken()
@@ -480,71 +511,137 @@ class _Listener:
                             )
                             inst.post(r, msg)
                             posted.append((ev, token, msg))
-                        failure: Optional[str] = None
-                        for ev, token, msg in posted:
-                            if not ev.wait(timeout):
-                                # atomically withdraw: if the server has
-                                # not STARTED applying, it never will
-                                # (serve_once CAS-checks the token) and
-                                # the failure report is exact; if it is
-                                # mid-apply, wait for it to finish and
-                                # report the true outcome instead of
-                                # lying.
-                                if token.cancel():
-                                    failure = "remote update apply timed out"
-                                    continue
-                                ev.wait()  # apply in progress: completes
-                            if msg.error is not None:
-                                failure = f"update apply failed: {msg.error}"
-                        if failure is not None:
-                            # A multi frame is acked/deduped as a UNIT.
-                            # The error is fatal client-side (the pool
-                            # never resends on _KIND_ERROR) — but the
-                            # ERROR response itself can be lost to a
-                            # connection drop, and the reconnect RESEND
-                            # must not re-apply the items that succeeded:
-                            # poison this (key, seq) so the retry is
-                            # answered from the record.
-                            if kind == _KIND_UPDATE_MULTI and seq:
-                                with self._applied_lock:
-                                    while len(self._failed) >= 64:
-                                        self._failed.pop(
-                                            next(iter(self._failed))
-                                        )
-                                    self._failed[ikey] = failure
-                            _send_frame(conn, _KIND_ERROR, rule=failure)
-                            continue
-                        with self._applied_lock:
-                            if seq:
-                                self._applied[dkey] = seq
-                        _send_frame(conn, _KIND_ACK, inst=inst_id, rank=rank)
-                    finally:
-                        if seq:
-                            with self._applied_lock:
-                                done_ev = self._inflight.pop(ikey, None)
-                            if done_ev is not None:
-                                done_ev.set()
+                    except Exception as e:  # noqa: BLE001 - e.g. bad rank
+                        # PARTIALLY-posted frame (an out-of-range rank
+                        # makes inst.post raise): withdraw what we can,
+                        # reply ERROR, and release the inflight slot the
+                        # old inline finally covered — leaking it would
+                        # hang the channel replay's not-owner wait forever
+                        pool.submit(
+                            self._abort_partial_post, reply, kind, ikey,
+                            seq, posted, f"update post failed: {e}",
+                        )
+                        continue
+                    pool.submit(
+                        self._finish_update, reply, kind, dkey, ikey, seq,
+                        inst_id, rank, posted, timeout,
+                    )
                 elif kind == _KIND_TRIGGER:
                     f: Future = Future()
                     inst.post(rank, _Message("trigger", client=client, reply=f))
-                    try:
-                        shard = f.result(timeout)
-                    except Exception as e:
-                        _send_frame(conn, _KIND_ERROR, rule=str(e))
-                        continue
-                    _send_frame(
-                        conn, _KIND_SHARD, inst=inst_id, rank=rank,
-                        dtype=shard.dtype.str, payload=shard.tobytes(),
+                    pool.submit(
+                        self._finish_trigger, reply, f, seq, inst_id, rank,
+                        timeout,
                     )
                 else:
-                    _send_frame(conn, _KIND_ERROR, rule=f"bad kind {kind}")
+                    reply(_KIND_ERROR, seq, rule=f"bad kind {kind}")
         except (ConnectionError, OSError):
             pass
         finally:
+            pool.shutdown(wait=False)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _abort_partial_post(
+        self, reply, kind, ikey, seq, posted, failure
+    ) -> None:
+        try:
+            applied_any = False
+            for ev, token, msg in posted:
+                if token.cancel():
+                    continue  # never started: exactly withdrawn
+                ev.wait()  # applying or applied: let it finish
+                applied_any = True
+            if kind == _KIND_UPDATE_MULTI and seq and applied_any:
+                # items that DID apply must never re-apply on a replay
+                # whose ERROR response was lost: poison the (key, seq)
+                with self._applied_lock:
+                    while len(self._failed) >= 64:
+                        self._failed.pop(next(iter(self._failed)))
+                    self._failed[ikey] = failure
+            reply(_KIND_ERROR, seq, rule=failure)
+        finally:
+            if seq:
+                with self._applied_lock:
+                    done_ev = self._inflight.pop(ikey, None)
+                if done_ev is not None:
+                    done_ev.set()
+
+    def _await_other_apply(
+        self, reply, dkey, seq, pending, inst_id, rank, timeout
+    ) -> None:
+        pending.wait(timeout)
+        with self._applied_lock:
+            done = self._applied.get(dkey, 0) >= seq
+        if done:
+            reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
+        else:
+            reply(
+                _KIND_ERROR, seq,
+                rule="original update apply did not complete",
+            )
+
+    def _finish_update(
+        self, reply, kind, dkey, ikey, seq, inst_id, rank, posted, timeout
+    ) -> None:
+        try:
+            failure: Optional[str] = None
+            for ev, token, msg in posted:
+                if not ev.wait(timeout):
+                    # atomically withdraw: if the server has not STARTED
+                    # applying, it never will (serve_once CAS-checks the
+                    # token) and the failure report is exact; if it is
+                    # mid-apply, wait for it to finish and report the true
+                    # outcome instead of lying.
+                    if token.cancel():
+                        failure = "remote update apply timed out"
+                        continue
+                    ev.wait()  # apply in progress: completes
+                if msg.error is not None:
+                    failure = f"update apply failed: {msg.error}"
+            if failure is not None:
+                # A multi frame is acked/deduped as a UNIT. The error is
+                # fatal client-side (the pool never resends on
+                # _KIND_ERROR) — but the ERROR response itself can be
+                # lost to a connection drop, and the reconnect RESEND
+                # must not re-apply the items that succeeded: poison this
+                # (key, seq) so the retry is answered from the record.
+                if kind == _KIND_UPDATE_MULTI and seq:
+                    with self._applied_lock:
+                        while len(self._failed) >= 64:
+                            self._failed.pop(next(iter(self._failed)))
+                        self._failed[ikey] = failure
+                reply(_KIND_ERROR, seq, rule=failure)
+                return
+            with self._applied_lock:
+                if seq:
+                    # max(): concurrent applies of two updates to the same
+                    # (inst, rank, client) finish on different pool
+                    # workers — a plain store could regress the
+                    # high-water mark
+                    self._applied[dkey] = max(
+                        self._applied.get(dkey, 0), seq
+                    )
+            reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
+        finally:
+            if seq:
+                with self._applied_lock:
+                    done_ev = self._inflight.pop(ikey, None)
+                if done_ev is not None:
+                    done_ev.set()
+
+    def _finish_trigger(self, reply, fut, seq, inst_id, rank, timeout) -> None:
+        try:
+            shard = fut.result(timeout)
+        except Exception as e:  # noqa: BLE001 - reported to the client
+            reply(_KIND_ERROR, seq, rule=str(e))
+            return
+        reply(
+            _KIND_SHARD, seq, inst=inst_id, rank=rank,
+            dtype=shard.dtype.str, payload=shard.tobytes(),
+        )
 
     def close(self):
         self._stop.set()
@@ -571,10 +668,10 @@ class _PeerChannel:
     """One persistent connection to a peer, PIPELINED: a sender holds the
     channel lock only while assigning its seq and putting the frame on
     the wire — never for the round trip — so many requests ride the
-    connection concurrently. A demux reader thread completes waiters
-    strictly FIFO, which is a valid correlation because the listener
-    serves each connection's frames in order and replies in order: TCP
-    order IS the request id (no wire-format change).
+    connection concurrently. A demux reader thread matches each reply to
+    its waiter by the ECHOED frame seq: the listener posts a
+    connection's frames in wire order but applies them concurrently, so
+    replies arrive out of order and the seq is the request id.
 
     Reconnects are CHANNEL-level, not caller-level: on a broken
     connection the channel reconnects once and replays every un-answered
@@ -591,7 +688,11 @@ class _PeerChannel:
         self.addresses = addresses
         self.proc = proc
         self.lock = threading.Lock()
-        self.pending: "deque[_Waiter]" = deque()
+        # seq -> waiter, in submission (== seq) order: replies are matched
+        # by the echoed seq (the server replies OUT of order now that
+        # applies run concurrently), while reconnect replay still walks
+        # the insertion order
+        self.pending: "OrderedDict[int, _Waiter]" = OrderedDict()
         self.sock: Optional[socket.socket] = None
         self.gen = 0  # connection generation (stale-reader guard)
         self.seq = 0
@@ -676,8 +777,9 @@ class _PeerChannel:
             except Exception as e:  # noqa: BLE001 - includes auth/magic
                 self._on_broken(gen, e)
                 return
+            rseq = frame[4]  # server echoes the request seq
             with self.lock:
-                w = self.pending.popleft() if self.pending else None
+                w = self.pending.pop(rseq, None)
                 self._unacked_replays = 0  # traffic flows: reset budget
                 self._last_reply = time.monotonic()
             if w is not None:
@@ -686,7 +788,7 @@ class _PeerChannel:
 
     def _fail_pending_locked(self, err: Exception) -> None:
         while self.pending:
-            w = self.pending.popleft()
+            _, w = self.pending.popitem(last=False)
             w.error = err
             w.event.set()
 
@@ -717,7 +819,7 @@ class _PeerChannel:
             self._unacked_replays += 1
             try:
                 sock = self._connected_locked()
-                for w in self.pending:
+                for w in self.pending.values():
                     sock.sendall(w.frame)
             except (ConnectionError, OSError) as e2:
                 if self.sock is not None:
@@ -748,20 +850,16 @@ class _PeerChannel:
         inst: int,
         rank: int,
         client: int,
-        use_seq: bool = False,
         fp: int = 0,
         rule: str = "",
         payload_arr: Optional[np.ndarray] = None,
         payload_raw: bytes = b"",
         dtype_str: str = "",
     ):
-        """Pipelined request/response. UPDATEs carry ``seq`` (``use_seq``),
-        drawn from the per-peer counter UNDER the channel lock together
-        with the send — assignment order == wire order, so the server's
-        dedup can never confuse concurrent sends with retries."""
+        """Pipelined request/response."""
         return self.complete(
             self.submit(
-                kind, inst, rank, client, use_seq=use_seq, fp=fp, rule=rule,
+                kind, inst, rank, client, fp=fp, rule=rule,
                 payload_arr=payload_arr, payload_raw=payload_raw,
                 dtype_str=dtype_str,
             )
@@ -773,7 +871,6 @@ class _PeerChannel:
         inst: int,
         rank: int,
         client: int,
-        use_seq: bool = False,
         fp: int = 0,
         rule: str = "",
         payload_arr: Optional[np.ndarray] = None,
@@ -783,17 +880,21 @@ class _PeerChannel:
         """Put one frame on the wire and return its waiter WITHOUT waiting
         for the reply — fan-out callers (allgather_blob, barrier) submit to
         every peer first, then :meth:`complete` each, so P-1 exchanges cost
-        ~1 round trip instead of P-1 serialized ones."""
+        ~1 round trip instead of P-1 serialized ones.
+
+        EVERY frame draws a seq from the per-peer counter UNDER the channel
+        lock together with the send — assignment order == wire order, so
+        the server's dedup can never confuse concurrent sends with
+        retries, and replies (now out-of-order: the server applies
+        concurrently) are correlated back by the echoed seq."""
         if payload_arr is not None:
             payload_raw = payload_arr.tobytes()
             dtype_str = payload_arr.dtype.str
         with self.lock:
             if self.closed:
                 raise ConnectionError("parameter-server transport closed")
-            seq = 0
-            if use_seq:
-                self.seq += 1
-                seq = self.seq
+            self.seq += 1
+            seq = self.seq
             w = _Waiter(
                 _frame_bytes(
                     kind, inst, rank, client, seq, fp, rule, dtype_str,
@@ -801,7 +902,7 @@ class _PeerChannel:
                 )
             )
             sock = self._connected_locked()  # raises if unreachable
-            self.pending.append(w)
+            self.pending[seq] = w
             try:
                 sock.sendall(w.frame)
             except OSError:
@@ -925,7 +1026,7 @@ class Transport:
     ) -> None:
         self.pool.request(
             proc, _KIND_UPDATE, inst, rank, client,
-            use_seq=True, fp=fp, rule=rule, payload_arr=payload,
+            fp=fp, rule=rule, payload_arr=payload,
         )
 
     def update_multi(
@@ -948,7 +1049,7 @@ class Transport:
         )
         self.pool.request(
             proc, _KIND_UPDATE_MULTI, inst, _MULTI_RANK, client,
-            use_seq=True, fp=fp, rule=rule,
+            fp=fp, rule=rule,
             payload_raw=payload, dtype_str=arrs[0].dtype.str,
         )
 
@@ -967,8 +1068,7 @@ class Transport:
         procs = set(int(p) for p in procs)
         me = self.process_index
         waiters = [
-            (p, self.pool.submit(p, _KIND_BARRIER, 0, 0, me,
-                                 use_seq=True, rule=tag))
+            (p, self.pool.submit(p, _KIND_BARRIER, 0, 0, me, rule=tag))
             for p in sorted(procs - {me})
         ]
         for p, w in waiters:
@@ -997,7 +1097,7 @@ class Transport:
         # P-1 peers cost ~1 round trip, not P-1 serialized ones
         waiters = [
             (p, self.pool.submit(p, _KIND_GATHER, 0, 0, me,
-                                 use_seq=True, rule=tag, payload_raw=payload))
+                                 rule=tag, payload_raw=payload))
             for p in sorted(procs - {me})
         ]
         for p, w in waiters:
