@@ -1,0 +1,243 @@
+"""Scale-breadth sweep: plan/schedule arithmetic and collectives above the
+8-device conftest mesh.
+
+The reference exercises ``mpirun -n {1..37}`` and oversubscribes one host to
+fake multi-node (``scripts/test_cpu.sh:14-33``, ``test_gpu.sh:45-51``); the
+conftest's 8-device mesh leaves plan arithmetic (binomial trees, 1F1B slots,
+ring plans) unexercised above 8. This file closes that: pure-arithmetic
+sweeps at p = 16/32/37 run in-process (no mesh needed), and device sweeps at
+p = 16/32 run in subprocesses with their own
+``xla_force_host_platform_device_count``.
+"""
+
+import math
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# pure plan/schedule arithmetic — no devices, any p
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "sizes",
+    [[5, 4, 4, 3], [16, 11, 7, 3], [1, 1, 35], [16] * 2, [37]],
+)
+def test_binomial_reduce_steps_wide_and_ragged(sizes):
+    """The static binomial schedule accumulates every member exactly once
+    into its group first, for ragged group mixes up to p=37."""
+    from torchmpi_tpu.collectives.eager import _binomial_reduce_steps
+
+    p = sum(sizes)
+    groups, nxt = [], 0
+    for s in sizes:
+        groups.append(list(range(nxt, nxt + s)))
+        nxt += s
+    steps = _binomial_reduce_steps(groups, p)
+    assert len(steps) == max(
+        (math.ceil(math.log2(s)) for s in sizes if s > 1), default=0
+    )
+    val = np.ones(p)
+    sent = np.zeros(p, bool)
+    for perm, mask in steps:
+        receivers = [dst for _, dst in perm]
+        assert len(set(receivers)) == len(receivers), "receiver collision"
+        for src, dst in perm:
+            assert not sent[src], "member sent twice"
+            sent[src] = True
+            val[dst] += val[src]
+        assert (mask == np.isin(np.arange(p), receivers)).all()
+    for g in groups:
+        assert val[g[0]] == len(g), (g, val[g[0]])
+
+
+@pytest.mark.parametrize("p,m", [(16, 16), (16, 19), (16, 48), (32, 32), (8, 37)])
+def test_1f1b_schedule_wide(p, m):
+    """1F1B slots at 16/32 stages: complete, dependency-ordered, in-flight
+    bounded by min(m, p - s) — the O(p) activation bound is the schedule's
+    whole point."""
+    from torchmpi_tpu.parallel.pp import _one_f_one_b_schedule
+
+    rows_f, rows_b, fwd_time, bwd_time = _one_f_one_b_schedule(p, m)
+    assert rows_f.shape == rows_b.shape
+    for s in range(p):
+        fs = [t for t in range(rows_f.shape[0]) if rows_f[t, s] >= 0]
+        assert [int(rows_f[t, s]) for t in fs] == list(range(m)), "fwd order"
+        bs = [t for t in range(rows_b.shape[0]) if rows_b[t, s] >= 0]
+        assert [int(rows_b[t, s]) for t in bs] == list(range(m)), "bwd order"
+    for (s, j), t in fwd_time.items():
+        if s > 0:
+            assert fwd_time[(s - 1, j)] < t, "fwd before upstream fwd"
+    for (s, j), t in bwd_time.items():
+        assert fwd_time[(s, j)] < t, "bwd before local fwd"
+        if s < p - 1:
+            assert bwd_time[(s + 1, j)] < t, "bwd before downstream bwd"
+    # in-flight bound at every tick
+    for s in range(p):
+        inflight = 0
+        done_f = done_b = 0
+        for t in range(rows_f.shape[0]):
+            if rows_f[t, s] >= 0:
+                done_f += 1
+            if rows_b[t, s] >= 0:
+                done_b += 1
+            inflight = done_f - done_b
+            assert inflight <= min(m, p - s), (s, t, inflight)
+
+
+def test_ring_plan_wide():
+    """The native ring plan at p=16/32/37: neighbor hand-offs line up and a
+    full data-flow simulation reduces then gathers every chunk."""
+    from torchmpi_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native runtime not built/available")
+    for p in (16, 32, 37):
+        plans = [native.ring_plan(r, p) for r in range(p)]
+        for r in range(p):
+            send, recv = plans[r]
+            assert len(send) == len(recv) == 2 * (p - 1)
+            assert set(send) <= set(range(p)) and set(recv) <= set(range(p))
+            # my send at step s is my right neighbor's recv at step s
+            nsend, nrecv = plans[(r + 1) % p]
+            assert (recv == plans[(r - 1) % p][0]).all()
+        # simulate: chunk values start at 1; RS phase accumulates, AG
+        # phase copies. End state: every chunk on every rank equals p.
+        val = np.ones((p, p))
+        for s in range(p - 1):  # reduce-scatter
+            incoming = [(r, plans[r][0][s], val[r, plans[r][0][s]]) for r in range(p)]
+            for r, c, v in incoming:
+                val[(r + 1) % p, c] += v
+        for r in range(p):
+            assert val[r, (r + 1) % p] == p
+        for s in range(p - 1, 2 * (p - 1)):  # allgather
+            incoming = [(r, plans[r][0][s], val[r, plans[r][0][s]]) for r in range(p)]
+            for r, c, v in incoming:
+                val[(r + 1) % p, c] = v
+        assert (val == p).all()
+
+
+# ---------------------------------------------------------------------------
+# device sweeps — subprocesses with their own virtual mesh size
+# ---------------------------------------------------------------------------
+
+_MESH_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    p = int(sys.argv[1])
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={{p}}"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torchmpi_tpu as mpi
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mpi.start()
+    assert mpi.size() == p
+    comm = mpi.current_communicator()
+    mpi.constants.set("small_allreduce_size_cpu", 1)
+
+    def stacked(c, fill=None):
+        m = c.flat_mesh("mpi")
+        return jax.device_put(
+            np.arange(c.size, dtype=np.float32)[:, None]
+            * np.ones((c.size, 300), np.float32),
+            NamedSharding(m, P("mpi")),
+        )
+
+    want = p * (p - 1) / 2
+    out = mpi.ring.allreduce_tensor(stacked(comm))
+    assert np.allclose(np.asarray(out), want), "flat ring"
+
+    # cartesian hierarchical: sqrt-ish split
+    intra = 4
+    mpi.push_communicator([r // intra for r in range(p)], name="hier")
+    hcomm = mpi.current_communicator()
+    assert hcomm.cartesian and hcomm.num_intra_groups == p // intra
+    hout = mpi.ring.allreduce_tensor(stacked(hcomm), comm=hcomm)
+    assert np.allclose(np.asarray(hout), want), "cartesian hier"
+    assert any(
+        k[0].startswith("hier") for k in hcomm._collective_resources
+    ), "hier path not taken"
+    mpi.set_communicator(0)
+
+    # ragged groups -> tree hierarchical (non-cartesian)
+    sizes = [p - 2 * (p // 3), p // 3, p // 3]
+    keys = [i for i, s in enumerate(sizes) for _ in range(s)]
+    mpi.push_communicator(keys, name="ragged")
+    rcomm = mpi.current_communicator()
+    assert not rcomm.cartesian and rcomm.num_intra_groups == 3
+    rout = mpi.ring.allreduce_tensor(stacked(rcomm), comm=rcomm)
+    assert np.allclose(np.asarray(rout), want), "ragged tree hier"
+    mpi.set_communicator(0)
+    mpi.stop()
+    print(f"mesh p={{p}} OK")
+    """
+).format(repo=str(_REPO))
+
+
+def _run_mesh_worker(tmp_path, p: int, timeout: int = 420) -> None:
+    worker = tmp_path / "worker.py"
+    worker.write_text(_MESH_WORKER)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, str(worker), str(p)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=timeout, env=env,
+    )
+    assert out.returncode == 0, out.stdout[-3000:]
+    assert f"mesh p={p} OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_p16_collectives(tmp_path):
+    """Flat ring, cartesian 4x4 hier, and ragged tree hier at p=16."""
+    _run_mesh_worker(tmp_path, 16)
+
+
+@pytest.mark.slow
+def test_p32_collectives(tmp_path):
+    """The same sweep at p=32 — 8x4 cartesian, 12/10/10 ragged."""
+    _run_mesh_worker(tmp_path, 32)
+
+
+@pytest.mark.slow
+def test_p16_dryrun_multichip(tmp_path):
+    """The driver's multi-chip validation at double the usual width: every
+    sharding config (dp/tp/sp/pp/3D/ep/fsdp/zero1/ps-x-dp) compiles and
+    steps on a 16-device mesh."""
+    worker = tmp_path / "dryrun16.py"
+    worker.write_text(textwrap.dedent(
+        f"""
+        import os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, {str(_REPO)!r})
+        import __graft_entry__ as ge
+        ge.dryrun_multichip(16)
+        print("dryrun16 OK")
+        """
+    ))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, str(worker)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=900, env=env,
+    )
+    assert out.returncode == 0, out.stdout[-3000:]
+    assert "dryrun16 OK" in out.stdout

@@ -206,7 +206,10 @@ def _one_f_one_b_schedule(p: int, m: int):
                 row_b[s] = jb
                 bwd_time[(s, jb)] = t
                 bwd_next[s] += 1
-            elif can_fwd:
+            elif can_fwd and jf - jb < max_inflight[s]:
+                # at capacity with no backward ready the stage IDLES (a
+                # bubble): forwarding anyway would grow live activations
+                # to O(m) and forfeit exactly the bound 1F1B exists for
                 row_f[s] = jf
                 fwd_time[(s, jf)] = t
                 fwd_next[s] += 1
