@@ -357,18 +357,288 @@ def _ring_attention_bwd_xla(q, k, v, o, lse, do, axis, causal, p):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def ring_attention(q, k, v, axis, causal=False, axis_size=None, interpret=False):
-    """Differentiable ring attention: RDMA-kernel forward, analytic
-    flash-attention ring backward from the saved (o, lse) residuals — no
-    forward recompute on the gradient path."""
+def _ring_attn_bwd_kernel(
+    p: int,
+    axis: str,
+    causal: bool,
+    scale: float,
+    n: int,
+    my_ref,
+    q_ref,
+    o_ref,
+    do_ref,
+    lse_ref,
+    k_ref,
+    v_ref,
+    dq_ref,
+    dk_ref,
+    dv_ref,
+    kbuf,
+    vbuf,
+    dkbuf,
+    dvbuf,
+    dqacc,
+    dacc,
+    send_k,
+    recv_k,
+    send_v,
+    recv_v,
+    send_dk,
+    recv_dk,
+    send_dv,
+    recv_dv,
+    cap_sem,
+):
+    """Backward ring program: the K/V blocks make a SECOND trip around the
+    ring, this time carrying their dK/dV accumulators with them (the
+    fused-transport philosophy of ``collectives_cuda.cpp:202-388``): each
+    rank computes the analytic flash gradients against the visiting block
+    from the saved (o, lse) residuals — no forward recompute — adds its
+    contribution to the riding accumulators, THEN forwards the 4-tensor
+    payload. p sends total, so the last hop is the homecoming: every
+    block's finished dK/dV lands back on its owner.
+
+    Transport discipline differs from the forward in one way: the forward
+    pushes its (immutable) block while computing on it; here the payload
+    is MUTATED by the compute, so the send follows the compute and the
+    overlap is between this step's compute and the NEXT block's in-flight
+    arrival. Capacity semaphores close the same fast-sender race: a send
+    into the right neighbor's slot waits for that slot's consumed-signal.
+    """
+    my = my_ref[0]
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my + p - 1, p)
+    bh = q_ref.shape[0]
+
+    kbuf[0] = k_ref[:]
+    vbuf[0] = v_ref[:]
+    dkbuf[0] = jnp.zeros_like(dkbuf[0])
+    dvbuf[0] = jnp.zeros_like(dvbuf[0])
+    dqacc[:] = jnp.zeros_like(dqacc)
+
+    def dinit(i, _):
+        # D = rowsum(dO ∘ O): the softmax-jacobian correction, f32
+        dacc[i] = jnp.sum(
+            do_ref[i].astype(jnp.float32) * o_ref[i].astype(jnp.float32),
+            axis=1,
+            keepdims=True,
+        )
+        return 0
+
+    lax.fori_loop(0, bh, dinit, 0)
+
+    barrier = pltpu.get_barrier_semaphore()
+    for nbr in (left, right):
+        pltpu.semaphore_signal(
+            barrier,
+            inc=1,
+            device_id={axis: nbr},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+    pltpu.semaphore_wait(barrier, 2)
+
+    def block_grad(s: int, slot: int):
+        """Analytic flash gradients of the visiting block, accumulated
+        into dqacc (stays) and dkbuf/dvbuf[slot] (rides onward)."""
+        src = lax.rem(my - s + p, p)
+
+        def cell(i, _):
+            qi = q_ref[i].astype(jnp.float32)  # [n, d]
+            doi = do_ref[i].astype(jnp.float32)
+            ki = kbuf[slot, i].astype(jnp.float32)
+            vi = vbuf[slot, i].astype(jnp.float32)
+            sij = (
+                lax.dot_general(
+                    qi, ki, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [n(q), n(k)]
+            if causal:
+                qpos = lax.broadcasted_iota(jnp.int32, (n, n), 0) + my * n
+                kpos = lax.broadcasted_iota(jnp.int32, (n, n), 1) + src * n
+                sij = jnp.where(qpos >= kpos, sij, NEG_INF)
+            pij = jnp.exp(sij - lse_ref[i])  # true probs ([n,1] lse bcasts)
+            dvbuf[slot, i] += lax.dot_general(
+                pij, doi, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [n(k), d]
+            dp = lax.dot_general(
+                doi, vi, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [n(q), n(k)]
+            ds = pij * (dp - dacc[i])
+            dqacc[i] += (
+                lax.dot_general(
+                    ds, ki, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            dkbuf[slot, i] += (
+                lax.dot_general(
+                    ds, qi, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            return 0
+
+        lax.fori_loop(0, bh, cell, 0)
+
+    for s in range(p):
+        slot = s % 2
+        nslot = 1 - slot
+        block_grad(s, slot)
+        # forward the mutated payload; the right neighbor's slot must be
+        # consumed (its step s-1 compute done AND its own send of that
+        # slot landed — it signals after its c.wait())
+        if s >= 1:
+            pltpu.semaphore_wait(cap_sem.at[nslot], 1)
+        copies = tuple(
+            pltpu.make_async_remote_copy(
+                src_ref=buf.at[slot],
+                dst_ref=buf.at[nslot],
+                send_sem=ssem.at[slot],
+                recv_sem=rsem.at[slot],
+                device_id={axis: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            for buf, ssem, rsem in (
+                (kbuf, send_k, recv_k),
+                (vbuf, send_v, recv_v),
+                (dkbuf, send_dk, recv_dk),
+                (dvbuf, send_dv, recv_dv),
+            )
+        )
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()  # our payload landed + next block fully arrived
+        if s < p - 1:
+            # my slot is consumed and my outgoing read of it is complete:
+            # left may overwrite it at its step s+1. No signal after the
+            # last step so every semaphore ends the kernel drained.
+            pltpu.semaphore_signal(
+                cap_sem.at[slot],
+                inc=1,
+                device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+    home = p % 2  # p sends: each block's accumulators are back home
+
+    def fin(i, _):
+        dq_ref[i] = dqacc[i].astype(dq_ref.dtype)
+        dk_ref[i] = dkbuf[home, i].astype(dk_ref.dtype)
+        dv_ref[i] = dvbuf[home, i].astype(dv_ref.dtype)
+        return 0
+
+    lax.fori_loop(0, bh, fin, 0)
+
+
+def ring_attention_bwd_vmem_bytes(local_shape, dtype) -> int:
+    """Backward working-set estimate: q/o/do/k/v inputs + dq/dk/dv outputs
+    + 2x2 K/V slots in ``dtype``, 2x2 dK/dV slots + dq accumulator in f32,
+    plus the [.., n, 1] lse/D columns."""
+    b, n, h, d = local_shape
+    cells = b * h * n * d
+    itemsize = jnp.dtype(dtype).itemsize
+    return cells * (12 * itemsize + 20) + 2 * 4 * b * h * n
+
+
+def ring_attention_bwd_pallas(
+    q, k, v, o, lse, do,
+    axis: str = "sp",
+    causal: bool = False,
+    axis_size: Optional[int] = None,
+    interpret: bool = False,
+):
+    """Analytic flash-attention backward on the RDMA ring (the transport
+    symmetry the XLA-ppermute backward leaves on the table). ``lse`` is
+    the forward's ``[b, h, n]`` residual. Returns (dq, dk, dv)."""
+    p = axis_size or lax.axis_size(axis)
+    b, n, h, d = q.shape
+    assert p > 1, "p == 1 has no ring; callers differentiate locally"
+    bytes_needed = ring_attention_bwd_vmem_bytes(q.shape, q.dtype)
+    if bytes_needed > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"ring-attention backward working set {bytes_needed} B exceeds "
+            f"the VMEM envelope {_VMEM_BUDGET_BYTES} B; shard further or "
+            "use the XLA ppermute backward"
+        )
+    bh = b * h
+    to_cells = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, n, d)  # noqa: E731
+    scale = 1.0 / math.sqrt(d)
+    my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
+    kernel = functools.partial(
+        _ring_attn_bwd_kernel, p, axis, causal, scale, n
+    )
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), v.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, bh, n, d), k.dtype),
+            pltpu.VMEM((2, bh, n, d), v.dtype),
+            pltpu.VMEM((2, bh, n, d), jnp.float32),
+            pltpu.VMEM((2, bh, n, d), jnp.float32),
+            pltpu.VMEM((bh, n, d), jnp.float32),
+            pltpu.VMEM((bh, n, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=12),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(
+        my, to_cells(q), to_cells(o), to_cells(do),
+        lse.reshape(bh, n, 1), to_cells(k), to_cells(v),
+    )
+    back = lambda t: t.reshape(b, h, n, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return back(dq), back(dk), back(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_attention(
+    q, k, v, axis, causal=False, axis_size=None, interpret=False,
+    bwd_kernel=False,
+):
+    """Differentiable ring attention: RDMA-kernel forward, with the
+    backward either the analytic XLA ppermute ring (default) or the RDMA
+    backward kernel (``bwd_kernel=True`` — both directions on the custom
+    transport). Either way the saved (o, lse) residuals mean no forward
+    recompute on the gradient path."""
     return ring_attention_pallas(
         q, k, v, axis=axis, causal=causal, axis_size=axis_size,
         interpret=interpret,
     )
 
 
-def _ra_fwd(q, k, v, axis, causal, axis_size, interpret):
+def _ra_fwd(q, k, v, axis, causal, axis_size, interpret, bwd_kernel):
     out, lse = ring_attention_pallas(
         q, k, v, axis=axis, causal=causal, axis_size=axis_size,
         interpret=interpret, return_lse=True,
@@ -376,7 +646,7 @@ def _ra_fwd(q, k, v, axis, causal, axis_size, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _ra_bwd(axis, causal, axis_size, interpret, res, g):
+def _ra_bwd(axis, causal, axis_size, interpret, bwd_kernel, res, g):
     q, k, v, o, lse = res
     p = axis_size or lax.axis_size(axis)
     if p == 1:
@@ -388,6 +658,11 @@ def _ra_bwd(axis, causal, axis_size, interpret, res, g):
             q, k, v,
         )
         return vjp(g)
+    if bwd_kernel:
+        return ring_attention_bwd_pallas(
+            q, k, v, o, lse, g, axis=axis, causal=causal,
+            axis_size=axis_size, interpret=interpret,
+        )
     return _ring_attention_bwd_xla(q, k, v, o, lse, g, axis, causal, p)
 
 

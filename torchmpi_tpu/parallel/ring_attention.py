@@ -67,10 +67,13 @@ def ring_self_attention(
     identical (up to float error) to full attention over the gathered
     sequence.
 
-    ``backend``: ``'xla'`` (ppermute ring), ``'pallas'`` (RDMA kernel,
-    differentiable via its custom VJP), ``'pallas_interpret'`` (kernel in
-    interpret mode — CPU-mesh validation), or ``'auto'`` (kernel on real
-    multi-chip TPU when it fits VMEM, else the XLA ring).
+    ``backend``: ``'xla'`` (ppermute ring), ``'pallas'`` (RDMA kernel
+    forward, analytic XLA-ring backward via its custom VJP),
+    ``'pallas_full'`` (RDMA kernels BOTH directions — the backward rides
+    the same double-buffered ring, carrying dK/dV home with their
+    blocks), ``'pallas_interpret'`` / ``'pallas_interpret_full'`` (the
+    same in interpret mode — CPU-mesh validation), or ``'auto'`` (kernel
+    on real multi-chip TPU when it fits VMEM, else the XLA ring).
 
     Causal masking accounts for the global positions: the k/v block visiting
     at ring step s originated on rank ``(r - s) mod p``, so its global
@@ -83,10 +86,14 @@ def ring_self_attention(
             ring_attention_vmem_bytes,
         )
 
-        if backend in ("pallas", "pallas_interpret"):
+        if backend in (
+            "pallas", "pallas_interpret", "pallas_full",
+            "pallas_interpret_full",
+        ):
             return ring_attention(
                 q, k, v, axis, causal, axis_size,
-                backend == "pallas_interpret",
+                backend.startswith("pallas_interpret"),
+                backend.endswith("_full"),
             )
         if backend == "auto":
             from ..ops.ring_kernels import available
