@@ -61,6 +61,7 @@ def _sequential_baseline(model, params, xtr, ytr, batch, epochs, lr, seed):
 
 
 @pytest.mark.parametrize("mode", ["sync", "async"])
+@pytest.mark.slow
 def test_engine_matches_sequential(mode):
     """Distributed AllReduceSGD must track the sequential baseline loss
     step-for-step (averaged grads over rank-shards == full-batch grad)."""
@@ -137,6 +138,7 @@ def test_engine_hooks_fire_in_order():
     assert calls[i : i + 4] == ["on_sample", "on_forward", "on_backward", "on_update"]
 
 
+@pytest.mark.slow
 def test_engine_async_mlp_convergence():
     """test/async.lua analog: async (bucketed) training on the 6-layer MLP
     reaches the same loss region as sync."""
@@ -244,6 +246,7 @@ def test_engine_public_step():
     assert l2 < l1  # same batch twice: loss must drop
 
 
+@pytest.mark.slow
 def test_engine_fsdp_matches_replicated():
     """ZeRO-3 mode: sharded params/opt-state must follow the replicated
     trajectory exactly (same global-batch means), with leaves actually
@@ -287,6 +290,7 @@ def test_engine_fsdp_matches_replicated():
     ), "fsdp shard holds the full leaf"
 
 
+@pytest.mark.slow
 def test_engine_zero1_matches_replicated():
     """ZeRO-1: sharded optimizer state, replicated params — must follow
     the replicated trajectory exactly, with opt-state leaves actually
@@ -361,6 +365,7 @@ def test_engine_accum_steps_matches_unaccumulated(sharding):
         np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_engine_accum_steps_validation():
     (xtr, ytr), _ = synthetic_mnist(num_train=64, num_test=1)
     model = MLP6()
@@ -399,6 +404,7 @@ def test_engine_fsdp_step_and_eval():
     assert acc > 0.6  # short run after 2 junk warm-up steps
 
 
+@pytest.mark.slow
 def test_engine_fsdp_checkpoint_roundtrip(tmp_path):
     """Save/restore must preserve the fsdp SHARDED placement (densifying
     to replicated would silently drop ZeRO-3) and resume identically."""

@@ -19,6 +19,7 @@ def test_mnist_sequential_example():
     assert acc > 0.8
 
 
+@pytest.mark.slow
 def test_blocksequential_2host_example():
     """BASELINE.json config #5 at test scale: block-partitioned async
     gradient allreduce over a 2-host hierarchical communicator converges
@@ -66,6 +67,7 @@ def test_resnet50_dp_e2e_example():
     assert 0.0 <= acc <= 1.0
 
 
+@pytest.mark.slow
 def test_resnet_example_fsdp_accum():
     """The example's --fsdp / --accum-steps flags drive the ZeRO-3 +
     gradient-accumulation engine path end-to-end (ResNet-18 at test
@@ -92,6 +94,7 @@ def test_resnet_example_fsdp_accum():
     assert 0.0 <= acc <= 1.0
 
 
+@pytest.mark.slow
 def test_pipeline_stages_example_both_schedules():
     """Pipeline-parallel training example: GPipe and 1F1B schedules follow
     the IDENTICAL trajectory (same gradients by construction) and

@@ -73,6 +73,7 @@ def test_transformer_flops_model():
     assert train_flops(f1) == 3 * f1
 
 
+@pytest.mark.slow
 def test_lm_trains_through_engine():
     """The LM loss fn drives the engine's device-resident loop: loss drops
     well below uniform-random (ln vocab) because the stream is order-1
@@ -98,6 +99,7 @@ def test_lm_trains_through_engine():
     assert state["losses"][-1] < state["losses"][0]
 
 
+@pytest.mark.slow
 def test_lm_loss_fn_matches_manual_cross_entropy():
     vocab, seq = 16, 8
     model = LongContextTransformer(
@@ -122,6 +124,7 @@ def test_lm_loss_fn_matches_manual_cross_entropy():
     np.testing.assert_allclose(float(loss), manual, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_lm_remat_identical_loss_and_grads():
     """Per-layer remat must not change the math: loss AND gradients match
     the non-remat model exactly (same params, same batch)."""
@@ -151,6 +154,7 @@ def test_lm_remat_identical_loss_and_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_engine_remat_same_trajectory():
     """engine remat=True follows the exact k-step trajectory of
     remat=False (jax.checkpoint recomputes, never changes values)."""

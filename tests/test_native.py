@@ -172,6 +172,7 @@ def test_native_barrier_threads():
     b.destroy()
 
 
+@pytest.mark.slow
 def test_native_barrier_cross_process(tmp_path):
     """The barrier's ONLY reason to exist is cross-process sync: two real
     subprocesses increment a shared mmap counter before each barrier and

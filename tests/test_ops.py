@@ -22,6 +22,15 @@ from torchmpi_tpu.ops.reduce_kernel import accumulate, scale_accumulate
 from torchmpi_tpu.ops.ring_kernels import available, ring_allreduce_pallas
 
 
+# Device-count sweep for the interpret-mode kernel tests: p=2 (minimum
+# ring) and p=3 (odd/ragged schedules) stay in the fast bucket; the wider
+# p=4/8 sweeps are `slow` so `-m "not slow"` iterates quickly
+# (the reference's quick-vs-full test tiers, scripts/test_cpu.sh).
+P_SWEEP = [2, 3,
+           pytest.param(4, marks=pytest.mark.slow),
+           pytest.param(8, marks=pytest.mark.slow)]
+
+
 def test_accumulate_matches_add():
     rng = np.random.RandomState(0)
     a = jnp.asarray(rng.randn(317, 53).astype(np.float32))  # ragged shape
@@ -50,7 +59,7 @@ def test_accumulate_large_multiblock():
     np.testing.assert_array_equal(np.asarray(out), 3.0)
 
 
-@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("p", P_SWEEP)
 @pytest.mark.parametrize("n", [1024, 1000, 8 * 128 * 8 + 3])
 def test_pallas_ring_allreduce_interpret(p, n):
     """The RDMA ring allreduce (interpret mode) must equal the sum across
@@ -141,6 +150,7 @@ def test_pallas_ring_2d_mesh():
     )
 
 
+@pytest.mark.slow
 def test_pallas_ring_vmem_segmentation():
     if len(jax.devices()) < 4:
         pytest.skip("needs 4 devices")
@@ -212,7 +222,7 @@ def test_pallas_ring_dtype_preserving(dtype):
         )
 
 
-@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("p", P_SWEEP)
 @pytest.mark.parametrize("root", [0, 1])
 @pytest.mark.parametrize("k", [None, 4])
 def test_pallas_ring_broadcast_interpret(p, root, k):
@@ -240,7 +250,7 @@ def test_pallas_ring_broadcast_interpret(p, root, k):
     np.testing.assert_array_equal(out, np.tile(x[root], (p, 1)))
 
 
-@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("p", P_SWEEP)
 def test_pallas_reduce_scatter_interpret(p):
     """psum_scatter semantics: device r gets the sum of every device's
     segment r."""
@@ -305,6 +315,7 @@ def test_pallas_reduce_scatter_rejects_indivisible():
         )(np.zeros((p, 7), np.float32))
 
 
+@pytest.mark.slow
 def test_pallas_broadcast_vmem_segmentation_and_bitcast():
     """Broadcasts beyond the VMEM budget run as sequential segments; non-
     native dtypes ride losslessly as a byte view (here: int64)."""
@@ -342,6 +353,7 @@ def test_pallas_broadcast_vmem_segmentation_and_bitcast():
         rk._VMEM_BUDGET_BYTES = old
 
 
+@pytest.mark.slow
 def test_pallas_reduce_scatter_vmem_segmentation():
     from torchmpi_tpu.ops import ring_kernels as rk
 
@@ -397,7 +409,7 @@ def test_pallas_broadcast_bool_rides_as_uint8():
     np.testing.assert_array_equal(out, np.tile(x[1], (p, 1)))
 
 
-@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("p", P_SWEEP)
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32, jnp.bfloat16])
 def test_pallas_allgather_interpret(p, dtype):
     """Pallas ring allgather: every device gets [p, ...] stacked in rank
@@ -578,7 +590,7 @@ def test_eager_pallas_dtype_fallback():
         mpi.stop()
 
 
-@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("p", P_SWEEP)
 @pytest.mark.parametrize("root", [0, 1])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32, jnp.bfloat16])
 def test_pallas_ring_reduce_interpret(p, root, dtype):
@@ -622,6 +634,7 @@ def test_pallas_ring_reduce_interpret(p, root, dtype):
         )
 
 
+@pytest.mark.slow
 def test_pallas_ring_step_counts():
     """The dedicated allgather schedule is (p-1) steps — NOT the 2(p-1) of
     the round-2 zero-padded allreduce reuse; allreduce/reduce stay 2(p-1)
@@ -690,7 +703,7 @@ def test_eager_pallas_reduce_dispatch():
         mpi.stop()
 
 
-@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("p", P_SWEEP)
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32])
 def test_pallas_bidir_allreduce_interpret(p, dtype):
     """Bidirectional ring allreduce: two half-buffers reduced in opposite
@@ -775,7 +788,7 @@ def _ra_mesh(p):
     return Mesh(np.array(jax.devices()[:p]), ("sp",))
 
 
-@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("p", P_SWEEP)
 @pytest.mark.parametrize("causal", [False, True])
 def test_pallas_ring_attention_interpret(p, causal):
     """The RDMA ring-attention kernel (interpret mode) == full attention
@@ -878,6 +891,7 @@ def test_pallas_ring_attention_grad_matches_xla():
         )
 
 
+@pytest.mark.slow
 def test_pallas_ring_attention_vmem_envelope():
     """Working sets beyond the VMEM budget are rejected loudly (callers
     use backend='auto' for silent fallback to the XLA ring)."""
@@ -906,6 +920,7 @@ def test_pallas_ring_attention_vmem_envelope():
         )
 
 
+@pytest.mark.slow
 def test_long_context_transformer_pallas_backend():
     """The model's sp_backend switch routes attention through the kernel:
     forward logits match the XLA-ring backend."""
@@ -982,3 +997,70 @@ def test_pallas_ring_attention_grad_singleton_axis():
     np.testing.assert_allclose(float(l1), float(l0), atol=1e-6)
     for a, b_ in zip(g0, g1):
         np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=2e-5)
+
+
+@pytest.mark.parametrize("p", P_SWEEP)
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_ring_attention_bwd_kernel_matches_xla(p, causal):
+    """The RDMA backward kernel ('pallas_*_full' backends): dq/dk/dv match
+    the analytic XLA ppermute backward bit-for-purpose — the dK/dV
+    accumulators ride the ring home with their blocks (the fused-transport
+    symmetry of collectives_cuda.cpp:202-388)."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from torchmpi_tpu.parallel.ring_attention import ring_self_attention
+
+    b, n, h, d = 2, 4 * p, 2, 8
+    rs = np.random.RandomState(7 + p)
+    q = rs.randn(b, n, h, d).astype(np.float32)
+    k = rs.randn(b, n, h, d).astype(np.float32)
+    v = rs.randn(b, n, h, d).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:p]), ("sp",))
+
+    def grads(backend):
+        def loss(q, k, v):
+            out = ring_self_attention(
+                q, k, v, axis="sp", causal=causal, backend=backend
+            )
+            return (out * out).sum()
+
+        f = jax.jit(jax.grad(
+            partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P(None, "sp"),) * 3, out_specs=P(),
+                check_vma=False,
+            )(lambda q, k, v: jax.lax.psum(loss(q, k, v), "sp")),
+            argnums=(0, 1, 2),
+        ))
+        return f(q, k, v)
+
+    ref = grads("xla")
+    got = grads("pallas_interpret_full")
+    for r, g, name in zip(ref, got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch (p={p}, causal={causal})",
+        )
+
+
+def test_pallas_ring_attention_bwd_vmem_envelope():
+    """The backward's bigger working set (4 extra f32 ring slots) is
+    gated: an oversized shard raises with the fallback suggestion."""
+    from torchmpi_tpu.ops.ring_attention_kernel import (
+        _VMEM_BUDGET_BYTES,
+        ring_attention_bwd_vmem_bytes,
+    )
+
+    small = ring_attention_bwd_vmem_bytes((1, 128, 2, 64), jnp.float32)
+    assert small < _VMEM_BUDGET_BYTES
+    big = ring_attention_bwd_vmem_bytes((8, 4096, 16, 128), jnp.float32)
+    assert big > _VMEM_BUDGET_BYTES
+    # the backward set strictly dominates the forward's (it carries the
+    # f32 dK/dV slots on top of the K/V ring)
+    from torchmpi_tpu.ops.ring_attention_kernel import ring_attention_vmem_bytes
+
+    assert ring_attention_bwd_vmem_bytes(
+        (2, 256, 4, 64), jnp.bfloat16
+    ) > ring_attention_vmem_bytes((2, 256, 4, 64), jnp.bfloat16)

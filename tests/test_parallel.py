@@ -209,6 +209,7 @@ def test_ring_attention_bf16():
     )
 
 
+@pytest.mark.slow
 def test_long_context_transformer_sp_matches_single():
     _need(8)
     """The sp-sharded transformer forward == unsharded forward."""
@@ -244,6 +245,7 @@ def test_long_context_transformer_sp_matches_single():
     )
 
 
+@pytest.mark.slow
 def test_resnet50_forward_and_shapes():
     import flax
 
@@ -260,6 +262,7 @@ def test_resnet50_forward_and_shapes():
     assert 22e6 < n_params < 26e6, n_params
 
 
+@pytest.mark.slow
 def test_resnet18_train_step_with_engine():
     """ResNet DP training through the engine with batch_stats sync
     (BASELINE.json config #4 at test scale)."""
@@ -837,6 +840,7 @@ def test_pipeline_1f1b_stash_bounded():
     assert sizes[0] == sizes[-1], sizes
 
 
+@pytest.mark.slow
 def test_sp_transformer_remat_matches():
     """Per-layer remat composed with ring-attention sequence parallelism:
     recomputing ppermute rings during backward must not change loss or
