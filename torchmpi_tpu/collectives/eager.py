@@ -697,35 +697,39 @@ def _run_staged_hierarchical_allreduce(x, comm: Communicator):
             intra_kernel, mesh=mesh, in_specs=spec, out_specs=spec,
             check_vma=False,
         )
-        perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
-        # pin the output to the rank-stacked sharding: the multi-controller
-        # fetch below maps shard -> rank from shard.index, which is only
-        # partition-exact (no replicated rows double-counted) when row r
-        # lives exactly on comm._devices[r]
+        perm_j = jnp.asarray(perm)
+        # the output stays in GROUP-MAJOR order, pinned to the SAME
+        # (inter, intra) mesh the shard_map runs on (a rank-order out
+        # sharding would use a different device order and jit rejects
+        # mixed orders). Row k is rank perm[k]'s group sum, one row per
+        # device — so the rep extraction below is partition-exact and
+        # position k maps to a rank through perm.
         intra_fn = jax.jit(
-            lambda a: jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0),
-            out_shardings=_rank_sharding(comm, x.ndim),
+            lambda a: shmapped(jnp.take(a, perm_j, axis=0)),
+            out_shardings=NamedSharding(mesh, spec),
         )
-        reps = np.asarray([g[0] for g in comm._groups], np.int32)
-        entry = (intra_fn, reps)
+        # reps (group firsts) sit at the head of each group-major block
+        isz = len(comm._groups[0])
+        rep_pos = np.arange(len(comm._groups), dtype=np.int32) * isz
+        entry = (intra_fn, rep_pos)
         cache[key] = entry
-    intra_fn, reps = entry
-    reduced = intra_fn(x)  # every rank holds its group's sum
+    intra_fn, rep_pos = entry
+    reduced = intra_fn(x)  # group-major; every row = its group's sum
     # host-staged inter reduction (the DCN hop)
     procs = sorted({d.process_index for d in comm._devices})
     if len(procs) > 1:
         # Multi-controller: jax.device_get of the full representative set
         # would raise — most rep rows are non-addressable here. Instead
-        # each process sums the rep rows it OWNS (partition-exact thanks to
-        # the pinned rank sharding) and the partials meet over the PS
+        # each process sums the rep rows it OWNS (partition-exact: one
+        # group-major row per device) and the partials meet over the PS
         # socket transport: host wires, no inter-group device link — the
         # point of the staged path (collectives_cuda.cpp:390-683).
-        rep_set = {int(r) for r in reps}
+        rep_set = {int(k) for k in rep_pos}
         rows = {}
         for shard in reduced.addressable_shards:
-            r = shard.index[0].start or 0
-            if r in rep_set and r not in rows:
-                rows[r] = np.asarray(shard.data)[0]
+            k = shard.index[0].start or 0
+            if k in rep_set and k not in rows:
+                rows[k] = np.asarray(shard.data)[0]
         dt = np.dtype(reduced.dtype)
         per_row = tuple(x.shape[1:])
         partial = np.zeros(per_row, dt)
@@ -765,7 +769,7 @@ def _run_staged_hierarchical_allreduce(x, comm: Communicator):
             total = total + np.frombuffer(blob, dt).reshape(per_row)
         total = total.astype(dt, copy=False)
     else:
-        host = np.asarray(jax.device_get(reduced[np.asarray(reps)]))
+        host = np.asarray(jax.device_get(reduced[np.asarray(rep_pos)]))
         total = host.sum(axis=0).astype(host.dtype)
     stacked = np.broadcast_to(total, (comm.size,) + total.shape)
     # make_array_from_callback works on single- AND multi-controller
