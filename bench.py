@@ -114,6 +114,13 @@ def _run_worker(model: str, timeout_s: float):
     """Run one measurement in a child process; return (json_dict|None, err)."""
     cmd = [sys.executable, str(HERE / "bench.py"), "--worker", model]
     try:
+        # tell the worker the budget it ACTUALLY runs under (deadline
+        # pressure can shrink it below WORKER_TIMEOUT_S) so its optional
+        # diagnostics gate on the real number
+        env = dict(
+            os.environ,
+            TORCHMPI_TPU_BENCH_WORKER_BUDGET=str(int(max(60.0, timeout_s))),
+        )
         proc = subprocess.run(
             cmd,
             stdout=subprocess.PIPE,
@@ -121,13 +128,30 @@ def _run_worker(model: str, timeout_s: float):
             timeout=max(60.0, timeout_s),
             cwd=str(HERE),
             text=True,
+            env=env,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the worker prints its capture line BEFORE optional diagnostics,
+        # so a timeout mid-diagnostic must not discard a real measurement
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        line = _last_metric_line(out or "")
+        if line is not None:
+            return line, None
         return None, f"worker timeout after {int(timeout_s)}s"
     except Exception as e:  # noqa: BLE001 - launcher must never crash
         return None, f"worker spawn failed: {e!r}"
+    line = _last_metric_line(proc.stdout or "")
+    if line is not None:
+        return line, None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, f"worker rc={proc.returncode}: " + " | ".join(tail)[-500:]
+
+
+def _last_metric_line(stdout: str):
     line = None
-    for raw in (proc.stdout or "").splitlines():
+    for raw in stdout.splitlines():
         raw = raw.strip()
         if raw.startswith("{"):
             try:
@@ -136,10 +160,7 @@ def _run_worker(model: str, timeout_s: float):
                 continue
             if isinstance(obj, dict) and "metric" in obj:
                 line = obj
-    if line is not None:
-        return line, None
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
-    return None, f"worker rc={proc.returncode}: " + " | ".join(tail)[-500:]
+    return line
 
 
 def _load_last_good() -> dict:
@@ -360,6 +381,7 @@ def _flops_fields(value, flops_per_sample, device):
 
 
 def _worker_mnist():
+    worker_t0 = time.monotonic()
     devices, platform = _worker_setup()
 
     import jax.numpy as jnp
@@ -420,6 +442,70 @@ def _worker_mnist():
     line.update(
         _flops_fields(value, train_flops(lenet_forward_flops()), devices[0])
     )
+    # the capture is safe on stdout BEFORE the optional diagnostics below
+    # (the launcher parses the LAST metric line, and salvages this one if
+    # a diagnostic blows the worker timeout)
+    print(json.dumps(line), flush=True)
+
+    # Optional diagnostics, gated on the budget the worker ACTUALLY runs
+    # under (the launcher passes it: deadline pressure can shrink it
+    # below WORKER_TIMEOUT_S). A wedged backend mid-diagnostic is cut by
+    # the worker's hard timeout with the capture line above salvaged.
+    budget = float(
+        os.environ.get("TORCHMPI_TPU_BENCH_WORKER_BUDGET", WORKER_TIMEOUT_S)
+    )
+
+    # async-launch overhead: median time for run_async to RETURN the
+    # handle on a device-resident buffer — the reference asserts < 50µs
+    # on its real stack (test/collectives_all.lua:192-199); here it is
+    # measured on hardware and reported rather than asserted (the
+    # launcher must still get its capture if dispatch is slow).
+    try:
+        if time.monotonic() - worker_t0 > 0.7 * budget:
+            raise TimeoutError("budget nearly spent; skip diagnostics")
+        import jax
+        import numpy as _np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        buf = jax.device_put(
+            jnp.ones((p, 1 << 14), jnp.float32),
+            NamedSharding(comm.flat_mesh("mpi"), P("mpi")),
+        )
+        for _ in range(3):  # warm the executable cache
+            mpi.wait(mpi.async_.allreduce_tensor(buf))
+        lat = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            h = mpi.async_.allreduce_tensor(buf)
+            lat.append(time.perf_counter() - t0)
+            mpi.wait(h)
+        launch_us = float(_np.median(lat) * 1e6)
+        line["launch_overhead_us"] = round(launch_us, 1)
+        line["launch_overhead_ok"] = bool(launch_us < 50.0)
+    except Exception:  # noqa: BLE001 - diagnostics never block the capture
+        pass
+
+    # overlap evidence: the same resident training in engine async mode
+    # (bucketed overlapped allreduces) vs the sync rate above — the
+    # wall-time comparison the reference ran in test/async.lua:63-148.
+    # STRICTLY time-bounded: the main capture line above must never be
+    # forfeited to this diagnostic (the worker runs under a hard
+    # timeout), so it only runs when most of the budget remains.
+    try:
+        if time.monotonic() - worker_t0 < 0.4 * budget:
+            async_engine = AllReduceSGDEngine(
+                make_loss_fn(model), params, optimizer=optax.sgd(0.05),
+                mode="async",
+            )
+            astate = async_engine.train_resident(
+                xtr, ytr, per_rank, max_epochs=1 + 2,
+                image_dtype=jnp.bfloat16, seed=1,
+            )
+            async_rate = _steady_rate(astate, 2, p)
+            line["async_vs_sync"] = round(async_rate / value, 3)
+    except Exception:  # noqa: BLE001
+        pass
+
     print(json.dumps(line), flush=True)
     mpi.stop()
 

@@ -739,3 +739,65 @@ def test_transport_trigger_overtakes_slow_update_on_other_rank():
     finally:
         ch.close()
         lst.close()
+
+
+def test_transport_barrier_replay_does_not_double_count():
+    """A channel-level replay of a BARRIER frame (same seq — the ACK was
+    lost, the frame was resent) must not bank a second arrival
+    generation: the surplus would let a LATER barrier with the same tag
+    pass before that origin actually arrives."""
+    import socket
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    lst = T._Listener(lambda i: None)
+    try:
+        s = socket.create_connection(("localhost", lst.port), timeout=10)
+        s.settimeout(10)
+        kw = dict(client=3, seq=5, rule="tag-a")
+        T._send_frame(s, T._KIND_BARRIER, **kw)
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        T._send_frame(s, T._KIND_BARRIER, **kw)  # replay, same seq
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        # exactly ONE generation banked: the first wait passes instantly,
+        # the second (same tag, same origin) must time out
+        assert lst.barrier_wait("tag-a", {3}, timeout=5)
+        assert not lst.barrier_wait("tag-a", {3}, timeout=0.3)
+        # a FRESH barrier frame (new seq) banks a new generation
+        T._send_frame(s, T._KIND_BARRIER, client=3, seq=6, rule="tag-a")
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        assert lst.barrier_wait("tag-a", {3}, timeout=5)
+        s.close()
+    finally:
+        lst.close()
+
+
+def test_transport_gather_replay_deduped_and_generations_banked():
+    """GATHER frames: replay dedup (same seq re-delivered once) plus the
+    generation banking — two distinct sends queue two payloads, consumed
+    one per wait, in order."""
+    import socket
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    lst = T._Listener(lambda i: None)
+    try:
+        s = socket.create_connection(("localhost", lst.port), timeout=10)
+        s.settimeout(10)
+        T._send_frame(s, T._KIND_GATHER, client=1, seq=2, rule="g",
+                      payload=b"first")
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        T._send_frame(s, T._KIND_GATHER, client=1, seq=2, rule="g",
+                      payload=b"first")  # replay
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        T._send_frame(s, T._KIND_GATHER, client=1, seq=3, rule="g",
+                      payload=b"second")
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        got = lst.gather_wait("g", {1}, timeout=5)
+        assert got == {1: b"first"}, got
+        got = lst.gather_wait("g", {1}, timeout=5)
+        assert got == {1: b"second"}, got
+        assert lst.gather_wait("g", {1}, timeout=0.3) is None
+        s.close()
+    finally:
+        lst.close()
