@@ -599,3 +599,74 @@ def test_multiprocess_staged_hierarchical_allreduce(tmp_path, nproc):
     staged path exists for (collectives_cuda.cpp:390-683). Guards the
     round-4 regression where jax.device_get touched non-addressable rows."""
     _run_workers(tmp_path, _STAGED_WORKER, "staged proc {pid} OK", nproc=nproc)
+
+
+@pytest.mark.slow
+def test_launcher_elastic_restart_resumes_from_checkpoint(tmp_path):
+    """--max-restarts: a rank dying mid-job kills the survivors and
+    relaunches the WHOLE world (fresh coordinator), and the restarted
+    scripts resume from their persisted state instead of cold-starting —
+    elastic recovery the reference never had (a dead rank meant manual
+    pkill, dependencies/README.md:46-49)."""
+    worker = tmp_path / "elastic.py"
+    state = tmp_path / "state"
+    worker.write_text(textwrap.dedent(
+        f"""
+        import os, sys
+        sys.path.insert(0, {str(_REPO)!r})
+        import numpy as np
+        import torchmpi_tpu as mpi
+
+        restart = int(os.environ["TORCHMPI_TPU_RESTART_COUNT"])
+        rank = int(os.environ["TORCHMPI_TPU_PROCESS_ID"])
+        state = {str(state)!r} + f"_{{rank}}.npy"
+        mpi.start()
+        # "checkpoint": persist progress each step; resume where we left
+        step = int(np.load(state)) if os.path.exists(state) else 0
+        for s in range(step, 4):
+            np.save(state, np.int64(s + 1))
+            if s == 1 and restart == 0 and rank == 1:
+                os.abort()  # mid-training crash on the first attempt
+        assert restart == 1, "should be running the restarted world"
+        assert int(np.load(state)) == 4
+        out = mpi.allreduce_scalar(1.0)
+        assert out == mpi.size()
+        print(f"elastic rank {{rank}} resumed OK", flush=True)
+        mpi.barrier()
+        mpi.stop()
+        """
+    ))
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "1", "--max-restarts", "1",
+            str(worker),
+        ],
+        cwd=str(_REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "restarting the world" in proc.stdout
+    assert "elastic rank 0 resumed OK" in proc.stdout
+    assert "elastic rank 1 resumed OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_launcher_max_restarts_budget_exhausted(tmp_path):
+    """A rank that keeps dying exhausts the restart budget and the
+    launcher exits with the failure code (no infinite loop)."""
+    worker = tmp_path / "dies.py"
+    worker.write_text("import sys; sys.exit(7)\n")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "1", "--max-restarts", "2",
+            str(worker),
+        ],
+        cwd=str(_REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 7, (proc.returncode, proc.stdout[-800:])
+    assert proc.stdout.count("restarting the world") == 2

@@ -66,6 +66,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--coordinator", default=None,
                     help="host:port of process 0 (required when nnodes > 1; "
                     "default: localhost:<free port>)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="elastic full-job restarts: when a rank dies, kill "
+                    "the survivors and relaunch ALL ranks up to this many "
+                    "times (scripts see TORCHMPI_TPU_RESTART_COUNT and "
+                    "should resume from their last checkpoint). Single-node "
+                    "jobs only.")
     ap.add_argument("-m", "--module", default=None,
                     help="run a module (python -m) instead of a script")
     ap.add_argument("script", nargs="?", default=None,
@@ -87,10 +93,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("--coordinator host:port is required when nnodes > 1")
     if not 0 <= args.node_rank < args.nnodes:
         ap.error(f"--node-rank {args.node_rank} outside [0, {args.nnodes})")
+    if args.max_restarts < 0:
+        ap.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
+    if args.max_restarts and args.nnodes > 1:
+        # a restart needs a fresh coordinator port and a synchronized
+        # world relaunch; across hosts that coordination does not exist
+        ap.error("--max-restarts requires a single-node job (nnodes == 1)")
 
-    coordinator = args.coordinator or f"localhost:{_free_port()}"
-    world = args.nnodes * args.nproc
-    base = args.node_rank * args.nproc
     target = (
         [sys.executable, "-m", args.module]
         if args.module
@@ -101,6 +110,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     if extra and extra[0] == "--":
         extra = extra[1:]
 
+    # Elastic recovery = full-job restart from the last checkpoint: the
+    # practical TPU model (a controller process cannot rejoin a running
+    # jax.distributed job; the reference had no recovery at all — a dead
+    # rank meant manual pkill, dependencies/README.md:46-49). Each
+    # attempt gets a FRESH auto-chosen coordinator port (the old
+    # service's socket may linger); scripts read
+    # TORCHMPI_TPU_RESTART_COUNT to resume instead of cold-start.
+    for restart in range(args.max_restarts + 1):
+        rc = _run_world(args, target, extra, restart)
+        if rc == 0 or rc == 130 or restart == args.max_restarts:
+            return rc  # success, operator interrupt, or budget spent
+        print(
+            f"[launch] attempt {restart} failed with rc={rc}; "
+            f"restarting the world "
+            f"({args.max_restarts - restart} restart(s) left)",
+            file=sys.stderr,
+        )
+    return rc
+
+
+def _run_world(args, target, extra, restart: int) -> int:
+    """Spawn the full world once and wait for it (one elastic attempt)."""
+    # restart attempts ignore an explicit --coordinator port: the failed
+    # attempt's service socket can linger, and the fresh-port choice is
+    # what the relaunch depends on (single-node only, so auto-choice is
+    # always valid here)
+    coordinator = (
+        args.coordinator if restart == 0 and args.coordinator else None
+    ) or f"localhost:{_free_port()}"
+    world = args.nnodes * args.nproc
+    base = args.node_rank * args.nproc
     procs: List[subprocess.Popen] = []
     logs = []
     readers: List[threading.Thread] = []
@@ -114,6 +154,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             TORCHMPI_TPU_COORDINATOR=coordinator,
             TORCHMPI_TPU_NUM_PROCESSES=str(world),
             TORCHMPI_TPU_PROCESS_ID=str(rank),
+            TORCHMPI_TPU_RESTART_COUNT=str(restart),
         )
         if args.cpu_devices:
             env["XLA_FLAGS"] = (
@@ -123,7 +164,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             env["TORCHMPI_TPU_FORCE_CPU"] = "1"
             env["JAX_PLATFORMS"] = "cpu"
         if log_dir is not None:
-            out = open(log_dir / f"rank_{rank}.log", "w")
+            # restart attempts keep distinct logs: the failed attempt's
+            # tail is the evidence worth reading
+            name = (
+                f"rank_{rank}.log" if restart == 0
+                else f"rank_{rank}.restart{restart}.log"
+            )
+            out = open(log_dir / name, "w")
             logs.append(out)
             proc = subprocess.Popen(
                 target + extra, env=env, stdout=out,
