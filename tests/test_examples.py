@@ -110,3 +110,44 @@ def test_pipeline_stages_example_both_schedules():
     l_gpipe = main(common + ["--schedule", "gpipe"])
     assert l_1f1b[-1] < l_1f1b[0]
     np.testing.assert_allclose(l_1f1b, l_gpipe, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_elastic_training_example(tmp_path):
+    """The elastic demo end-to-end under the real launcher: rank 1 aborts
+    mid-training on attempt 0, the relaunched world resumes from the
+    checkpoint, and the final loss equals the uninterrupted run's."""
+    import subprocess
+
+    repo = Path(__file__).resolve().parent.parent
+    # uninterrupted oracle (single process, fresh checkpoint dir)
+    oracle = subprocess.run(
+        [
+            sys.executable, "examples/elastic_training.py",
+            "--cpu-mesh", "2", "--ckpt", str(tmp_path / "oracle"),
+        ],
+        cwd=str(repo), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=300,
+    )
+    assert oracle.returncode == 0, oracle.stdout[-2000:]
+    want = [l for l in oracle.stdout.splitlines() if l.startswith("final:")]
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "1", "--max-restarts", "1",
+            "examples/elastic_training.py", "--",
+            "--crash-at-epoch", "2", "--ckpt", str(tmp_path / "ck"),
+        ],
+        cwd=str(repo), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=400,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "injected crash" in proc.stdout
+    assert "resumed from checkpoint at epoch 2" in proc.stdout
+    got = [
+        l.split("] ", 1)[-1]
+        for l in proc.stdout.splitlines()
+        if "final:" in l
+    ]
+    assert got and want and got[0] == want[0], (got, want)
