@@ -77,17 +77,18 @@ def main():
 
     start_epoch = 0
     ckdir = Path(args.ckpt)
-    if (ckdir / "meta.json").exists() or any(ckdir.glob("*")):
-        try:
-            meta = checkpoint.restore_engine(ckdir, engine)
-            start_epoch = int(meta.get("step", 0))
-            print(
-                f"[attempt {restart}] resumed from checkpoint at epoch "
-                f"{start_epoch}",
-                flush=True,
-            )
-        except Exception as e:  # noqa: BLE001 - cold-start on a bad ckpt
-            print(f"[attempt {restart}] no usable checkpoint ({e})", flush=True)
+    if ckdir.exists() and any(ckdir.iterdir()):
+        # no fallback: in a multi-process job a one-sided restore failure
+        # would leave ranks on DIFFERENT epochs and hang the next
+        # collective — fail the attempt loudly and let --max-restarts
+        # retry the whole world instead
+        meta = checkpoint.restore_engine(ckdir, engine)
+        start_epoch = int(meta.get("step", 0))
+        print(
+            f"[attempt {restart}] resumed from checkpoint at epoch "
+            f"{start_epoch}",
+            flush=True,
+        )
 
     losses = []
     for epoch in range(start_epoch, args.epochs):
@@ -110,7 +111,10 @@ def main():
             print("[attempt 0] injected crash", flush=True)
             os.abort()
 
-    print(f"final: epoch={args.epochs} loss={losses[-1]:.4f}", flush=True)
+    if losses:
+        print(f"final: epoch={args.epochs} loss={losses[-1]:.4f}", flush=True)
+    else:  # resumed past the last epoch: nothing left to train
+        print(f"final: epoch={args.epochs} already complete", flush=True)
     mpi.barrier()
     mpi.stop()
 

@@ -893,8 +893,9 @@ def test_pallas_ring_attention_grad_matches_xla():
 
 @pytest.mark.slow
 def test_pallas_ring_attention_vmem_envelope():
-    """Working sets beyond the VMEM budget are rejected loudly (callers
-    use backend='auto' for silent fallback to the XLA ring)."""
+    """Working sets beyond the VMEM budget AUTO-CHUNK over batch/heads
+    (each chunk rides its own ring); only a single oversized (batch,
+    head) cell is rejected loudly."""
     from torchmpi_tpu.ops import ring_attention_pallas
     from torchmpi_tpu.ops.ring_attention_kernel import (
         ring_attention_vmem_bytes,
@@ -902,11 +903,12 @@ def test_pallas_ring_attention_vmem_envelope():
 
     if len(jax.devices()) < 2:
         pytest.skip("needs 2 devices")
-    big = (8, 2048, 8, 64)  # ~billions of bytes with slots + accumulators
+    big = (8, 2048, 8, 64)  # over budget in aggregate, cells fit
     assert ring_attention_vmem_bytes(big, jnp.bfloat16) > 10 * 1024 * 1024
     q = jnp.zeros(big, jnp.bfloat16)
-    with pytest.raises(ValueError, match="VMEM envelope"):
-        jax.eval_shape(
+
+    def shaped(q):
+        return jax.eval_shape(
             lambda q: jax.shard_map(
                 lambda q: ring_attention_pallas(
                     q, q, q, "sp", axis_size=2, interpret=INTERPRET
@@ -917,6 +919,66 @@ def test_pallas_ring_attention_vmem_envelope():
                 check_vma=False,
             )(q),
             q,
+        )
+
+    assert shaped(q).shape == big  # chunked, not rejected
+    huge_cell = jnp.zeros((1, 65536, 1, 256), jnp.bfloat16)
+    with pytest.raises(ValueError, match="VMEM envelope"):
+        shaped(huge_cell)
+
+
+@pytest.mark.parametrize("p", [2, 3])
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_ring_attention_chunked_matches_unchunked(p, causal):
+    """A tiny forced budget splits the call into per-(batch, head) ring
+    trips; outputs and grads must match the unchunked kernel exactly."""
+    from functools import partial
+
+    from jax.sharding import Mesh
+
+    from torchmpi_tpu.ops import ring_attention_kernel as rak
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    b, n, h, d = 2, 4 * p, 4, 8
+    rs = np.random.RandomState(11 + p)
+    q = rs.randn(b, n, h, d).astype(np.float32)
+    k = rs.randn(b, n, h, d).astype(np.float32)
+    v = rs.randn(b, n, h, d).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:p]), ("sp",))
+
+    def fwd(budget):
+        f = lambda q, k, v: rak.ring_attention_pallas(  # noqa: E731
+            q, k, v, axis="sp", causal=causal, interpret=True,
+            vmem_budget_bytes=budget,
+        )
+        return jax.jit(partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False,
+        )(f))(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(fwd(30_000)), np.asarray(fwd(None)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    def grads(budget):
+        def loss(q, k, v):
+            out = rak.ring_attention(
+                q, k, v, "sp", causal, None, True, True,
+                vmem_budget_bytes=budget,
+            )
+            return (out * out).sum()
+
+        return jax.jit(jax.grad(partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(), check_vma=False,
+        )(lambda q, k, v: jax.lax.psum(loss(q, k, v), "sp")),
+            argnums=(0, 1, 2)))(q, k, v)
+
+    for a, g in zip(grads(None), grads(60_000)):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(a), rtol=1e-4, atol=1e-5
         )
 
 

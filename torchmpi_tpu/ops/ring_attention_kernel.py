@@ -202,6 +202,64 @@ def _ring_attn_kernel(
     lax.fori_loop(0, bh, finalize, 0)
 
 
+def _sequence_after(x, dep):
+    """Give ``x`` a data dependency on ``dep`` so XLA cannot overlap two
+    ring kernels that share a ``collective_id`` (and thus barrier/DMA
+    semaphore state) — chunked sub-calls must run strictly one after
+    another."""
+    return lax.optimization_barrier((x, dep))[0]
+
+
+def _chunk_plan(b, h, fits) -> Optional[tuple]:
+    """(b_chunk, h_chunk) making ``fits(b_chunk, h_chunk)`` true, halving
+    heads first (keeps batches coherent), or None when even a single
+    (batch, head) cell is too large."""
+    hh = h
+    while hh > 1 and not fits(b, hh):
+        hh = (hh + 1) // 2
+    bb = b
+    while bb > 1 and not fits(bb, hh):
+        bb = (bb + 1) // 2
+    return (bb, hh) if fits(bb, hh) else None
+
+
+def _run_chunked(b, h, fits, sub, concat_axes, cell_bytes, budget, what):
+    """Shared dispatch for VMEM auto-chunking (forward AND backward use
+    it — the plan heuristic, sequencing scheme, and error text must never
+    diverge between them). ``sub(bi, bb, hi, hh, prev)`` runs one chunk
+    (applying its own slicing and the ``prev`` sequencing dependency) and
+    returns a tuple of outputs; chunks are concatenated along
+    ``concat_axes`` over heads, then axis 0 over batches."""
+    plan = _chunk_plan(b, h, fits)
+    if plan is None:
+        raise ValueError(
+            f"one {what} (batch, head) cell of {cell_bytes} B exceeds "
+            f"the VMEM envelope {budget} B; shard the sequence further "
+            "or use the XLA ppermute backend"
+        )
+    bb, hh = plan
+    prev = None
+    out_rows: Optional[list] = None
+    for bi in range(0, b, bb):
+        row: Optional[list] = None
+        for hi in range(0, h, hh):
+            outs = sub(bi, bb, hi, hh, prev)
+            prev = outs[0]
+            if row is None:
+                row = [[] for _ in outs]
+            for acc, t in zip(row, outs):
+                acc.append(t)
+        merged = [
+            jnp.concatenate(acc, axis=ax)
+            for acc, ax in zip(row, concat_axes)
+        ]
+        if out_rows is None:
+            out_rows = [[] for _ in merged]
+        for acc, t in zip(out_rows, merged):
+            acc.append(t)
+    return tuple(jnp.concatenate(acc, axis=0) for acc in out_rows)
+
+
 def ring_attention_pallas(
     q,
     k,
@@ -211,14 +269,20 @@ def ring_attention_pallas(
     axis_size: Optional[int] = None,
     interpret: bool = False,
     return_lse: bool = False,
+    vmem_budget_bytes: Optional[int] = None,
 ):
     """Forward ring attention via the RDMA kernel. Call inside
     ``shard_map``; q/k/v are the local shards ``[b, n_local, h, d]``.
     Not differentiable — training uses :func:`ring_attention` (custom
     VJP). ``return_lse=True`` additionally returns the global
     log-sum-exp ``[b, h, n_local]`` f32 (the backward's residual).
-    Raises when the working set exceeds the VMEM envelope; callers
-    wanting automatic fallback use ``ring_self_attention(backend='auto')``.
+
+    A working set over the VMEM envelope is AUTO-CHUNKED over batch and
+    heads (attention is independent across both): each chunk runs its own
+    full K/V ring, so total wire traffic is unchanged — every head's K/V
+    still crosses each link exactly once per step — while per-call VMEM
+    fits. Only a single (batch, head) cell too large for the envelope
+    raises; sequence length then needs more sp shards or the XLA backend.
     """
     p = axis_size or lax.axis_size(axis)
     b, n, h, d = q.shape
@@ -229,13 +293,31 @@ def ring_attention_pallas(
         from ..parallel.ring_attention import full_self_attention
 
         return full_self_attention(q, k, v, causal=causal)
-    bytes_needed = ring_attention_vmem_bytes(q.shape, q.dtype)
-    if bytes_needed > _VMEM_BUDGET_BYTES:
-        raise ValueError(
-            f"ring-attention working set {bytes_needed} B exceeds the VMEM "
-            f"envelope {_VMEM_BUDGET_BYTES} B; shard the batch/heads "
-            "further or use the XLA ppermute backend"
+    budget = vmem_budget_bytes or _VMEM_BUDGET_BYTES
+    if ring_attention_vmem_bytes(q.shape, q.dtype) > budget:
+        def sub(bi, bb, hi, hh, prev):
+            qs = q[bi:bi + bb, :, hi:hi + hh]
+            if prev is not None:
+                qs = _sequence_after(qs, prev)
+            return ring_attention_pallas(
+                qs,
+                k[bi:bi + bb, :, hi:hi + hh],
+                v[bi:bi + bb, :, hi:hi + hh],
+                axis=axis, causal=causal, axis_size=axis_size,
+                interpret=interpret, return_lse=True,
+                vmem_budget_bytes=budget,
+            )
+
+        out, lse = _run_chunked(
+            b, h,
+            lambda bb, hh: ring_attention_vmem_bytes(
+                (bb, n, hh, d), q.dtype
+            ) <= budget,
+            sub, (2, 1),
+            ring_attention_vmem_bytes((1, n, 1, d), q.dtype), budget,
+            "ring-attention",
         )
+        return (out, lse) if return_lse else out
     bh = b * h
     # [b, n, h, d] -> [bh, n, d]: per-cell 2D math on the MXU
     to_cells = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, n, d)  # noqa: E731
@@ -553,19 +635,41 @@ def ring_attention_bwd_pallas(
     causal: bool = False,
     axis_size: Optional[int] = None,
     interpret: bool = False,
+    vmem_budget_bytes: Optional[int] = None,
 ):
     """Analytic flash-attention backward on the RDMA ring (the transport
     symmetry the XLA-ppermute backward leaves on the table). ``lse`` is
-    the forward's ``[b, h, n]`` residual. Returns (dq, dk, dv)."""
+    the forward's ``[b, h, n]`` residual. Returns (dq, dk, dv).
+    Auto-chunks over batch/heads like the forward (each chunk rides its
+    own ring; wire bytes unchanged)."""
     p = axis_size or lax.axis_size(axis)
     b, n, h, d = q.shape
     assert p > 1, "p == 1 has no ring; callers differentiate locally"
-    bytes_needed = ring_attention_bwd_vmem_bytes(q.shape, q.dtype)
-    if bytes_needed > _VMEM_BUDGET_BYTES:
-        raise ValueError(
-            f"ring-attention backward working set {bytes_needed} B exceeds "
-            f"the VMEM envelope {_VMEM_BUDGET_BYTES} B; shard further or "
-            "use the XLA ppermute backward"
+    budget = vmem_budget_bytes or _VMEM_BUDGET_BYTES
+    if ring_attention_bwd_vmem_bytes(q.shape, q.dtype) > budget:
+        def sub(bi, bb, hi, hh, prev):
+            qs = q[bi:bi + bb, :, hi:hi + hh]
+            if prev is not None:
+                qs = _sequence_after(qs, prev)
+            return ring_attention_bwd_pallas(
+                qs,
+                k[bi:bi + bb, :, hi:hi + hh],
+                v[bi:bi + bb, :, hi:hi + hh],
+                o[bi:bi + bb, :, hi:hi + hh],
+                lse[bi:bi + bb, hi:hi + hh],
+                do[bi:bi + bb, :, hi:hi + hh],
+                axis=axis, causal=causal, axis_size=axis_size,
+                interpret=interpret, vmem_budget_bytes=budget,
+            )
+
+        return _run_chunked(
+            b, h,
+            lambda bb, hh: ring_attention_bwd_vmem_bytes(
+                (bb, n, hh, d), q.dtype
+            ) <= budget,
+            sub, (2, 2, 2),
+            ring_attention_bwd_vmem_bytes((1, n, 1, d), q.dtype), budget,
+            "ring-attention backward",
         )
     bh = b * h
     to_cells = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, n, d)  # noqa: E731
@@ -622,31 +726,35 @@ def ring_attention_bwd_pallas(
     return back(dq), back(dk), back(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def ring_attention(
     q, k, v, axis, causal=False, axis_size=None, interpret=False,
-    bwd_kernel=False,
+    bwd_kernel=False, vmem_budget_bytes=None,
 ):
     """Differentiable ring attention: RDMA-kernel forward, with the
     backward either the analytic XLA ppermute ring (default) or the RDMA
     backward kernel (``bwd_kernel=True`` — both directions on the custom
     transport). Either way the saved (o, lse) residuals mean no forward
-    recompute on the gradient path."""
+    recompute on the gradient path. ``vmem_budget_bytes`` overrides the
+    auto-chunking envelope for BOTH directions (None = module default)."""
     return ring_attention_pallas(
         q, k, v, axis=axis, causal=causal, axis_size=axis_size,
-        interpret=interpret,
+        interpret=interpret, vmem_budget_bytes=vmem_budget_bytes,
     )
 
 
-def _ra_fwd(q, k, v, axis, causal, axis_size, interpret, bwd_kernel):
+def _ra_fwd(q, k, v, axis, causal, axis_size, interpret, bwd_kernel,
+            vmem_budget_bytes):
     out, lse = ring_attention_pallas(
         q, k, v, axis=axis, causal=causal, axis_size=axis_size,
         interpret=interpret, return_lse=True,
+        vmem_budget_bytes=vmem_budget_bytes,
     )
     return out, (q, k, v, out, lse)
 
 
-def _ra_bwd(axis, causal, axis_size, interpret, bwd_kernel, res, g):
+def _ra_bwd(axis, causal, axis_size, interpret, bwd_kernel,
+            vmem_budget_bytes, res, g):
     q, k, v, o, lse = res
     p = axis_size or lax.axis_size(axis)
     if p == 1:
@@ -662,6 +770,7 @@ def _ra_bwd(axis, causal, axis_size, interpret, bwd_kernel, res, g):
         return ring_attention_bwd_pallas(
             q, k, v, o, lse, g, axis=axis, causal=causal,
             axis_size=axis_size, interpret=interpret,
+            vmem_budget_bytes=vmem_budget_bytes,
         )
     return _ring_attention_bwd_xla(q, k, v, o, lse, g, axis, causal, p)
 

@@ -13,8 +13,10 @@ works on the CPU test mesh and lowers ppermute to ICI collective-permute
 on TPU) and the Pallas kernel with explicit double-buffered K/V RDMA and
 the streaming-softmax merge in-kernel
 (``backend='pallas'``/``'pallas_interpret'``, ``ops/ring_attention_kernel
-.py``). ``backend='auto'`` picks the kernel on real multi-chip TPU when
-the working set fits its VMEM envelope, the XLA path otherwise.
+.py``). Oversized working sets auto-chunk over batch/heads (each chunk
+rides its own ring); ``backend='auto'`` picks the kernel on real
+multi-chip TPU whenever a single (batch, head) cell fits the VMEM
+envelope, the XLA path otherwise.
 
 Derived from the ring-attention pattern in the public pallas guide and the
 scaling-book recipe: shift-K/V ring + online softmax.
@@ -73,7 +75,8 @@ def ring_self_attention(
     the same double-buffered ring, carrying dK/dV home with their
     blocks), ``'pallas_interpret'`` / ``'pallas_interpret_full'`` (the
     same in interpret mode — CPU-mesh validation), or ``'auto'`` (kernel
-    on real multi-chip TPU when it fits VMEM, else the XLA ring).
+    on real multi-chip TPU when a single (batch, head) cell fits VMEM —
+    larger working sets auto-chunk — else the XLA ring).
 
     Causal masking accounts for the global positions: the k/v block visiting
     at ring step s originated on rank ``(r - s) mod p``, so its global
@@ -98,9 +101,12 @@ def ring_self_attention(
         if backend == "auto":
             from ..ops.ring_kernels import available
 
+            # the kernel auto-chunks over batch/heads, so it is usable
+            # whenever a single (batch, head) cell fits the envelope
+            b, n, h, d = q.shape
             if (
                 available()
-                and ring_attention_vmem_bytes(q.shape, q.dtype)
+                and ring_attention_vmem_bytes((1, n, 1, d), q.dtype)
                 <= _VMEM_BUDGET_BYTES
             ):
                 return ring_attention(q, k, v, axis, causal, axis_size, False)
