@@ -1,7 +1,11 @@
 """Pallas TPU kernels: fused reduction, ring collectives over ICI RDMA."""
 
 from .reduce_kernel import accumulate, scale_accumulate
-from .ring_attention_kernel import ring_attention, ring_attention_pallas
+from .ring_attention_kernel import (
+    ring_attention,
+    ring_attention_bwd_pallas,
+    ring_attention_pallas,
+)
 from .ring_kernels import (
     available,
     ring_allgather_pallas,
@@ -18,6 +22,7 @@ __all__ = [
     "scale_accumulate",
     "available",
     "ring_attention",
+    "ring_attention_bwd_pallas",
     "ring_attention_pallas",
     "ring_allgather_pallas",
     "ring_allreduce_bidir_pallas",
