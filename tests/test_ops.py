@@ -962,6 +962,21 @@ def test_pallas_ring_attention_chunked_matches_unchunked(p, causal):
         rtol=1e-5, atol=1e-5,
     )
 
+    def fwd_bidir(budget):
+        f = lambda q, k, v: rak.ring_attention_bidir_pallas(  # noqa: E731
+            q, k, v, axis="sp", causal=causal, interpret=True,
+            vmem_budget_bytes=budget,
+        )
+        return jax.jit(partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False,
+        )(f))(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(fwd_bidir(40_000)), np.asarray(fwd(None)),
+        rtol=1e-5, atol=1e-5,
+    )
+
     def grads(budget):
         def loss(q, k, v):
             out = rak.ring_attention(
@@ -1126,3 +1141,84 @@ def test_pallas_ring_attention_bwd_vmem_envelope():
     assert ring_attention_bwd_vmem_bytes(
         (2, 256, 4, 64), jnp.bfloat16
     ) > ring_attention_vmem_bytes((2, 256, 4, 64), jnp.bfloat16)
+
+
+@pytest.mark.parametrize("p", P_SWEEP)
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_ring_attention_bidir_interpret(p, causal):
+    """Bidirectional forward ('pallas_*_bidir'): two K/V chains in
+    opposite ICI directions cover sources {my, my±1, my±2, ...} in
+    ceil((p-1)/2)+1 steps; the order-independent streaming-softmax merge
+    makes the result exactly the unidirectional ring's."""
+    from functools import partial
+
+    from jax.sharding import Mesh
+
+    from torchmpi_tpu.parallel.ring_attention import ring_self_attention
+
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    b, n, h, d = 2, 4 * p, 2, 8
+    rs = np.random.RandomState(29 + p)
+    q = rs.randn(b, n, h, d).astype(np.float32)
+    k = rs.randn(b, n, h, d).astype(np.float32)
+    v = rs.randn(b, n, h, d).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:p]), ("sp",))
+
+    def run(backend):
+        f = lambda q, k, v: ring_self_attention(  # noqa: E731
+            q, k, v, axis="sp", causal=causal, backend=backend
+        )
+        return jax.jit(partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False,
+        )(f))(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(run("pallas_interpret_bidir")),
+        np.asarray(run("xla")),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+@pytest.mark.parametrize("p", [3, 4])
+@pytest.mark.parametrize("backend", [
+    "pallas_interpret_bidir", "pallas_interpret_bidir_full",
+])
+def test_pallas_ring_attention_bidir_grads(backend, p):
+    """Gradients through the bidir forward: the saved (o, lse) residuals
+    feed either the analytic XLA backward or the RDMA backward kernel —
+    both must match the all-XLA reference. p=3 has equal chains
+    (nR == nL == 1); p=4 exercises the asymmetric case (the L chain one
+    distance short, its early-stop at t > nL)."""
+    from functools import partial
+
+    from jax.sharding import Mesh
+
+    from torchmpi_tpu.parallel.ring_attention import ring_self_attention
+    if len(jax.devices()) < p:
+        pytest.skip(f"needs {p} devices")
+    b, n, h, d = 2, 4 * p, 2, 8
+    rs = np.random.RandomState(5)
+    q = rs.randn(b, n, h, d).astype(np.float32)
+    k = rs.randn(b, n, h, d).astype(np.float32)
+    v = rs.randn(b, n, h, d).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:p]), ("sp",))
+
+    def grads(bk):
+        def loss(q, k, v):
+            out = ring_self_attention(
+                q, k, v, axis="sp", causal=True, backend=bk
+            )
+            return (out * out).sum()
+
+        return jax.jit(jax.grad(partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(), check_vma=False,
+        )(lambda q, k, v: jax.lax.psum(loss(q, k, v), "sp")),
+            argnums=(0, 1, 2)))(q, k, v)
+
+    for a, g in zip(grads("xla"), grads(backend)):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(a), rtol=2e-4, atol=2e-4
+        )

@@ -3,6 +3,7 @@
 from .reduce_kernel import accumulate, scale_accumulate
 from .ring_attention_kernel import (
     ring_attention,
+    ring_attention_bidir_pallas,
     ring_attention_bwd_pallas,
     ring_attention_pallas,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "scale_accumulate",
     "available",
     "ring_attention",
+    "ring_attention_bidir_pallas",
     "ring_attention_bwd_pallas",
     "ring_attention_pallas",
     "ring_allgather_pallas",

@@ -63,6 +63,50 @@ NEG_INF = -1e30
 _VMEM_BUDGET_BYTES = 10 * 1024 * 1024
 
 
+def _flash_merge_cells(
+    bh, n, my, src, causal, scale, q_ref, kbuf, vbuf, slot,
+    oacc, macc, lacc,
+):
+    """Merge the K/V block in ``(kbuf, vbuf)[slot]`` (originating on rank
+    ``src``) into the running flash accumulators, one 2D MXU step per
+    (b, h) cell. Shared by the uni- and bidirectional forward kernels —
+    the merge is order-independent, which is what makes the bidir
+    schedule valid."""
+
+    def cell(i, _):
+        qi = q_ref[i].astype(jnp.float32)  # [n, d]
+        ki = kbuf[slot, i].astype(jnp.float32)
+        vi = vbuf[slot, i].astype(jnp.float32)
+        sij = (
+            lax.dot_general(
+                qi, ki, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [n(q), n(k)]
+        if causal:
+            qpos = lax.broadcasted_iota(jnp.int32, (n, n), 0) + my * n
+            kpos = lax.broadcasted_iota(jnp.int32, (n, n), 1) + src * n
+            sij = jnp.where(qpos >= kpos, sij, NEG_INF)
+        mb = jnp.max(sij, axis=1, keepdims=True)  # [n, 1]
+        pexp = jnp.exp(sij - mb)
+        lb = jnp.sum(pexp, axis=1, keepdims=True)  # [n, 1]
+        ob = lax.dot_general(
+            pexp, vi, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [n, d]
+        m_old = macc[i]  # [n, 1]
+        m_new = jnp.maximum(m_old, mb)
+        alpha = jnp.exp(m_old - m_new)
+        beta = jnp.exp(mb - m_new)
+        lacc[i] = lacc[i] * alpha + lb * beta
+        oacc[i] = oacc[i] * alpha + ob * beta
+        macc[i] = m_new
+        return 0
+
+    lax.fori_loop(0, bh, cell, 0)
+
+
 def _ring_attn_kernel(
     p: int,
     axis: str,
@@ -118,39 +162,10 @@ def _ring_attn_kernel(
         """Attention of resident q against the slot's K/V block, merged
         into the running (o, m, l) — one 2D flash step per (b, h) cell."""
         src = lax.rem(my - s + p, p)  # rank whose shard this block is
-
-        def cell(i, _):
-            qi = q_ref[i].astype(jnp.float32)  # [n, d]
-            ki = kbuf[slot, i].astype(jnp.float32)
-            vi = vbuf[slot, i].astype(jnp.float32)
-            sij = (
-                lax.dot_general(
-                    qi, ki, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                * scale
-            )  # [n(q), n(k)]
-            if causal:
-                qpos = lax.broadcasted_iota(jnp.int32, (n, n), 0) + my * n
-                kpos = lax.broadcasted_iota(jnp.int32, (n, n), 1) + src * n
-                sij = jnp.where(qpos >= kpos, sij, NEG_INF)
-            mb = jnp.max(sij, axis=1, keepdims=True)  # [n, 1]
-            pexp = jnp.exp(sij - mb)
-            lb = jnp.sum(pexp, axis=1, keepdims=True)  # [n, 1]
-            ob = lax.dot_general(
-                pexp, vi, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [n, d]
-            m_old = macc[i]  # [n, 1]
-            m_new = jnp.maximum(m_old, mb)
-            alpha = jnp.exp(m_old - m_new)
-            beta = jnp.exp(mb - m_new)
-            lacc[i] = lacc[i] * alpha + lb * beta
-            oacc[i] = oacc[i] * alpha + ob * beta
-            macc[i] = m_new
-            return 0
-
-        lax.fori_loop(0, bh, cell, 0)
+        _flash_merge_cells(
+            bh, n, my, src, causal, scale, q_ref, kbuf, vbuf, slot,
+            oacc, macc, lacc,
+        )
 
     for s in range(p):
         slot = s % 2
@@ -260,107 +275,107 @@ def _run_chunked(b, h, fits, sub, concat_axes, cell_bytes, budget, what):
     return tuple(jnp.concatenate(acc, axis=0) for acc in out_rows)
 
 
-def ring_attention_pallas(
-    q,
-    k,
-    v,
-    axis: str = "sp",
-    causal: bool = False,
-    axis_size: Optional[int] = None,
-    interpret: bool = False,
-    return_lse: bool = False,
-    vmem_budget_bytes: Optional[int] = None,
-):
-    """Forward ring attention via the RDMA kernel. Call inside
-    ``shard_map``; q/k/v are the local shards ``[b, n_local, h, d]``.
-    Not differentiable — training uses :func:`ring_attention` (custom
-    VJP). ``return_lse=True`` additionally returns the global
-    log-sum-exp ``[b, h, n_local]`` f32 (the backward's residual).
+def _make_fwd(kernel_fn, vmem_bytes_fn, scratch_fn, collective_id, what):
+    """Build a forward-ring entry point: ONE wrapper body (p == 1
+    degenerate, batch/head auto-chunking, cell layout, pallas_call
+    scaffolding) shared by the uni- and bidirectional kernels, so the
+    chunk-plan/sequencing discipline can never diverge between them.
+    ``scratch_fn(bh, n, d, k_dtype, v_dtype)`` returns the kernel's
+    scratch list."""
 
-    A working set over the VMEM envelope is AUTO-CHUNKED over batch and
-    heads (attention is independent across both): each chunk runs its own
-    full K/V ring, so total wire traffic is unchanged — every head's K/V
-    still crosses each link exactly once per step — while per-call VMEM
-    fits. Only a single (batch, head) cell too large for the envelope
-    raises; sequence length then needs more sp shards or the XLA backend.
-    """
-    p = axis_size or lax.axis_size(axis)
-    b, n, h, d = q.shape
-    if p == 1:
-        if return_lse:
-            # one score matrix serves both the output and the residual
-            return _full_attention_with_lse(q, k, v, causal)
-        from ..parallel.ring_attention import full_self_attention
+    def fwd(
+        q,
+        k,
+        v,
+        axis: str = "sp",
+        causal: bool = False,
+        axis_size: Optional[int] = None,
+        interpret: bool = False,
+        return_lse: bool = False,
+        vmem_budget_bytes: Optional[int] = None,
+    ):
+        p = axis_size or lax.axis_size(axis)
+        b, n, h, d = q.shape
+        if p == 1:
+            if return_lse:
+                # one score matrix serves both the output and the residual
+                return _full_attention_with_lse(q, k, v, causal)
+            from ..parallel.ring_attention import full_self_attention
 
-        return full_self_attention(q, k, v, causal=causal)
-    budget = vmem_budget_bytes or _VMEM_BUDGET_BYTES
-    if ring_attention_vmem_bytes(q.shape, q.dtype) > budget:
-        def sub(bi, bb, hi, hh, prev):
-            qs = q[bi:bi + bb, :, hi:hi + hh]
-            if prev is not None:
-                qs = _sequence_after(qs, prev)
-            return ring_attention_pallas(
-                qs,
-                k[bi:bi + bb, :, hi:hi + hh],
-                v[bi:bi + bb, :, hi:hi + hh],
-                axis=axis, causal=causal, axis_size=axis_size,
-                interpret=interpret, return_lse=True,
-                vmem_budget_bytes=budget,
+            return full_self_attention(q, k, v, causal=causal)
+        budget = vmem_budget_bytes or _VMEM_BUDGET_BYTES
+        if vmem_bytes_fn(q.shape, q.dtype) > budget:
+            def sub(bi, bb, hi, hh, prev):
+                qs = q[bi:bi + bb, :, hi:hi + hh]
+                if prev is not None:
+                    qs = _sequence_after(qs, prev)
+                return fwd(
+                    qs,
+                    k[bi:bi + bb, :, hi:hi + hh],
+                    v[bi:bi + bb, :, hi:hi + hh],
+                    axis=axis, causal=causal, axis_size=axis_size,
+                    interpret=interpret, return_lse=True,
+                    vmem_budget_bytes=budget,
+                )
+
+            out, lse = _run_chunked(
+                b, h,
+                lambda bb, hh: vmem_bytes_fn(
+                    (bb, n, hh, d), q.dtype
+                ) <= budget,
+                sub, (2, 1),
+                vmem_bytes_fn((1, n, 1, d), q.dtype), budget, what,
             )
+            return (out, lse) if return_lse else out
+        bh = b * h
+        # [b, n, h, d] -> [bh, n, d]: per-cell 2D math on the MXU
+        to_cells = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, n, d)  # noqa: E731
+        scale = 1.0 / math.sqrt(d)
+        my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
+        kernel = functools.partial(kernel_fn, p, axis, causal, scale, n)
+        out, lse = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, n, 1), jnp.float32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            scratch_shapes=scratch_fn(bh, n, d, k.dtype, v.dtype),
+            compiler_params=pltpu.CompilerParams(
+                collective_id=collective_id
+            ),
+            interpret=pltpu.InterpretParams() if interpret else False,
+        )(my, to_cells(q), to_cells(k), to_cells(v))
+        out = out.reshape(b, h, n, d).transpose(0, 2, 1, 3)
+        if return_lse:
+            return out, lse.reshape(b, h, n)
+        return out
 
-        out, lse = _run_chunked(
-            b, h,
-            lambda bb, hh: ring_attention_vmem_bytes(
-                (bb, n, hh, d), q.dtype
-            ) <= budget,
-            sub, (2, 1),
-            ring_attention_vmem_bytes((1, n, 1, d), q.dtype), budget,
-            "ring-attention",
-        )
-        return (out, lse) if return_lse else out
-    bh = b * h
-    # [b, n, h, d] -> [bh, n, d]: per-cell 2D math on the MXU
-    to_cells = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, n, d)  # noqa: E731
-    scale = 1.0 / math.sqrt(d)
-    my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
-    kernel = functools.partial(
-        _ring_attn_kernel, p, axis, causal, scale, n
-    )
-    out, lse = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n, 1), jnp.float32),
-        ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((2, bh, n, d), k.dtype),
-            pltpu.VMEM((2, bh, n, d), v.dtype),
-            pltpu.VMEM((bh, n, d), jnp.float32),
-            pltpu.VMEM((bh, n, 1), jnp.float32),
-            pltpu.VMEM((bh, n, 1), jnp.float32),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.REGULAR((2,)),
-        ],
-        compiler_params=pltpu.CompilerParams(collective_id=11),
-        interpret=pltpu.InterpretParams() if interpret else False,
-    )(my, to_cells(q), to_cells(k), to_cells(v))
-    out = out.reshape(b, h, n, d).transpose(0, 2, 1, 3)
-    if return_lse:
-        return out, lse.reshape(b, h, n)
-    return out
+    return fwd
+
+
+def _uni_scratch(bh, n, d, k_dtype, v_dtype):
+    return [
+        pltpu.VMEM((2, bh, n, d), k_dtype),
+        pltpu.VMEM((2, bh, n, d), v_dtype),
+        pltpu.VMEM((bh, n, d), jnp.float32),
+        pltpu.VMEM((bh, n, 1), jnp.float32),
+        pltpu.VMEM((bh, n, 1), jnp.float32),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR((2,)),
+    ]
 
 
 def ring_attention_vmem_bytes(local_shape, dtype) -> int:
@@ -371,6 +386,215 @@ def ring_attention_vmem_bytes(local_shape, dtype) -> int:
     cells = b * h * n * d
     itemsize = jnp.dtype(dtype).itemsize
     return cells * (8 * itemsize + 4) + 2 * 4 * b * h * n
+
+
+ring_attention_pallas = _make_fwd(
+    _ring_attn_kernel, ring_attention_vmem_bytes, _uni_scratch, 11,
+    "ring-attention",
+)
+ring_attention_pallas.__doc__ = """Forward ring attention via the RDMA
+kernel. Call inside ``shard_map``; q/k/v are the local shards
+``[b, n_local, h, d]``. Not differentiable — training uses
+:func:`ring_attention` (custom VJP). ``return_lse=True`` additionally
+returns the global log-sum-exp ``[b, h, n_local]`` f32 (the backward's
+residual).
+
+A working set over the VMEM envelope is AUTO-CHUNKED over batch and
+heads (attention is independent across both): each chunk runs its own
+full K/V ring, so total wire traffic is unchanged — every head's K/V
+still crosses each link exactly once per step — while per-call VMEM
+fits. Only a single (batch, head) cell too large for the envelope
+raises; sequence length then needs more sp shards or the XLA backend."""
+
+
+def _ring_attn_bidir_kernel(
+    p: int,
+    axis: str,
+    causal: bool,
+    scale: float,
+    n: int,
+    my_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    kbufR,
+    vbufR,
+    kbufL,
+    vbufL,
+    oacc,
+    macc,
+    lacc,
+    sendR_k,
+    recvR_k,
+    sendR_v,
+    recvR_v,
+    sendL_k,
+    recvL_k,
+    sendL_v,
+    recvL_v,
+    capR,
+    capL,
+):
+    """Bidirectional forward: TWO independent K/V chains rotate in
+    opposite ICI directions (the torus has a link each way), so the ring
+    finishes in ceil((p-1)/2) + 1 steps instead of p — total wire bytes
+    unchanged, wall-clock halved when both link directions run at full
+    rate (the same trade as ``ring_allreduce_bidir_pallas``). The
+    streaming-softmax merge is order-independent, so visiting sources as
+    {my, my±1, my±2, ...} instead of {my, my-1, my-2, ...} is exact.
+
+    Per loop step t (t also = block distance): the R chain's slot holds
+    the block from rank (my - t), the L chain's from (my + t). The R
+    chain delivers distances 1..ceil((p-1)/2); the L chain distances
+    1..floor((p-1)/2) — at t = 0 both slots hold the LOCAL block and it
+    is merged exactly once. Each chain runs the unidirectional kernel's
+    transport discipline (prefetch-send, per-step wait, capacity
+    semaphores toward its upstream neighbor) with its own buffers and
+    semaphores."""
+    my = my_ref[0]
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my + p - 1, p)
+    bh = q_ref.shape[0]
+
+    oacc[:] = jnp.zeros_like(oacc)
+    macc[:] = jnp.full_like(macc, NEG_INF)
+    lacc[:] = jnp.zeros_like(lacc)
+    kbufR[0] = k_ref[:]
+    vbufR[0] = v_ref[:]
+    kbufL[0] = k_ref[:]
+    vbufL[0] = v_ref[:]
+
+    barrier = pltpu.get_barrier_semaphore()
+    for nbr in (left, right):
+        pltpu.semaphore_signal(
+            barrier,
+            inc=1,
+            device_id={axis: nbr},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+    pltpu.semaphore_wait(barrier, 2)
+
+    # distances delivered per chain; nR >= nL, nR + nL = p - 1
+    nR = (p - 1 + 1) // 2
+    nL = (p - 1) // 2
+
+    chains = (
+        # (buffers, sems, cap, dst neighbor, cap-signal target, #distances)
+        ((kbufR, vbufR), (sendR_k, recvR_k, sendR_v, recvR_v), capR,
+         right, left, nR),
+        ((kbufL, vbufL), (sendL_k, recvL_k, sendL_v, recvL_v), capL,
+         left, right, nL),
+    )
+
+    for t in range(nR + 1):
+        slot = t % 2
+        nslot = 1 - slot
+        all_copies = []
+        for (bufs, sems, cap, dst, cap_to, ndist) in chains:
+            if t < ndist:  # this chain still has a farther block to push
+                if t >= 1:
+                    pltpu.semaphore_wait(cap.at[nslot], 1)
+                sk, rk, sv, rv = sems
+                copies = tuple(
+                    pltpu.make_async_remote_copy(
+                        src_ref=buf.at[slot],
+                        dst_ref=buf.at[nslot],
+                        send_sem=ssem.at[slot],
+                        recv_sem=rsem.at[slot],
+                        device_id={axis: dst},
+                        device_id_type=pltpu.DeviceIdType.MESH,
+                    )
+                    for buf, ssem, rsem in (
+                        (bufs[0], sk, rk),
+                        (bufs[1], sv, rv),
+                    )
+                )
+                for c in copies:
+                    c.start()
+                all_copies.append((copies, cap, cap_to, ndist))
+        # merge this step's visiting block(s); t = 0 merges the local
+        # block exactly once (both chains hold it)
+        if t == 0:
+            _flash_merge_cells(
+                bh, n, my, my, causal, scale, q_ref, kbufR, vbufR, 0,
+                oacc, macc, lacc,
+            )
+        else:
+            # the R chain reaches every loop step (nR >= nL); the L
+            # chain stops one distance short when p is even
+            _flash_merge_cells(
+                bh, n, my, lax.rem(my - t + p, p), causal, scale,
+                q_ref, kbufR, vbufR, slot, oacc, macc, lacc,
+            )
+            if t <= nL:
+                _flash_merge_cells(
+                    bh, n, my, lax.rem(my + t, p), causal, scale,
+                    q_ref, kbufL, vbufL, slot, oacc, macc, lacc,
+                )
+        for copies, cap, cap_to, ndist in all_copies:
+            for c in copies:
+                c.wait()
+            # slot consumed + our outgoing read landed: upstream may
+            # overwrite it at its next send. Its sends stop at t = ndist-1,
+            # so signals stop one step earlier (semaphores end drained).
+            if t < ndist - 1:
+                pltpu.semaphore_signal(
+                    cap.at[slot],
+                    inc=1,
+                    device_id={axis: cap_to},
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+
+    def finalize(i, _):
+        li = jnp.maximum(lacc[i], 1e-30)
+        o_ref[i] = (oacc[i] / li).astype(o_ref.dtype)
+        lse_ref[i] = macc[i] + jnp.log(li)
+        return 0
+
+    lax.fori_loop(0, bh, finalize, 0)
+
+
+def ring_attention_bidir_vmem_bytes(local_shape, dtype) -> int:
+    """Bidir working set: the unidirectional envelope plus the second
+    chain's 2x2 K/V slots."""
+    b, n, h, d = local_shape
+    cells = b * h * n * d
+    itemsize = jnp.dtype(dtype).itemsize
+    return cells * (12 * itemsize + 4) + 2 * 4 * b * h * n
+
+
+def _bidir_scratch(bh, n, d, k_dtype, v_dtype):
+    return [
+        pltpu.VMEM((2, bh, n, d), k_dtype),
+        pltpu.VMEM((2, bh, n, d), v_dtype),
+        pltpu.VMEM((2, bh, n, d), k_dtype),
+        pltpu.VMEM((2, bh, n, d), v_dtype),
+        pltpu.VMEM((bh, n, d), jnp.float32),
+        pltpu.VMEM((bh, n, 1), jnp.float32),
+        pltpu.VMEM((bh, n, 1), jnp.float32),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR((2,)),
+        pltpu.SemaphoreType.REGULAR((2,)),
+    ]
+
+
+ring_attention_bidir_pallas = _make_fwd(
+    _ring_attn_bidir_kernel, ring_attention_bidir_vmem_bytes,
+    _bidir_scratch, 13, "bidirectional ring-attention",
+)
+ring_attention_bidir_pallas.__doc__ = """Forward ring attention with BOTH
+ICI directions carrying K/V chains (~half the steps of
+:func:`ring_attention_pallas`). Same call contract, residuals, and
+batch/head auto-chunking."""
 
 
 def _full_attention_with_lse(q, k, v, causal):
@@ -726,26 +950,30 @@ def ring_attention_bwd_pallas(
     return back(dq), back(dk), back(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def ring_attention(
     q, k, v, axis, causal=False, axis_size=None, interpret=False,
-    bwd_kernel=False, vmem_budget_bytes=None,
+    bwd_kernel=False, vmem_budget_bytes=None, fwd_bidir=False,
 ):
-    """Differentiable ring attention: RDMA-kernel forward, with the
-    backward either the analytic XLA ppermute ring (default) or the RDMA
-    backward kernel (``bwd_kernel=True`` — both directions on the custom
-    transport). Either way the saved (o, lse) residuals mean no forward
-    recompute on the gradient path. ``vmem_budget_bytes`` overrides the
-    auto-chunking envelope for BOTH directions (None = module default)."""
-    return ring_attention_pallas(
+    """Differentiable ring attention: RDMA-kernel forward (uni- or, with
+    ``fwd_bidir=True``, bidirectional — both ICI directions carry K/V
+    chains, ~half the ring steps), with the backward either the analytic
+    XLA ppermute ring (default) or the RDMA backward kernel
+    (``bwd_kernel=True``). Either way the saved (o, lse) residuals mean
+    no forward recompute on the gradient path. ``vmem_budget_bytes``
+    overrides the auto-chunking envelope for BOTH directions (None =
+    module default)."""
+    fwd = ring_attention_bidir_pallas if fwd_bidir else ring_attention_pallas
+    return fwd(
         q, k, v, axis=axis, causal=causal, axis_size=axis_size,
         interpret=interpret, vmem_budget_bytes=vmem_budget_bytes,
     )
 
 
 def _ra_fwd(q, k, v, axis, causal, axis_size, interpret, bwd_kernel,
-            vmem_budget_bytes):
-    out, lse = ring_attention_pallas(
+            vmem_budget_bytes, fwd_bidir):
+    fwd = ring_attention_bidir_pallas if fwd_bidir else ring_attention_pallas
+    out, lse = fwd(
         q, k, v, axis=axis, causal=causal, axis_size=axis_size,
         interpret=interpret, return_lse=True,
         vmem_budget_bytes=vmem_budget_bytes,
@@ -754,7 +982,7 @@ def _ra_fwd(q, k, v, axis, causal, axis_size, interpret, bwd_kernel,
 
 
 def _ra_bwd(axis, causal, axis_size, interpret, bwd_kernel,
-            vmem_budget_bytes, res, g):
+            vmem_budget_bytes, fwd_bidir, res, g):
     q, k, v, o, lse = res
     p = axis_size or lax.axis_size(axis)
     if p == 1:

@@ -69,14 +69,16 @@ def ring_self_attention(
     identical (up to float error) to full attention over the gathered
     sequence.
 
-    ``backend``: ``'xla'`` (ppermute ring), ``'pallas'`` (RDMA kernel
-    forward, analytic XLA-ring backward via its custom VJP),
-    ``'pallas_full'`` (RDMA kernels BOTH directions — the backward rides
-    the same double-buffered ring, carrying dK/dV home with their
-    blocks), ``'pallas_interpret'`` / ``'pallas_interpret_full'`` (the
-    same in interpret mode — CPU-mesh validation), or ``'auto'`` (kernel
+    ``backend``: ``'xla'`` (ppermute ring); ``'auto'`` (the RDMA kernel
     on real multi-chip TPU when a single (batch, head) cell fits VMEM —
-    larger working sets auto-chunk — else the XLA ring).
+    larger working sets auto-chunk — else the XLA ring); or any
+    combination of ``'pallas'`` with the suffix tokens ``_interpret``
+    (interpret mode — CPU-mesh validation), ``_bidir`` (bidirectional
+    forward: both ICI directions carry K/V chains, ~half the ring
+    steps), and ``_full`` (RDMA backward kernel too — dK/dV accumulators
+    ride the ring home with their blocks; default backward is the
+    analytic XLA ring from the saved residuals). E.g.
+    ``'pallas_interpret_bidir_full'``.
 
     Causal masking accounts for the global positions: the k/v block visiting
     at ring step s originated on rank ``(r - s) mod p``, so its global
@@ -89,14 +91,16 @@ def ring_self_attention(
             ring_attention_vmem_bytes,
         )
 
-        if backend in (
-            "pallas", "pallas_interpret", "pallas_full",
-            "pallas_interpret_full",
-        ):
+        tokens = set(backend.split("_"))
+        if backend.startswith("pallas") and tokens <= {
+            "pallas", "interpret", "full", "bidir"
+        }:
             return ring_attention(
                 q, k, v, axis, causal, axis_size,
-                backend.startswith("pallas_interpret"),
-                backend.endswith("_full"),
+                "interpret" in tokens,
+                "full" in tokens,
+                None,
+                "bidir" in tokens,
             )
         if backend == "auto":
             from ..ops.ring_kernels import available
