@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Tiered local validation — the full suite, split to fit ~10-minute
+# execution windows on a single-core box (this dev box has ONE cpu; see
+# README "Testing"). Each tier is independently green; together they are
+# the whole suite.
+#
+#   scripts/ci.sh           # all three tiers, sequential
+#   scripts/ci.sh fast      # just the fast tier (~4 min)
+set -eu
+cd "$(dirname "$0")/.."
+
+tier="${1:-all}"
+
+run_fast() {
+    echo "=== fast tier (unit + interpret p<=3 + single-process) ==="
+    python -m pytest tests/ -q -m "not slow"
+}
+
+run_slow_a() {
+    echo "=== slow tier A (multi-process + e2e examples) ==="
+    python -m pytest tests/test_multiprocess.py tests/test_examples.py -q
+}
+
+run_slow_b() {
+    echo "=== slow tier B (wide interpret sweeps + heavy engine/models) ==="
+    python -m pytest tests/test_ops.py tests/test_parallel.py \
+        tests/test_lm.py tests/test_engine.py tests/test_native.py \
+        tests/test_scale_breadth.py -q -m slow
+}
+
+case "$tier" in
+    fast) run_fast ;;
+    slow-a) run_slow_a ;;
+    slow-b) run_slow_b ;;
+    all) run_fast; run_slow_a; run_slow_b ;;
+    *) echo "usage: scripts/ci.sh [fast|slow-a|slow-b|all]" >&2; exit 2 ;;
+esac
+echo "Success"
