@@ -67,7 +67,7 @@ from .runtime_state import (
 # `collectives`/`runtime_state` above, so the order avoids cycles.
 from . import engine, nn, parallel, parameterserver, utils  # noqa: E402
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "start",
