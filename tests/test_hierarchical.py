@@ -288,3 +288,82 @@ def test_hierarchical_pallas_bidir_intra_phase():
             assert "allreduce" in rk._LAST_STEP_COUNTS
     finally:
         rk._FORCE_INTERPRET = False
+
+
+def test_staged_hierarchical_pallas_intra_phase():
+    """use_staged_collectives keeps the routed INTRA transport: with
+    staged_intra='pallas' the group reduction runs the RDMA ring kernel
+    (the reference's staged path likewise kept its custom IPC transport
+    inside the node, collectives_cuda.cpp:390-683), with numeric parity
+    against the closed-form sum."""
+    from torchmpi_tpu.collectives.eager import run_hierarchical_allreduce
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    p, comm = _2level()
+    calls = []
+    orig = rk.ring_allreduce_pallas
+
+    def spy(*a, **kw):
+        axis = kw.get("axis") or next(
+            (s for s in a if isinstance(s, str)), None
+        )
+        calls.append(axis)
+        return orig(*a, **kw)
+
+    rk._FORCE_INTERPRET = True
+    try:
+        rk.ring_allreduce_pallas = spy
+        x = np.tile(
+            np.arange(p, dtype=np.float32)[:, None], (1, 300)
+        )
+        out = run_hierarchical_allreduce(
+            x, comm, impl="staged", staged_intra="pallas"
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), p * (p - 1) / 2, rtol=1e-6
+        )
+    finally:
+        rk.ring_allreduce_pallas = orig
+        rk._FORCE_INTERPRET = False
+    assert calls and all(a == "intra" for a in calls), calls
+
+
+def test_staged_pallas_intra_via_run_dispatch():
+    """The production wiring end to end: use_staged_collectives=True with
+    the pallas backend requested through mpi.pallas.allreduce_tensor must
+    route the staged path AND keep the RDMA intra ring (regression guard
+    on run()'s staged_intra=effective threading)."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.ops import ring_kernels as rk
+
+    p, comm = _2level()
+    calls = []
+    orig = rk.ring_allreduce_pallas
+
+    def spy(*a, **kw):
+        axis = kw.get("axis") or next(
+            (s for s in a if isinstance(s, str)), None
+        )
+        calls.append(axis)
+        return orig(*a, **kw)
+
+    constants.set("use_staged_collectives", True)
+    constants.set(
+        f"small_allreduce_size_{constants.platform_suffix(comm.devices[0].platform)}",
+        1,
+    )
+    rk._FORCE_INTERPRET = True
+    try:
+        rk.ring_allreduce_pallas = spy
+        x = np.tile(np.arange(p, dtype=np.float32)[:, None], (1, 300))
+        out = mpi.pallas.allreduce_tensor(x, comm=comm)
+        np.testing.assert_allclose(
+            np.asarray(out), p * (p - 1) / 2, rtol=1e-6
+        )
+    finally:
+        rk.ring_allreduce_pallas = orig
+        rk._FORCE_INTERPRET = False
+    assert calls and all(a == "intra" for a in calls), calls
+    assert any(
+        k[0] == "staged_allreduce" for k in comm._collective_resources
+    ), "staged path not taken through run()"

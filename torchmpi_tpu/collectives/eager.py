@@ -426,12 +426,14 @@ def run(
             # when the selector routed to pallas, the composition's intra
             # phase runs the RDMA ring (collectives_cuda.cpp:501-581 — the
             # reference's intra-IPC transport was the custom one there too)
-            impl = (
-                "staged"
-                if constants.get("use_staged_collectives")
-                else effective
-            )
-            return run_hierarchical_allreduce(x, comm, impl=impl)
+            if constants.get("use_staged_collectives"):
+                # the staged variant keeps the routed INTRA transport
+                # (the reference's staged path still ran its custom IPC
+                # rings inside the node, collectives_cuda.cpp:390-683)
+                return run_hierarchical_allreduce(
+                    x, comm, impl="staged", staged_intra=effective
+                )
+            return run_hierarchical_allreduce(x, comm, impl=effective)
         if op in ("broadcast", "reduce", "allgather"):
             return run_hierarchical_collective(
                 op, x, comm, root=root, ring_impl=effective
@@ -579,7 +581,9 @@ def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
     return h
 
 
-def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
+def run_hierarchical_allreduce(
+    x, comm: Communicator, impl: str = "ring", staged_intra: str = "ring"
+):
     """Explicit two-level allreduce over a cartesian communicator: ring
     reduce within each intra group, ring across the inter dimension, then
     the intra all-gather — the reference's hierarchical dispatch
@@ -599,7 +603,7 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
             "multiple intra groups of size > 1"
         )
     if impl == "staged":
-        return _run_staged_hierarchical_allreduce(x, comm)
+        return _run_staged_hierarchical_allreduce(x, comm, staged_intra)
     donate = constants.get("donate_eager_buffers")
     tuning = (
         ring_tuning(comm._devices[0].platform)
@@ -622,14 +626,7 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
         # ring_implementation); inter = cross-ICI/DCN: the ppermute ring
         # (XLA schedules it over the slower fabric) — the reference's
         # intra-IPC-ring x inter-MPI split.
-        from ..ops.ring_kernels import (
-            ring_allreduce_bidir_pallas,
-            ring_allreduce_pallas,
-        )
-
-        intra_ring = (
-            ring_allreduce_bidir_pallas if bidir else ring_allreduce_pallas
-        )
+        intra_ring, _ = _pallas_intra_ring()
         minb, maxb, nbuf = tuning
 
         def kernel(b):
@@ -660,13 +657,36 @@ def run_hierarchical_allreduce(x, comm: Communicator, impl: str = "ring"):
     return _hier_compile(comm, key, x.ndim, donate, kernel)(x)
 
 
-def _run_staged_hierarchical_allreduce(x, comm: Communicator):
+def _pallas_intra_ring():
+    """(ring_fn, bidir) for the intra (ICI) allreduce phase when the
+    selector routed 'pallas' — uni- or bidirectional per
+    ``ring_implementation``. The ONE selection site shared by the direct
+    and staged hierarchical paths, so their intra transports can never
+    diverge."""
+    from ..ops.ring_kernels import (
+        ring_allreduce_bidir_pallas,
+        ring_allreduce_pallas,
+    )
+
+    bidir = constants.get("ring_implementation") == "pallas_bidir"
+    return (
+        ring_allreduce_bidir_pallas if bidir else ring_allreduce_pallas,
+        bidir,
+    )
+
+
+def _run_staged_hierarchical_allreduce(
+    x, comm: Communicator, intra_impl: str = "ring"
+):
     """Host-staged cross-group allreduce — the TPU analog of
     ``allreducep2pCrossNodesViaCPU`` (staged-via-pinned-CPU,
     ``detail/collectives_cuda.cpp:390-683``), selected by
     ``use_staged_collectives``:
 
-    1. device: ring-allreduce within each intra group (ICI-local);
+    1. device: ring-allreduce within each intra group (ICI-local) — the
+       ppermute ring, or the Pallas RDMA ring when the selector routed
+       ``intra_impl='pallas'`` (the reference's staged path likewise kept
+       its custom IPC transport inside the node);
     2. host: fetch one representative group-sum per group, reduce across
        groups in host memory (the DCN-staged hop);
     3. device: push the global total back to every rank.
@@ -677,7 +697,14 @@ def _run_staged_hierarchical_allreduce(x, comm: Communicator):
     """
     cache = _resource_cache(comm)
     tuning = ring_tuning(comm._devices[0].platform)
-    key = ("staged_allreduce", tuple(x.shape), jnp.result_type(x), tuning)
+    bidir = (
+        intra_impl == "pallas"
+        and constants.get("ring_implementation") == "pallas_bidir"
+    )
+    key = (
+        "staged_allreduce", intra_impl, bidir, tuple(x.shape),
+        jnp.result_type(x), tuning,
+    )
     entry = cache.get(key)
     if entry is None:
         perm = np.concatenate(comm._groups).astype(np.int32)
@@ -686,12 +713,18 @@ def _run_staged_hierarchical_allreduce(x, comm: Communicator):
         spec = P(("inter", "intra"), *([None] * (x.ndim - 1)))
         minb, maxb, nbuf = tuning
 
-        def intra_kernel(b):
-            return prim.ring_allreduce(
-                b, "intra",
-                max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf,
-            )
+        if intra_impl == "pallas":
+            intra_ring, _ = _pallas_intra_ring()
+
+            def intra_kernel(b):
+                return intra_ring(b, "intra")
+        else:
+            def intra_kernel(b):
+                return prim.ring_allreduce(
+                    b, "intra",
+                    max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                    num_buffers=nbuf,
+                )
 
         shmapped = jax.shard_map(
             intra_kernel, mesh=mesh, in_specs=spec, out_specs=spec,
