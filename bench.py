@@ -320,15 +320,25 @@ def _metric_unit(model):
 
 def _worker_setup():
     sys.path.insert(0, str(HERE))
-    import jax
-
     # Honor an explicit CPU request BEFORE the first backend touch: the
     # box's TPU plugin (sitecustomize) wins over the JAX_PLATFORMS env
     # var, and probing a busy/dead tunnel hangs rather than raising.
     force = os.environ.get("TORCHMPI_TPU_FORCE_CPU", "").lower()
-    if force in ("1", "true", "yes", "on") or (
+    force_cpu = force in ("1", "true", "yes", "on") or (
         os.environ.get("JAX_PLATFORMS") == "cpu"
-    ):
+    )
+    if force_cpu:
+        # virtual 8-device mesh via XLA_FLAGS while the flag can still be
+        # read (older jax has no jax_num_cpu_devices config and reads
+        # this only at first backend creation)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    if force_cpu:
         jax.config.update("jax_platforms", "cpu")
     # Persistent compilation cache: a worker killed mid-compile by the
     # per-attempt timeout would otherwise recompile from scratch on retry;
@@ -355,7 +365,18 @@ def _worker_setup():
         from jax.extend import backend as jeb
 
         jeb.clear_backends()
-        jax.config.update("jax_num_cpu_devices", 8)
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except AttributeError:
+            # older jax: no such config; best-effort via XLA_FLAGS +
+            # another backend rebuild (single-device measurement if the
+            # flag is no longer consulted)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            jeb.clear_backends()
         devices = jax.devices()
     return devices, platform
 

@@ -120,3 +120,35 @@ def test_probe_failure_budget_is_global(bench, monkeypatch):
     second = bench._measure("resnet50", t0, max_attempts=2)
     assert second["value"] is None
     assert len(calls) == n_after_first  # no further probe attempts
+
+
+def test_stdout_is_json_only_under_backoff_noise(bench, capsys, monkeypatch):
+    """Probe/backoff/attempt-failure noise must land on STDERR only: the
+    driver parses the LAST stdout line as JSON, so a single stray
+    diagnostic on stdout corrupts the record (PR-2 satellite)."""
+    bench.LAST_GOOD_FILE.write_text(json.dumps({"mnist": _stale_record()}))
+
+    probes = {"n": 0}
+
+    def flaky_probe(timeout_s=0):
+        # fail twice (exercising the backoff print), then succeed
+        probes["n"] += 1
+        if probes["n"] <= 2:
+            bench._PROBE_FAILURES += 1
+            return False
+        return True
+
+    def failing_worker(model, timeout_s):
+        return None, "worker rc=1: synthetic failure"  # attempt-print path
+
+    monkeypatch.setattr(bench, "_probe_backend", flaky_probe)
+    monkeypatch.setattr(bench, "_run_worker", failing_worker)
+    assert bench._launcher(["mnist"]) == 0
+    captured = capsys.readouterr()
+    stdout_lines = [l for l in captured.out.splitlines() if l.strip()]
+    assert stdout_lines, "launcher must print evidence lines"
+    for line in stdout_lines:
+        obj = json.loads(line)  # every stdout line is machine-parseable
+        assert isinstance(obj, dict) and "metric" in obj
+    # the noise went somewhere (stderr), not nowhere and not stdout
+    assert "failed" in captured.err

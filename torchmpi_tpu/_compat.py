@@ -1,0 +1,117 @@
+"""JAX version compatibility layer.
+
+The framework targets the current jax API surface (``jax.shard_map``,
+``pltpu.InterpretParams`` TPU interpret mode, ``pltpu.CompilerParams``);
+older releases (<= 0.4.x) spell these ``jax.experimental.shard_map``
+(``check_rep`` instead of ``check_vma``), ``interpret=True`` (the legacy
+pallas interpreter), and ``pltpu.TPUCompilerParams``. Every version-
+sensitive call site goes through this module so the difference lives in
+exactly one place.
+
+Legacy pallas interpreter caveats (jax <= 0.4.x), which the kernel
+wrappers consult via :data:`HAS_TPU_INTERPRET`:
+
+- remote ``semaphore_signal`` is not implemented — kernels skip their
+  flow-control semaphores (neighbor barrier, capacity signals) under the
+  legacy interpreter. That is sound there: the legacy discharge rules
+  evaluate the kernel as ONE lockstep SPMD program (each remote DMA
+  becomes an ``all_gather`` + select), so there is no fast-sender /
+  slow-consumer interleaving for the semaphores to close and the data
+  movement stays exact.
+- ``device_id`` must be a scalar (the discharge rule ``all_gather``\\ s
+  the raw value); the named ``{axis: idx}`` form is for the current API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# ``jax.shard_map`` (with check_vma) is the current spelling; the
+# experimental module (with check_rep) is the 0.4.x one.
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+try:  # pallas may be absent on exotic builds; degrade to None markers
+    from jax.experimental.pallas import tpu as _pltpu
+except Exception:  # noqa: BLE001 - optional dependency surface
+    _pltpu = None
+
+# The TPU interpret machinery (InterpretParams: simulated inter-chip DMA
+# + real semaphore semantics) arrived after 0.4.x; its presence is the
+# discriminator between the faithful and the legacy interpreters.
+HAS_TPU_INTERPRET = _pltpu is not None and hasattr(_pltpu, "InterpretParams")
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` on current jax; the experimental spelling (with
+    ``check_vma`` mapped onto ``check_rep``) on 0.4.x."""
+    if HAS_NATIVE_SHARD_MAP:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=bool(check_vma), **kw,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=bool(check_vma), **kw,
+    )
+
+
+def interpret_params():
+    """The value for ``pallas_call(interpret=...)`` requesting interpret
+    mode: ``InterpretParams()`` (faithful TPU interpreter) when available,
+    else ``True`` (the legacy interpreter)."""
+    if HAS_TPU_INTERPRET:
+        return _pltpu.InterpretParams()
+    return True
+
+
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` / legacy ``pltpu.TPUCompilerParams``."""
+    if _pltpu is None:
+        raise RuntimeError("pallas TPU backend unavailable")
+    cls = getattr(_pltpu, "CompilerParams", None) or getattr(
+        _pltpu, "TPUCompilerParams"
+    )
+    return cls(**kw)
+
+
+def dma_device_id(axis: str, idx, legacy_interpret: bool = False):
+    """Remote-copy target: the named ``{axis: idx}`` form everywhere
+    EXCEPT under the legacy interpreter (its discharge rule all_gathers
+    the raw value and cannot traverse a dict). The caller passes the
+    legacy condition it already computed (``not kernel_flow_control``):
+    keying on jax version alone would hand the scalar form to real
+    hardware on old jax, where only the named form identifies the
+    neighbor's coordinate on multi-axis meshes."""
+    if legacy_interpret:
+        return idx
+    return {axis: idx}
+
+
+def kernel_flow_control(interpret: bool) -> bool:
+    """Whether a ring kernel should execute its semaphore flow control
+    (neighbor barrier + capacity semaphores). Always on for hardware;
+    off only under the LEGACY interpreter, which cannot express remote
+    signals and evaluates the schedule lockstep anyway (see module
+    docstring)."""
+    return not (interpret and not HAS_TPU_INTERPRET)
+
+
+def _legacy_axis_size(axis_name):
+    """``lax.axis_size`` for 0.4.x: ``core.axis_frame(name)`` returns the
+    bound size of a named mesh axis there."""
+    from jax._src import core as _core
+
+    return _core.axis_frame(axis_name)
+
+
+def install_jax_aliases() -> None:
+    """Give older jax the current spellings — ``jax.shard_map``
+    (accepting ``check_vma``) and ``jax.lax.axis_size`` — so downstream
+    code and tests written against the current API run unmodified. No-op
+    on current jax."""
+    if not HAS_NATIVE_SHARD_MAP:
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _legacy_axis_size

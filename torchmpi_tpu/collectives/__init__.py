@@ -74,8 +74,11 @@ def reduce_tensor(x, root=0, comm=None):
     return _dispatch("reduce", x, comm, "sync", root=root)
 
 
-def allreduce_tensor(x, comm=None):
-    return _dispatch("allreduce", x, comm, "sync")
+def allreduce_tensor(x, comm=None, wire_dtype=None):
+    """Sum-allreduce. ``wire_dtype`` ('full' | 'bf16' | 'int8') overrides
+    the wire format for the bandwidth path (None = constants default;
+    engages only for f32 payloads above wire_quant_min_elements)."""
+    return _dispatch("allreduce", x, comm, "sync", wire_dtype=wire_dtype)
 
 
 def allgather_tensor(x, comm=None):
@@ -86,14 +89,15 @@ def sendreceive_tensor(x, src, dst, comm=None):
     return _dispatch("sendreceive", x, comm, "sync", src=src, dst=dst)
 
 
-def reducescatter_tensor(x, comm=None):
+def reducescatter_tensor(x, comm=None, wire_dtype=None):
     """Reduce-scatter over the LAST dim (dual of ``allgather_tensor``'s
     concat-last-dim contract): rank r's output block is slice r of the
     elementwise sum. Beyond the reference's surface (it has no
     reduce-scatter collective; its ring used one internally,
     ``lib/detail/collectives.cpp:128-326``) — exposed because ZeRO-style
-    sharded optimizers consume it directly."""
-    return _dispatch("reducescatter", x, comm, "sync")
+    sharded optimizers consume it directly. ``wire_dtype`` as in
+    :func:`allreduce_tensor`."""
+    return _dispatch("reducescatter", x, comm, "sync", wire_dtype=wire_dtype)
 
 
 def alltoall_tensor(x, comm=None):
@@ -124,8 +128,11 @@ class _BackendNS:
     def reduce_tensor(self, x, root=0, comm=None):
         return _dispatch("reduce", x, comm, self._mode, self._backend, root=root)
 
-    def allreduce_tensor(self, x, comm=None):
-        return _dispatch("allreduce", x, comm, self._mode, self._backend)
+    def allreduce_tensor(self, x, comm=None, wire_dtype=None):
+        return _dispatch(
+            "allreduce", x, comm, self._mode, self._backend,
+            wire_dtype=wire_dtype,
+        )
 
     def allgather_tensor(self, x, comm=None):
         return _dispatch("allgather", x, comm, self._mode, self._backend)
@@ -135,8 +142,11 @@ class _BackendNS:
             "sendreceive", x, comm, self._mode, self._backend, src=src, dst=dst
         )
 
-    def reducescatter_tensor(self, x, comm=None):
-        return _dispatch("reducescatter", x, comm, self._mode, self._backend)
+    def reducescatter_tensor(self, x, comm=None, wire_dtype=None):
+        return _dispatch(
+            "reducescatter", x, comm, self._mode, self._backend,
+            wire_dtype=wire_dtype,
+        )
 
     def alltoall_tensor(self, x, comm=None):
         return _dispatch("alltoall", x, comm, self._mode, self._backend)

@@ -209,14 +209,16 @@ def broadcast_plan(nelem: int, dtype, platform: str) -> Tuple[bool, int]:
     return False, int(k)
 
 
-def _pallas_reduce_scatter_lastdim(b, axis: str):
+def _pallas_reduce_scatter_lastdim(b, axis: str, wire_dtype=None):
     """Scatter-along-last-dim reduce-scatter (dual of the allgather
     contract) on a [1, ..., d] per-rank block via the pallas RS ring, which
     scatters dim 0 with psum_scatter tiled semantics."""
     from ..ops.ring_kernels import ring_reduce_scatter_pallas
 
     moved = jnp.moveaxis(b[0], -1, 0)  # [d, ...]
-    mine = ring_reduce_scatter_pallas(moved, axis)  # [d/p, ...]
+    mine = ring_reduce_scatter_pallas(
+        moved, axis, wire_dtype=wire_dtype
+    )  # [d/p, ...]
     return jnp.moveaxis(mine, 0, -1)[None]
 
 
@@ -232,21 +234,24 @@ def _pallas_allgather_lastdim(b, axis: str):
     return moved.reshape(b.shape[:-1] + (moved.shape[-2] * moved.shape[-1],))
 
 
-def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ()):
+def _kernels(op: str, backend: str, root: int, extra: Tuple,
+             tuning: Tuple = (), wire: str = "full"):
     """Return a kernel fn(block) for the given op/backend.
 
     For ``backend='ring'`` broadcasts, ``extra`` carries the tree-vs-pipeline
     decision (made in :func:`run` from the platform-appropriate constant, so
     it participates in the executable cache key — ``collectives.cpp:58-64``'s
     4MB switch) plus the pipelined chunk count; ``tuning`` carries
-    (min_bytes, max_bytes, num_buffers) for byte-bounded ring chunking."""
+    (min_bytes, max_bytes, num_buffers) for byte-bounded ring chunking;
+    ``wire`` the resolved wire format for the bandwidth-path reductions."""
     minb, maxb, nbuf = tuning if tuning else (None, None, 1)
+    wire_arg = wire if wire != "full" else None
 
     def _ring_allreduce(b):
         return prim.ring_allreduce(
             b, _AXIS,
             max_bytes_per_step=maxb, min_bytes_per_step=minb,
-            num_buffers=nbuf,
+            num_buffers=nbuf, wire_dtype=wire_arg,
         )
 
     def _ring_reduce(b):
@@ -296,7 +301,7 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             "allgather": lambda b: prim.ring_allgather(b, _AXIS, dim=-1),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
             "reducescatter": lambda b: prim.ring_reduce_scatter(
-                b, _AXIS, dim=-1
+                b, _AXIS, dim=-1, wire_dtype=wire_arg
             ),
             "alltoall": lambda b: prim.ring_alltoall(b[0], _AXIS)[None],
         }
@@ -315,11 +320,17 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
         _pallas_bcast = _bcast_builder(
             lambda b, k: ring_broadcast_pallas(b, root, _AXIS, num_chunks=k)
         )
-        _pallas_allreduce = (
-            ring_allreduce_bidir_pallas
-            if "bidir" in extra
-            else ring_allreduce_pallas
-        )
+        # a compressed wire pins the unidirectional kernel (the bidir
+        # ring has no quant path; run() drops the marker accordingly)
+        if wire_arg is not None:
+            def _pallas_allreduce(b, axis):
+                return ring_allreduce_pallas(b, axis, wire_dtype=wire_arg)
+        else:
+            _pallas_allreduce = (
+                ring_allreduce_bidir_pallas
+                if "bidir" in extra
+                else ring_allreduce_pallas
+            )
 
         table = {
             "allreduce": lambda b: _pallas_allreduce(b, _AXIS),
@@ -328,7 +339,7 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
             "allgather": lambda b: _pallas_allgather_lastdim(b, _AXIS),
             "sendreceive": lambda b: prim.sendreceive(b, extra[0], extra[1], _AXIS),
             "reducescatter": lambda b: _pallas_reduce_scatter_lastdim(
-                b, _AXIS
+                b, _AXIS, wire_arg
             ),
             # a single fused all_to_all IS one XLA collective already —
             # same rationale as sendreceive's ppermute path
@@ -341,6 +352,45 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple, tuning: Tuple = ())
     if op not in table:
         raise CollectiveArgumentError(f"unknown collective {op!r}")
     return table[op]
+
+
+# collectives the compressed wire formats apply to (the bandwidth-path
+# reductions; data movers are lossless by contract and stay verbatim)
+_WIRE_OPS = ("allreduce", "reducescatter")
+
+
+def resolve_wire_dtype(op: str, nelem: int, dtype,
+                       requested: Optional[str] = None) -> str:
+    """The wire-format routing decision for one eager call: the explicit
+    ``wire_dtype=`` argument wins, else the ``wire_dtype`` constant (the
+    autotuner's persisted pick); 'full' whenever the encoding cannot
+    engage — wrong op, non-f32 payload (ints pass through uncompressed,
+    exactness is their contract), or below the min-elements cutoff."""
+    wire = requested if requested is not None else constants.get("wire_dtype")
+    if wire in (None, "", "full"):
+        return "full"
+    if wire not in ("int8", "bf16"):
+        raise CollectiveArgumentError(
+            f"unknown wire_dtype {wire!r}; expected 'full', 'bf16' or 'int8'"
+        )
+    if op not in _WIRE_OPS:
+        return "full"
+    if jnp.dtype(dtype) != jnp.dtype(jnp.float32):
+        return "full"
+    if nelem < constants.get("wire_quant_min_elements"):
+        return "full"
+    return wire
+
+
+def _record_wire(op: str, nelem: int, dtype, wire: str) -> None:
+    """Feed the tracing counters: per-rank logical payload bytes vs the
+    bytes the chosen encoding puts on the wire per hop."""
+    from ..utils import tracing
+
+    itemsize = jnp.dtype(dtype).itemsize
+    block = constants.get("wire_quant_block_size")
+    wire_bytes = prim.wire_encoded_bytes(nelem, itemsize, wire, block)
+    tracing.wire_stats.record(op, wire, nelem * itemsize, wire_bytes)
 
 
 def op_route(op: str, nelem: int, platform: str, requested: str = "ring") -> str:
@@ -366,10 +416,23 @@ def run(
     src: int = 0,
     dst: int = 0,
     route_small: bool = True,
+    wire_dtype: Optional[str] = None,
 ):
-    """Synchronous eager collective on a rank-stacked array."""
+    """Synchronous eager collective on a rank-stacked array.
+
+    ``wire_dtype``: per-call wire-format override for the bandwidth-path
+    reductions ('full' | 'bf16' | 'int8'; None = the ``wire_dtype``
+    constant). See :func:`resolve_wire_dtype` for the engagement gates.
+    """
     x = jnp.asarray(x)
     _check_rank_stacked(x, comm)
+    if wire_dtype not in (None, "full", "bf16", "int8"):
+        # validated unconditionally: a typo must not pass silently just
+        # because this call happened to route to the fused XLA path
+        raise CollectiveArgumentError(
+            f"unknown wire_dtype {wire_dtype!r}; expected 'full', 'bf16' "
+            "or 'int8'"
+        )
     if op in ("broadcast", "reduce") and not 0 <= root < comm.size:
         raise CollectiveArgumentError(f"root {root} out of range")
     if op == "allgather" and x.ndim == 1:
@@ -407,6 +470,14 @@ def run(
                 effective = "ring"
         elif jnp.dtype(dt).kind == "c":
             effective = "ring"
+    # wire-format decision (made once, BEFORE the hierarchical split, so
+    # flat and hierarchical routes ship the same bytes) + byte accounting
+    wire = "full"
+    if effective in ("ring", "pallas") and op in _WIRE_OPS:
+        wire = resolve_wire_dtype(
+            op, _nelem_per_rank(x), jnp.result_type(x), wire_dtype
+        )
+        _record_wire(op, _nelem_per_rank(x), jnp.result_type(x), wire)
     hier = (
         effective in ("ring", "pallas")
         # route_small=False pins the EXACT backend (tester/autotuner
@@ -431,9 +502,12 @@ def run(
                 # (the reference's staged path still ran its custom IPC
                 # rings inside the node, collectives_cuda.cpp:390-683)
                 return run_hierarchical_allreduce(
-                    x, comm, impl="staged", staged_intra=effective
+                    x, comm, impl="staged", staged_intra=effective,
+                    wire=wire,
                 )
-            return run_hierarchical_allreduce(x, comm, impl=effective)
+            return run_hierarchical_allreduce(
+                x, comm, impl=effective, wire=wire
+            )
         if op in ("broadcast", "reduce", "allgather"):
             return run_hierarchical_collective(
                 op, x, comm, root=root, ring_impl=effective
@@ -442,15 +516,19 @@ def run(
         # non-cartesian (ragged/tree) comms: grouped reduce + roots
         # exchange + the trailing intra broadcast
         # (collectives_cuda.cpp:569-579)
-        return run_tree_hierarchical_allreduce(x, comm)
+        return run_tree_hierarchical_allreduce(x, comm, wire=wire)
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
     if (
         effective == "pallas"
         and op == "allreduce"
         and constants.get("ring_implementation") == "pallas_bidir"
+        and wire == "full"
     ):
         # bidirectional-ring variant; participates in the executable cache
-        # key via ``extra`` so toggling the constant recompiles
+        # key via ``extra`` so toggling the constant recompiles. The
+        # quantized wire runs the unidirectional kernel (the bidir ring
+        # has no quant path); dropping the marker here keeps the cache
+        # key honest about which kernel actually compiled.
         extra = extra + ("bidir",)
     tuning: Tuple = ()
     if effective in ("ring", "pallas"):
@@ -458,15 +536,23 @@ def run(
     if effective in ("ring", "pallas") and op == "broadcast":
         tree, k = broadcast_plan(_nelem_per_rank(x), jnp.result_type(x), platform)
         extra = extra + (("tree",) if tree else ("pipeline", ("chunks", k)))
+    # block size participates in the key only when an encoding engages
+    # (toggling it must recompile the quantized executable, not the full
+    # one)
+    wire_key = (
+        (wire, constants.get("wire_quant_block_size"))
+        if wire != "full"
+        else ("full",)
+    )
     aval = (tuple(x.shape), jnp.result_type(x))
-    static = (root,) + extra + (tuning,)
+    static = (root,) + extra + (tuning, wire_key)
     fn = _compile(
         comm,
         op,
         effective,
         aval,
         static,
-        lambda: _kernels(op, effective, root, extra, tuning),
+        lambda: _kernels(op, effective, root, extra, tuning, wire),
     )
     # Place the input on the communicator's devices (no-op if already there).
     sharding = _rank_sharding(comm, x.ndim)
@@ -582,7 +668,8 @@ def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
 
 
 def run_hierarchical_allreduce(
-    x, comm: Communicator, impl: str = "ring", staged_intra: str = "ring"
+    x, comm: Communicator, impl: str = "ring", staged_intra: str = "ring",
+    wire: str = "full",
 ):
     """Explicit two-level allreduce over a cartesian communicator: ring
     reduce within each intra group, ring across the inter dimension, then
@@ -603,7 +690,9 @@ def run_hierarchical_allreduce(
             "multiple intra groups of size > 1"
         )
     if impl == "staged":
-        return _run_staged_hierarchical_allreduce(x, comm, staged_intra)
+        return _run_staged_hierarchical_allreduce(
+            x, comm, staged_intra, wire
+        )
     donate = constants.get("donate_eager_buffers")
     tuning = (
         ring_tuning(comm._devices[0].platform)
@@ -615,18 +704,24 @@ def run_hierarchical_allreduce(
     bidir = (
         impl == "pallas"
         and constants.get("ring_implementation") == "pallas_bidir"
+        and wire == "full"
     )
+    wire_arg = wire if wire != "full" else None
     key = (
         "hier_allreduce", impl, tuple(x.shape), jnp.result_type(x), donate,
         tuning, bidir,
+        (wire, constants.get("wire_quant_block_size"))
+        if wire != "full" else ("full",),
     )
 
     if impl == "pallas":
         # intra = ICI: the Pallas RDMA ring (uni- or bidirectional per
         # ring_implementation); inter = cross-ICI/DCN: the ppermute ring
         # (XLA schedules it over the slower fabric) — the reference's
-        # intra-IPC-ring x inter-MPI split.
-        intra_ring, _ = _pallas_intra_ring()
+        # intra-IPC-ring x inter-MPI split. The wire format applies to
+        # BOTH levels: the inter hop is the slowest fabric, exactly where
+        # compression pays most.
+        intra_ring, _ = _pallas_intra_ring(wire_arg)
         minb, maxb, nbuf = tuning
 
         def kernel(b):
@@ -634,7 +729,7 @@ def run_hierarchical_allreduce(
             return prim.ring_allreduce(
                 b, "inter",
                 max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf,
+                num_buffers=nbuf, wire_dtype=wire_arg,
             )
     elif impl == "ring":
         minb, maxb, nbuf = tuning
@@ -643,12 +738,12 @@ def run_hierarchical_allreduce(
             b = prim.ring_allreduce(
                 b, "intra",
                 max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf,
+                num_buffers=nbuf, wire_dtype=wire_arg,
             )
             return prim.ring_allreduce(
                 b, "inter",
                 max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf,
+                num_buffers=nbuf, wire_dtype=wire_arg,
             )
     else:
         def kernel(b):
@@ -657,17 +752,23 @@ def run_hierarchical_allreduce(
     return _hier_compile(comm, key, x.ndim, donate, kernel)(x)
 
 
-def _pallas_intra_ring():
+def _pallas_intra_ring(wire_arg: Optional[str] = None):
     """(ring_fn, bidir) for the intra (ICI) allreduce phase when the
     selector routed 'pallas' — uni- or bidirectional per
     ``ring_implementation``. The ONE selection site shared by the direct
     and staged hierarchical paths, so their intra transports can never
-    diverge."""
+    diverge. A compressed ``wire_arg`` pins the unidirectional quantized
+    kernel (the bidir ring has no quant path)."""
     from ..ops.ring_kernels import (
         ring_allreduce_bidir_pallas,
         ring_allreduce_pallas,
     )
 
+    if wire_arg is not None:
+        def quant_ring(b, axis):
+            return ring_allreduce_pallas(b, axis, wire_dtype=wire_arg)
+
+        return quant_ring, False
     bidir = constants.get("ring_implementation") == "pallas_bidir"
     return (
         ring_allreduce_bidir_pallas if bidir else ring_allreduce_pallas,
@@ -676,7 +777,7 @@ def _pallas_intra_ring():
 
 
 def _run_staged_hierarchical_allreduce(
-    x, comm: Communicator, intra_impl: str = "ring"
+    x, comm: Communicator, intra_impl: str = "ring", wire: str = "full"
 ):
     """Host-staged cross-group allreduce — the TPU analog of
     ``allreducep2pCrossNodesViaCPU`` (staged-via-pinned-CPU,
@@ -697,13 +798,17 @@ def _run_staged_hierarchical_allreduce(
     """
     cache = _resource_cache(comm)
     tuning = ring_tuning(comm._devices[0].platform)
+    wire_arg = wire if wire != "full" else None
     bidir = (
         intra_impl == "pallas"
         and constants.get("ring_implementation") == "pallas_bidir"
+        and wire_arg is None
     )
     key = (
         "staged_allreduce", intra_impl, bidir, tuple(x.shape),
         jnp.result_type(x), tuning,
+        (wire, constants.get("wire_quant_block_size"))
+        if wire_arg else ("full",),
     )
     entry = cache.get(key)
     if entry is None:
@@ -714,7 +819,7 @@ def _run_staged_hierarchical_allreduce(
         minb, maxb, nbuf = tuning
 
         if intra_impl == "pallas":
-            intra_ring, _ = _pallas_intra_ring()
+            intra_ring, _ = _pallas_intra_ring(wire_arg)
 
             def intra_kernel(b):
                 return intra_ring(b, "intra")
@@ -723,7 +828,7 @@ def _run_staged_hierarchical_allreduce(
                 return prim.ring_allreduce(
                     b, "intra",
                     max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                    num_buffers=nbuf,
+                    num_buffers=nbuf, wire_dtype=wire_arg,
                 )
 
         shmapped = jax.shard_map(
@@ -980,7 +1085,8 @@ def _binomial_reduce_steps(groups, p: int):
     return steps
 
 
-def run_tree_hierarchical_allreduce(x, comm: Communicator):
+def run_tree_hierarchical_allreduce(x, comm: Communicator,
+                                    wire: str = "full"):
     """Hierarchical allreduce on a NON-cartesian (ragged/tree) communicator
     — the reference's non-cartesian path (intra reduce to group root, inter
     exchange among roots, final intra broadcast,
@@ -992,6 +1098,11 @@ def run_tree_hierarchical_allreduce(x, comm: Communicator):
     root, reduce across the roots to the global root, then a static
     cross-device gather broadcasts the total — the trailing broadcast of
     the reference, collapsed to one hop.
+
+    A compressed ``wire`` encodes every binomial exchange hop (partials
+    quantized on send, f32 accumulate — non-target ranks receive zeros,
+    which decode to exact zeros); only the final one-hop gather broadcast
+    ships full precision.
     """
     x = jnp.asarray(x)
     _check_rank_stacked(x, comm)
@@ -1001,7 +1112,12 @@ def run_tree_hierarchical_allreduce(x, comm: Communicator):
         )
     cache = _resource_cache(comm)
     donate = constants.get("donate_eager_buffers")
-    key = ("tree_hier_allreduce", tuple(x.shape), jnp.result_type(x), donate)
+    wire_arg = wire if wire != "full" else None
+    block = constants.get("wire_quant_block_size")
+    key = (
+        "tree_hier_allreduce", tuple(x.shape), jnp.result_type(x), donate,
+        (wire, block) if wire_arg else ("full",),
+    )
     fn = cache.get(key)
     if fn is None:
         p = comm.size
@@ -1015,7 +1131,13 @@ def run_tree_hierarchical_allreduce(x, comm: Communicator):
 
         def kernel(b):
             for perm, mask in schedule:
-                recv = lax.ppermute(b, _AXIS, perm)  # non-targets get zeros
+                if wire_arg:
+                    # non-targets receive zero q/scales -> decode to 0
+                    recv = prim._wire_send_recv(
+                        b, _AXIS, perm, wire_arg, block
+                    )
+                else:
+                    recv = lax.ppermute(b, _AXIS, perm)  # non-targets: 0
                 receives = jnp.take(
                     jnp.asarray(mask), lax.axis_index(_AXIS)
                 )

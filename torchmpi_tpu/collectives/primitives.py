@@ -98,6 +98,89 @@ def barrier_value(axis: str = "mpi"):
 
 
 # ---------------------------------------------------------------------------
+# Block-quantized wire format (EQuARX-style, arXiv:2506.17615): the
+# bandwidth-path rings optionally ship each per-step message as int8 with
+# one fp32 scale per block (or as a bf16 cast), summing in an fp32
+# accumulator and dequantizing once at the end. Compression lives in the
+# collective composition layer (HiCCL's argument, arXiv:2408.05962), not
+# in the model: callers opt in via wire_dtype= or the constants default.
+# ---------------------------------------------------------------------------
+
+#: wire encodings the rings understand ('full' = ship the dtype verbatim)
+WIRE_DTYPES = ("full", "bf16", "int8")
+
+# smallest positive scale: a zero block must not divide by zero, and the
+# dequantized zeros stay exactly zero
+_SCALE_FLOOR = 1e-30
+
+
+def quantize_blocks(x, block: int):
+    """Quantize a float32 tensor to ``(q_int8, scales_f32, n)``: flattened,
+    zero-padded to whole blocks of ``block`` elements, one symmetric scale
+    ``amax/127`` per block. Exact for blocks whose values are all equal
+    (the tester's closed-form inputs) and for zeros."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = -n % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    b = flat.reshape(-1, block)
+    scale = jnp.maximum(jnp.max(jnp.abs(b), axis=1, keepdims=True),
+                        _SCALE_FLOOR) / 127.0
+    q = jnp.round(b / scale).astype(jnp.int8)
+    return q, scale.astype(jnp.float32), n
+
+
+def dequantize_blocks(q, scale, n: int, shape=None):
+    """Inverse of :func:`quantize_blocks`; returns f32 of ``shape`` (flat
+    length ``n`` when shape is None)."""
+    out = (q.astype(jnp.float32) * scale).reshape(-1)[:n]
+    return out if shape is None else out.reshape(shape)
+
+
+def _wire_send_recv(buf, axis, perm, wire: str, block: int):
+    """Encode ``buf`` for the wire, one-hop ppermute it, decode. The
+    single quantize/transport/dequantize building block every quantized
+    ring step uses — RS steps add the result into their f32 partial
+    (higher-precision accumulate), AG steps install it verbatim."""
+    if wire == "int8":
+        q, scale, n = quantize_blocks(buf, block)
+        q = lax.ppermute(q, axis, perm)
+        scale = lax.ppermute(scale, axis, perm)
+        return dequantize_blocks(q, scale, n, buf.shape)
+    if wire == "bf16":
+        recv = lax.ppermute(buf.astype(jnp.bfloat16), axis, perm)
+        return recv.astype(jnp.float32)
+    return lax.ppermute(buf, axis, perm)
+
+
+def wire_encoded_bytes(nelem: int, itemsize: int, wire: str,
+                       block: int) -> int:
+    """On-wire bytes for ``nelem`` elements under a wire encoding (the
+    tracing counters' accounting model: int8 payload padded to whole
+    blocks + one f32 scale per block)."""
+    if wire == "int8":
+        nblocks = -(-max(1, nelem) // block)
+        return nblocks * block + nblocks * 4
+    if wire == "bf16":
+        return nelem * 2
+    return nelem * itemsize
+
+
+def wire_engages(wire: Optional[str], dtype, nelem: int) -> bool:
+    """Whether a compressed wire format actually applies: only f32
+    payloads (ints/bools pass through uncompressed — exactness is their
+    contract) at or above the min-elements cutoff."""
+    from .. import constants
+
+    return (
+        wire in ("int8", "bf16")
+        and jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+        and nelem >= constants.get("wire_quant_min_elements")
+    )
+
+
+# ---------------------------------------------------------------------------
 # Custom ring algorithms (the reference's p2p path, TPU-native)
 # ---------------------------------------------------------------------------
 
@@ -149,6 +232,37 @@ def _ring_phases(chunks, axis: str, p: int, r, perm, nb: int):
     return lax.fori_loop(0, p - 1, ag_step, chunks)
 
 
+def _ring_phases_wire(chunks, axis: str, p: int, r, perm, wire: str,
+                      block: int):
+    """Reduce-scatter + all-gather ring phases with a compressed wire
+    format: every hop encodes its outgoing chunk (int8 + per-block f32
+    scales, or a bf16 cast), the RS phase accumulates the DECODED values
+    into the f32 partials, and the AG phase forwards reduced chunks the
+    same way — re-encoding a just-decoded chunk reproduces the same code
+    points, so AG forwarding is lossless up to fp rounding. ``chunks``:
+    [p, chunk] f32; same fori_loop step structure as :func:`_ring_phases`
+    so the two schedules can be compared line for line."""
+
+    def rs_step(s, ch):
+        send_idx = (r - s) % p
+        recv_idx = (r - s - 1) % p
+        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
+        recv = _wire_send_recv(buf, axis, perm, wire, block)
+        upd = lax.dynamic_index_in_dim(ch, recv_idx, keepdims=False) + recv
+        return lax.dynamic_update_index_in_dim(ch, upd, recv_idx, 0)
+
+    chunks = lax.fori_loop(0, p - 1, rs_step, chunks)
+
+    def ag_step(s, ch):
+        send_idx = (r + 1 - s) % p
+        recv_idx = (r - s) % p
+        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
+        recv = _wire_send_recv(buf, axis, perm, wire, block)
+        return lax.dynamic_update_index_in_dim(ch, recv, recv_idx, 0)
+
+    return lax.fori_loop(0, p - 1, ag_step, chunks)
+
+
 def ring_allreduce(
     x,
     axis: str = "mpi",
@@ -156,6 +270,8 @@ def ring_allreduce(
     max_bytes_per_step: Optional[int] = None,
     min_bytes_per_step: Optional[int] = None,
     num_buffers: int = 1,
+    wire_dtype: Optional[str] = None,
+    wire_block: Optional[int] = None,
 ):
     """Chunked ring allreduce: (p-1) reduce-scatter steps then (p-1)
     all-gather steps, the schedule memoized by the reference as a "plan"
@@ -175,6 +291,13 @@ def ring_allreduce(
     travel the ring concurrently (pipelining depth ≙
     ``kNumBuffersPerCollective``), waves of segments are scanned
     sequentially.
+
+    ``wire_dtype`` ('int8' | 'bf16') selects the compressed wire format
+    for f32 payloads above the ``wire_quant_min_elements`` cutoff
+    (``wire_block`` elements per scale block; constants default). The
+    quantized path keeps f32 accumulation and takes the unsegmented
+    route (one chunk per ring step — the encode/decode already bounds
+    the per-step wire bytes).
     """
     p = axis_size or lax.axis_size(axis)
     if p == 1:
@@ -184,6 +307,16 @@ def ring_allreduce(
     itemsize = jnp.dtype(x.dtype).itemsize
     n = int(np.prod(x.shape)) if x.shape else 1
     chunk = -(-n // p)
+
+    if wire_engages(wire_dtype, x.dtype, n):
+        from .. import constants
+
+        block = wire_block or constants.get("wire_quant_block_size")
+        flat, n, chunk = _flatten_pad(x, p)
+        out = _ring_phases_wire(
+            flat.reshape(p, chunk), axis, p, r, perm, wire_dtype, block
+        )
+        return out.reshape(-1)[:n].reshape(x.shape)
 
     if max_bytes_per_step is None or chunk * itemsize <= max_bytes_per_step:
         flat, n, chunk = _flatten_pad(x, p)
@@ -286,6 +419,7 @@ def ring_reduce(
     max_bytes_per_step: Optional[int] = None,
     min_bytes_per_step: Optional[int] = None,
     num_buffers: int = 1,
+    wire_dtype: Optional[str] = None,
 ):
     """Reduce-to-root as ring reduce-scatter + gather-to-root; implemented as
     ring_allreduce masked to root (the reference reduces via the same plan)."""
@@ -296,18 +430,23 @@ def ring_reduce(
         max_bytes_per_step=max_bytes_per_step,
         min_bytes_per_step=min_bytes_per_step,
         num_buffers=num_buffers,
+        wire_dtype=wire_dtype,
     )
     idx = lax.axis_index(axis)
     return jnp.where(idx == root, total, x)
 
 
 def ring_reduce_scatter(
-    x, axis: str = "mpi", dim: int = -1, axis_size: Optional[int] = None
+    x, axis: str = "mpi", dim: int = -1, axis_size: Optional[int] = None,
+    wire_dtype: Optional[str] = None, wire_block: Optional[int] = None,
 ):
     """Reduce-scatter over ``dim`` as the (p-1)-step reduce-scatter phase of
     the ring (``lib/detail/collectives.cpp:128-326``'s first half, standalone):
     rank r returns slice r of the summed tensor (``lax.psum_scatter`` tiled
-    semantics). ``x.shape[dim]`` must be divisible by the axis size."""
+    semantics). ``x.shape[dim]`` must be divisible by the axis size.
+    ``wire_dtype`` selects the compressed wire format for f32 payloads
+    (same contract as :func:`ring_allreduce`): each hop's partial slice is
+    encoded on send and the f32 partial accumulates the decoded values."""
     p = axis_size or lax.axis_size(axis)
     if dim < 0:
         dim = x.ndim + dim
@@ -322,6 +461,14 @@ def ring_reduce_scatter(
     perm = [(i, (i + 1) % p) for i in range(p)]
     moved = jnp.moveaxis(x, dim, 0)  # [d, ...]
     ch = moved.reshape((p, moved.shape[0] // p) + moved.shape[1:])
+    nelem = int(np.prod(x.shape)) if x.shape else 1
+    wire = None
+    if wire_engages(wire_dtype, x.dtype, nelem):
+        from .. import constants
+
+        wire = wire_dtype
+        block = wire_block or constants.get("wire_quant_block_size")
+        ch = ch.astype(jnp.float32)
 
     def rs_step(s, ch):
         # schedule shifted one slot vs the allreduce RS phase so rank r
@@ -330,13 +477,16 @@ def ring_reduce_scatter(
         send_idx = (r - s - 1) % p
         recv_idx = (r - s - 2) % p
         buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
-        recv = lax.ppermute(buf, axis, perm)
+        if wire:
+            recv = _wire_send_recv(buf, axis, perm, wire, block)
+        else:
+            recv = lax.ppermute(buf, axis, perm)
         upd = lax.dynamic_index_in_dim(ch, recv_idx, keepdims=False) + recv
         return lax.dynamic_update_index_in_dim(ch, upd, recv_idx, 0)
 
     ch = lax.fori_loop(0, p - 1, rs_step, ch)
     mine = lax.dynamic_index_in_dim(ch, r, keepdims=False)  # [d/p, ...]
-    return jnp.moveaxis(mine, 0, dim)
+    return jnp.moveaxis(mine, 0, dim).astype(x.dtype)
 
 
 def alltoall(x, axis: str = "mpi", split_dim: int = 0, concat_dim: int = 0):

@@ -57,6 +57,21 @@ def backend_availability() -> Dict[str, bool]:
     }
 
 
+# single-home re-exports (primitives owns the encodings, eager owns the
+# op set — re-deriving them here would let the dump drift from dispatch)
+from .eager import _WIRE_OPS as WIRE_COLLECTIVES  # noqa: E402
+from .primitives import WIRE_DTYPES as WIRE_FORMATS  # noqa: E402
+
+
+def wire_format_availability() -> Dict[str, bool]:
+    """Which wire encodings the custom-ring backends can put on the wire
+    (every encoding is implemented on both the ppermute and pallas rings,
+    so availability tracks the backends, not the formats)."""
+    avail = backend_availability()
+    custom = avail["ring"] or avail["pallas"]
+    return {"full": True, "bf16": custom, "int8": custom}
+
+
 # Preference order per (platform, nodes, mode, collective).
 # Mirrors the reference's choices in spirit: single-node sync allreduce
 # prefers the custom ring (its cudaIPC ring beat NCCL, README.md:104-106);
@@ -118,11 +133,42 @@ class CollectiveSelector:
                 return b
         return "xla"
 
+    def select_wire(self, collective: str, nelem: int = None,
+                    dtype=None) -> str:
+        """The wire format an eager call of ``collective`` would ship:
+        the ``wire_dtype`` constant (the autotuner's persisted pick)
+        gated by the engagement rules. ``nelem``/``dtype`` None = assume
+        a large f32 payload (the routing question, not a specific call).
+        """
+        import jax.numpy as jnp
+
+        from .. import constants
+        from .eager import resolve_wire_dtype
+
+        if nelem is None:
+            nelem = constants.get("wire_quant_min_elements")
+        return resolve_wire_dtype(
+            collective, nelem, dtype if dtype is not None else jnp.float32
+        )
+
     def describe(self) -> str:
+        from .. import constants
+
         avail = backend_availability()
         lines = ["Backend availability: " + ", ".join(
             f"{k}={'yes' if v else 'no'}" for k, v in avail.items()
         )]
+        wf = wire_format_availability()
+        lines.append(
+            "Wire formats (fp32 "
+            + "/".join(WIRE_COLLECTIVES)
+            + " >= wire_quant_min_elements): "
+            + ", ".join(f"{k}={'yes' if v else 'no'}" for k, v in wf.items())
+            + f" -> default {constants.get('wire_dtype')}"
+        )
+        for coll in WIRE_COLLECTIVES:
+            # what a large f32 payload of this collective would ship
+            lines.append(f"wire.{coll}: -> {self.select_wire(coll)}")
         for platform, nodes_tbl in self.table.items():
             for nodes, mode_tbl in nodes_tbl.items():
                 for mode, coll_tbl in mode_tbl.items():

@@ -102,6 +102,24 @@ class _Constants:
     # the input array.
     donate_eager_buffers: bool = False
 
+    # --- wire format for the bandwidth-path reductions (EQuARX-style) ---
+    # Default on-wire encoding for ring allreduce / reduce-scatter of
+    # float32 payloads: 'full' (ship fp32 verbatim), 'bf16' (cast on
+    # send, accumulate in f32), or 'int8' (block-quantized with a
+    # per-block scale, f32 accumulate, requantize per hop). Opt-in
+    # per-call via wire_dtype=; the autotuner measures and persists the
+    # winner per (platform, world size).
+    wire_dtype: str = "full"
+    # Elements per quantization block (one shared scale each) for the
+    # ppermute ring. The Pallas kernels always quantize per 128-lane row
+    # (the sublane layout IS the block grid there); the default of 128
+    # keeps both backends on the same grid.
+    wire_quant_block_size: int = 128
+    # Per-rank element count below which compressed wire formats are
+    # bypassed: small payloads are latency-bound (op_route sends them to
+    # the fused XLA path anyway) and the scale overhead erodes the win.
+    wire_quant_min_elements: int = 1 << 16
+
 
 _frozen = False
 _lock = threading.Lock()

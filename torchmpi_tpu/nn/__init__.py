@@ -119,13 +119,23 @@ def synchronize_gradients(
     comm: Optional[Communicator] = None,
     average: bool = False,
     fused: bool = True,
+    wire_dtype: Optional[str] = None,
 ):
-    """Sum-allreduce every gradient leaf (``nn.lua:49-56``)."""
+    """Sum-allreduce every gradient leaf (``nn.lua:49-56``).
+
+    ``wire_dtype`` ('full' | 'bf16' | 'int8'; None = constants default)
+    selects the on-wire encoding for the bandwidth-path allreduce —
+    int8 ships block-quantized gradients with f32 accumulation (EQuARX-
+    style), engaging only for f32 buffers above the tuned cutoff. Integer
+    leaves always travel uncompressed (their dtype group resolves to
+    'full')."""
     comm = _comm(comm)
     p = comm.size
 
     def sync_one(buf):
-        out = collectives.allreduce_tensor(buf, comm=comm)
+        out = collectives.allreduce_tensor(
+            buf, comm=comm, wire_dtype=wire_dtype
+        )
         return out / p if average else out
 
     if fused:
@@ -177,12 +187,14 @@ class GradientBuckets:
         grads,
         comm: Optional[Communicator] = None,
         backend: Optional[str] = None,
+        wire_dtype: Optional[str] = None,
     ) -> List[SyncHandle]:
         """Launch one async fused allreduce per bucket; returns handles in
         launch order (wait them in reverse, ``nn.lua:207-212``).
         ``backend`` optionally pins the collective backend (e.g. ``'ring'``
         to engage the hierarchical intra×inter composition on 2-level
-        communicators); default = selector choice."""
+        communicators); default = selector choice. ``wire_dtype`` selects
+        the per-bucket wire encoding (:func:`synchronize_gradients`)."""
         comm = _comm(comm)
         p = comm.size
         leaves = tree_util.tree_leaves(grads)
@@ -195,7 +207,10 @@ class GradientBuckets:
             # ring_implementation remap — that applies only to
             # selector-routed calls)
             handles.append(
-                collectives._dispatch("allreduce", buf, comm, "async", backend)
+                collectives._dispatch(
+                    "allreduce", buf, comm, "async", backend,
+                    wire_dtype=wire_dtype,
+                )
             )
         # Remember which communicator these collectives ran on so the
         # averaging divisor in wait_and_unflatten defaults correctly.
@@ -247,13 +262,19 @@ def in_graph_synchronize_gradients(grads, axis: str = "mpi", average: bool = Tru
 
 
 def in_graph_synchronize_gradients_bucketed(
-    grads, buckets: GradientBuckets, axis: str = "mpi", average: bool = True
+    grads, buckets: GradientBuckets, axis: str = "mpi", average: bool = True,
+    wire_dtype: Optional[str] = None,
 ):
     """Bucketed psum: one collective per bucket (per dtype) so XLA's
     async-collective scheduler can overlap buckets with remaining compute —
     the in-graph analog of registerAsyncMPIBackward's per-layer overlap.
     Leaves are grouped by dtype within each bucket so mixed-precision
-    gradients (bf16 weights + f32 norms) keep their dtypes exactly."""
+    gradients (bf16 weights + f32 norms) keep their dtypes exactly.
+
+    ``wire_dtype`` ('bf16' | 'int8') replaces the fused psum with the
+    compressed-wire ppermute ring for f32 buckets above the tuned cutoff
+    (block-quantized send, f32 accumulate) — the in-graph path of the
+    EQuARX-style wire format; other buckets keep the psum."""
     leaves = list(tree_util.tree_leaves(grads))
     n = lax.psum(1, axis) if average else 1
     for b in range(buckets.num_buckets):
@@ -263,7 +284,13 @@ def in_graph_synchronize_gradients_bucketed(
         for dtype, idxs in by_dtype.items():
             flats = [jnp.reshape(leaves[i], (-1,)) for i in idxs]
             splits = np.cumsum([f.shape[0] for f in flats])[:-1]
-            buf = lax.psum(jnp.concatenate(flats), axis)
+            cat = jnp.concatenate(flats)
+            from ..collectives import primitives as _prim
+
+            if _prim.wire_engages(wire_dtype, dtype, int(cat.shape[0])):
+                buf = _prim.ring_allreduce(cat, axis, wire_dtype=wire_dtype)
+            else:
+                buf = lax.psum(cat, axis)
             if average:
                 buf = (buf / n).astype(dtype)
             parts = jnp.split(buf, splits)

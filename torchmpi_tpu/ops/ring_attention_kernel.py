@@ -56,6 +56,13 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .._compat import (
+    dma_device_id,
+    interpret_params,
+    kernel_flow_control,
+    tpu_compiler_params,
+)
+
 NEG_INF = -1e30
 
 # VMEM footprint bound for one kernel invocation (q/k/v/o + 2x2 kv slots
@@ -113,6 +120,7 @@ def _ring_attn_kernel(
     causal: bool,
     scale: float,
     n: int,
+    fc: bool,
     my_ref,
     q_ref,
     k_ref,
@@ -148,15 +156,18 @@ def _ring_attn_kernel(
     vbuf[0] = v_ref[:]
 
     # neighbor barrier: nobody pushes until both neighbors arrived
-    barrier = pltpu.get_barrier_semaphore()
-    for nbr in (left, right):
-        pltpu.semaphore_signal(
-            barrier,
-            inc=1,
-            device_id={axis: nbr},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-    pltpu.semaphore_wait(barrier, 2)
+    # (skipped, with the capacity semaphores, under the legacy lockstep
+    # interpreter — _compat.kernel_flow_control)
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        for nbr in (left, right):
+            pltpu.semaphore_signal(
+                barrier,
+                inc=1,
+                device_id={axis: nbr},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        pltpu.semaphore_wait(barrier, 2)
 
     def block_merge(s: int, slot: int):
         """Attention of resident q against the slot's K/V block, merged
@@ -174,7 +185,7 @@ def _ring_attn_kernel(
         if s < p - 1:
             # the RIGHT neighbor computes on its slot ``nslot`` at step
             # s-1; wait for its consumed-signal before overwriting
-            if s >= 1:
+            if fc and s >= 1:
                 pltpu.semaphore_wait(cap_sem.at[nslot], 1)
             copies = tuple(
                 pltpu.make_async_remote_copy(
@@ -182,7 +193,7 @@ def _ring_attn_kernel(
                     dst_ref=buf.at[nslot],
                     send_sem=ssem.at[slot],
                     recv_sem=rsem.at[slot],
-                    device_id={axis: right},
+                    device_id=dma_device_id(axis, right, not fc),
                     device_id_type=pltpu.DeviceIdType.MESH,
                 )
                 for buf, ssem, rsem in (
@@ -195,7 +206,7 @@ def _ring_attn_kernel(
         block_merge(s, slot)  # compute overlaps the in-flight DMA
         for c in copies:
             c.wait()  # our send landed + next block fully arrived
-        if s < p - 2:
+        if fc and s < p - 2:
             # tell LEFT our slot is consumed (left overwrites it at its
             # step s+1). Strictly after the wait above: the outgoing DMA
             # reads this slot until the send completes, so an earlier
@@ -275,6 +286,57 @@ def _run_chunked(b, h, fits, sub, concat_axes, cell_bytes, budget, what):
     return tuple(jnp.concatenate(acc, axis=0) for acc in out_rows)
 
 
+def _ring_attention_fwd_xla(q, k, v, axis, causal, p, return_lse):
+    """ppermute-ring forward with the lse residual — the stand-in the
+    kernel wrappers use when the LEGACY pallas interpreter cannot run
+    remote DMA on a multi-axis mesh (``ring_kernels._legacy_multiaxis``).
+    Same streaming-softmax math as the kernels; XLA transport."""
+    b, n, h, d = q.shape
+    r = lax.axis_index(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    scale = 1.0 / math.sqrt(d)
+    q_pos = r * n + jnp.arange(n)
+    qf = q.astype(jnp.float32)
+
+    def step(s, carry):
+        o, m, l, kb, vb = carry
+        src = lax.rem(r - s + p, p)
+        k_pos = src * n + jnp.arange(n)
+        sij = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+            * scale
+        )
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            sij = jnp.where(mask[None, None], sij, NEG_INF)
+        mb = sij.max(-1)  # [b, h, q]
+        pexp = jnp.exp(sij - mb[..., None])
+        lb = pexp.sum(-1)
+        ob = jnp.einsum("bhqk,bkhd->bqhd", pexp, vb.astype(jnp.float32))
+        m_new = jnp.maximum(m, mb)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(mb - m_new)
+        l_new = l * alpha + lb * beta
+        o_new = (
+            o * alpha.transpose(0, 2, 1)[..., None]
+            + ob * beta.transpose(0, 2, 1)[..., None]
+        )
+        return (
+            o_new, m_new, l_new,
+            lax.ppermute(kb, axis, perm), lax.ppermute(vb, axis, perm),
+        )
+
+    o0 = jnp.zeros((b, n, h, d), jnp.float32)
+    m0 = jnp.full((b, h, n), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, n), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, p, step, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    if return_lse:
+        return out, m + jnp.log(l)
+    return out
+
+
 def _make_fwd(kernel_fn, vmem_bytes_fn, scratch_fn, collective_id, what):
     """Build a forward-ring entry point: ONE wrapper body (p == 1
     degenerate, batch/head auto-chunking, cell layout, pallas_call
@@ -303,6 +365,12 @@ def _make_fwd(kernel_fn, vmem_bytes_fn, scratch_fn, collective_id, what):
             from ..parallel.ring_attention import full_self_attention
 
             return full_self_attention(q, k, v, causal=causal)
+        from .ring_kernels import _legacy_multiaxis
+
+        if _legacy_multiaxis(interpret):
+            return _ring_attention_fwd_xla(
+                q, k, v, axis, causal, p, return_lse
+            )
         budget = vmem_budget_bytes or _VMEM_BUDGET_BYTES
         if vmem_bytes_fn(q.shape, q.dtype) > budget:
             def sub(bi, bb, hi, hh, prev):
@@ -332,7 +400,10 @@ def _make_fwd(kernel_fn, vmem_bytes_fn, scratch_fn, collective_id, what):
         to_cells = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, n, d)  # noqa: E731
         scale = 1.0 / math.sqrt(d)
         my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
-        kernel = functools.partial(kernel_fn, p, axis, causal, scale, n)
+        kernel = functools.partial(
+            kernel_fn, p, axis, causal, scale, n,
+            kernel_flow_control(interpret),
+        )
         out, lse = pl.pallas_call(
             kernel,
             out_shape=(
@@ -350,10 +421,10 @@ def _make_fwd(kernel_fn, vmem_bytes_fn, scratch_fn, collective_id, what):
                 pl.BlockSpec(memory_space=pltpu.VMEM),
             ),
             scratch_shapes=scratch_fn(bh, n, d, k.dtype, v.dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 collective_id=collective_id
             ),
-            interpret=pltpu.InterpretParams() if interpret else False,
+            interpret=interpret_params() if interpret else False,
         )(my, to_cells(q), to_cells(k), to_cells(v))
         out = out.reshape(b, h, n, d).transpose(0, 2, 1, 3)
         if return_lse:
@@ -413,6 +484,7 @@ def _ring_attn_bidir_kernel(
     causal: bool,
     scale: float,
     n: int,
+    fc: bool,
     my_ref,
     q_ref,
     k_ref,
@@ -466,15 +538,16 @@ def _ring_attn_bidir_kernel(
     kbufL[0] = k_ref[:]
     vbufL[0] = v_ref[:]
 
-    barrier = pltpu.get_barrier_semaphore()
-    for nbr in (left, right):
-        pltpu.semaphore_signal(
-            barrier,
-            inc=1,
-            device_id={axis: nbr},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-    pltpu.semaphore_wait(barrier, 2)
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        for nbr in (left, right):
+            pltpu.semaphore_signal(
+                barrier,
+                inc=1,
+                device_id={axis: nbr},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        pltpu.semaphore_wait(barrier, 2)
 
     # distances delivered per chain; nR >= nL, nR + nL = p - 1
     nR = (p - 1 + 1) // 2
@@ -494,7 +567,7 @@ def _ring_attn_bidir_kernel(
         all_copies = []
         for (bufs, sems, cap, dst, cap_to, ndist) in chains:
             if t < ndist:  # this chain still has a farther block to push
-                if t >= 1:
+                if fc and t >= 1:
                     pltpu.semaphore_wait(cap.at[nslot], 1)
                 sk, rk, sv, rv = sems
                 copies = tuple(
@@ -503,7 +576,7 @@ def _ring_attn_bidir_kernel(
                         dst_ref=buf.at[nslot],
                         send_sem=ssem.at[slot],
                         recv_sem=rsem.at[slot],
-                        device_id={axis: dst},
+                        device_id=dma_device_id(axis, dst, not fc),
                         device_id_type=pltpu.DeviceIdType.MESH,
                     )
                     for buf, ssem, rsem in (
@@ -529,17 +602,32 @@ def _ring_attn_bidir_kernel(
                 q_ref, kbufR, vbufR, slot, oacc, macc, lacc,
             )
             if t <= nL:
-                _flash_merge_cells(
-                    bh, n, my, lax.rem(my + t, p), causal, scale,
-                    q_ref, kbufL, vbufL, slot, oacc, macc, lacc,
-                )
+                if causal:
+                    # The L chain's block at step t originated on rank
+                    # my + t. Without wraparound (my + t < p) that rank
+                    # is strictly FUTURE, so every (q, k) pair is masked
+                    # and the merge is a numerical no-op (its beta
+                    # underflows to exactly 0) — skip the matmuls. Only
+                    # wrapped sources (my + t - p < my: past blocks)
+                    # contribute.
+                    @pl.when(my + t >= p)
+                    def _():
+                        _flash_merge_cells(
+                            bh, n, my, lax.rem(my + t, p), causal, scale,
+                            q_ref, kbufL, vbufL, slot, oacc, macc, lacc,
+                        )
+                else:
+                    _flash_merge_cells(
+                        bh, n, my, lax.rem(my + t, p), causal, scale,
+                        q_ref, kbufL, vbufL, slot, oacc, macc, lacc,
+                    )
         for copies, cap, cap_to, ndist in all_copies:
             for c in copies:
                 c.wait()
             # slot consumed + our outgoing read landed: upstream may
             # overwrite it at its next send. Its sends stop at t = ndist-1,
             # so signals stop one step earlier (semaphores end drained).
-            if t < ndist - 1:
+            if fc and t < ndist - 1:
                 pltpu.semaphore_signal(
                     cap.at[slot],
                     inc=1,
@@ -594,7 +682,17 @@ ring_attention_bidir_pallas = _make_fwd(
 ring_attention_bidir_pallas.__doc__ = """Forward ring attention with BOTH
 ICI directions carrying K/V chains (~half the steps of
 :func:`ring_attention_pallas`). Same call contract, residuals, and
-batch/head auto-chunking."""
+batch/head auto-chunking.
+
+Causal caveat: under ``causal=True`` the L chain mostly carries blocks
+from strictly-future ranks (source ``my + t`` with no wraparound), whose
+scores are fully masked. The kernel SKIPS the merge compute for those
+blocks (they are a numerical no-op either way), but their K/V bytes
+still travel the wire — so for causal attention the bidirectional
+variant halves the step count without halving useful wire traffic, and
+the unidirectional kernel can win on bandwidth-bound shapes. Measure
+(``utils.autotune``) rather than assume; the autotuner treats the
+direction choice as a knob for exactly this reason."""
 
 
 def _full_attention_with_lse(q, k, v, causal):
@@ -669,6 +767,7 @@ def _ring_attn_bwd_kernel(
     causal: bool,
     scale: float,
     n: int,
+    fc: bool,
     my_ref,
     q_ref,
     o_ref,
@@ -733,15 +832,16 @@ def _ring_attn_bwd_kernel(
 
     lax.fori_loop(0, bh, dinit, 0)
 
-    barrier = pltpu.get_barrier_semaphore()
-    for nbr in (left, right):
-        pltpu.semaphore_signal(
-            barrier,
-            inc=1,
-            device_id={axis: nbr},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-    pltpu.semaphore_wait(barrier, 2)
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        for nbr in (left, right):
+            pltpu.semaphore_signal(
+                barrier,
+                inc=1,
+                device_id={axis: nbr},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        pltpu.semaphore_wait(barrier, 2)
 
     def block_grad(s: int, slot: int):
         """Analytic flash gradients of the visiting block, accumulated
@@ -799,7 +899,7 @@ def _ring_attn_bwd_kernel(
         # forward the mutated payload; the right neighbor's slot must be
         # consumed (its step s-1 compute done AND its own send of that
         # slot landed — it signals after its c.wait())
-        if s >= 1:
+        if fc and s >= 1:
             pltpu.semaphore_wait(cap_sem.at[nslot], 1)
         copies = tuple(
             pltpu.make_async_remote_copy(
@@ -807,7 +907,7 @@ def _ring_attn_bwd_kernel(
                 dst_ref=buf.at[nslot],
                 send_sem=ssem.at[slot],
                 recv_sem=rsem.at[slot],
-                device_id={axis: right},
+                device_id=dma_device_id(axis, right, not fc),
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
             for buf, ssem, rsem in (
@@ -821,7 +921,7 @@ def _ring_attn_bwd_kernel(
             c.start()
         for c in copies:
             c.wait()  # our payload landed + next block fully arrived
-        if s < p - 1:
+        if fc and s < p - 1:
             # my slot is consumed and my outgoing read of it is complete:
             # left may overwrite it at its step s+1. No signal after the
             # last step so every semaphore ends the kernel drained.
@@ -869,6 +969,10 @@ def ring_attention_bwd_pallas(
     p = axis_size or lax.axis_size(axis)
     b, n, h, d = q.shape
     assert p > 1, "p == 1 has no ring; callers differentiate locally"
+    from .ring_kernels import _legacy_multiaxis
+
+    if _legacy_multiaxis(interpret):
+        return _ring_attention_bwd_xla(q, k, v, o, lse, do, axis, causal, p)
     budget = vmem_budget_bytes or _VMEM_BUDGET_BYTES
     if ring_attention_bwd_vmem_bytes(q.shape, q.dtype) > budget:
         def sub(bi, bb, hi, hh, prev):
@@ -900,7 +1004,8 @@ def ring_attention_bwd_pallas(
     scale = 1.0 / math.sqrt(d)
     my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
     kernel = functools.partial(
-        _ring_attn_bwd_kernel, p, axis, causal, scale, n
+        _ring_attn_bwd_kernel, p, axis, causal, scale, n,
+        kernel_flow_control(interpret),
     )
     dq, dk, dv = pl.pallas_call(
         kernel,
@@ -940,8 +1045,8 @@ def ring_attention_bwd_pallas(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=12),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        compiler_params=tpu_compiler_params(collective_id=12),
+        interpret=interpret_params() if interpret else False,
     )(
         my, to_cells(q), to_cells(o), to_cells(do),
         lse.reshape(bh, n, 1), to_cells(k), to_cells(v),

@@ -41,7 +41,43 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .._compat import (
+    HAS_TPU_INTERPRET,
+    dma_device_id,
+    interpret_params,
+    kernel_flow_control,
+    tpu_compiler_params,
+)
+
 _LANES = 128
+
+
+def _legacy_interpret(interpret: bool) -> bool:
+    """True when ``interpret`` would run on the LEGACY pallas interpreter
+    (jax without the TPU interpret machinery). Kernels whose DMAs sit
+    under device-divergent ``pl.when`` conditions (pipelined broadcast,
+    root-directed gather) cannot discharge there — each remote copy
+    lowers to an ``all_gather``, which deadlocks inside a divergent cond
+    — so their wrappers substitute an equivalent transport. The
+    unconditional-schedule kernels (allreduce/rs/ag phases, quantized
+    ring) run fine."""
+    return interpret and not HAS_TPU_INTERPRET
+
+
+def _legacy_multiaxis(interpret: bool) -> bool:
+    """True when the legacy interpreter additionally cannot run remote
+    DMA AT ALL: its discharge rule rejects meshes with more than one
+    named axis (hierarchical intra/inter compositions). Wrappers fall
+    back to their ppermute equivalents — same results, XLA transport."""
+    if not _legacy_interpret(interpret):
+        return False
+    try:
+        from jax._src import core as _core
+
+        names = [n for n in _core.get_axis_env().axis_sizes if n is not None]
+    except Exception:  # noqa: BLE001 - private-API probe; assume 1 axis
+        return False
+    return len(names) > 1
 
 # dtypes the kernels move/reduce natively; everything else is routed
 # through a same-kind carrier (ints -> int32, floats -> float32) by the
@@ -166,6 +202,7 @@ def _ring_phases_kernel(
     p: int,
     axis: str,
     mode: str,
+    fc: bool,
     my_ref,
     x_ref,
     o_ref,
@@ -202,34 +239,37 @@ def _ring_phases_kernel(
     o_ref[:] = x_ref[:]
 
     # neighbor barrier: nobody starts pushing until both neighbors arrived
-    # (the reference's per-collective MPI barrier before the IPC ring)
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(
-        barrier,
-        inc=1,
-        device_id={axis: left},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_signal(
-        barrier,
-        inc=1,
-        device_id={axis: right},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_wait(barrier, 2)
+    # (the reference's per-collective MPI barrier before the IPC ring).
+    # ``fc`` gates all flow control — off only under the legacy lockstep
+    # interpreter, which cannot express remote signals (_compat).
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier,
+            inc=1,
+            device_id={axis: left},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_signal(
+            barrier,
+            inc=1,
+            device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_wait(barrier, 2)
 
     total = 2 * (p - 1) if mode == "allreduce" else (p - 1)
 
     def ring_step(t: int, send_idx, recv_idx, accumulate: bool):
         slot = t % 2
-        if t >= 2:  # slot reuse: wait until right consumed our step t-2 data
+        if fc and t >= 2:  # slot reuse: wait until right consumed t-2 data
             pltpu.semaphore_wait(cap_sem.at[slot], 1)
         copy = pltpu.make_async_remote_copy(
             src_ref=o_ref.at[send_idx],
             dst_ref=comm_buf.at[slot],
             send_sem=send_sem.at[slot],
             recv_sem=recv_sem.at[slot],
-            device_id={axis: right},
+            device_id=dma_device_id(axis, right, not fc),
             device_id_type=pltpu.DeviceIdType.MESH,
         )
         copy.start()
@@ -238,7 +278,7 @@ def _ring_phases_kernel(
             o_ref[recv_idx] = o_ref[recv_idx] + comm_buf[slot]
         else:
             o_ref[recv_idx] = comm_buf[slot]
-        if t < total - 2:  # tell LEFT its slot is free for step t+2
+        if fc and t < total - 2:  # tell LEFT its slot frees for step t+2
             pltpu.semaphore_signal(
                 cap_sem.at[slot],
                 inc=1,
@@ -287,7 +327,9 @@ def _max_rows(p: int, itemsize: int, min_rows: int) -> int:
 
 def _ring_phases_call(chunks, p, axis, rows, dtype, mode, interpret):
     my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
-    kernel = functools.partial(_ring_phases_kernel, p, axis, mode)
+    kernel = functools.partial(
+        _ring_phases_kernel, p, axis, mode, kernel_flow_control(interpret)
+    )
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((p, rows, _LANES), dtype),
@@ -302,21 +344,30 @@ def _ring_phases_call(chunks, p, axis, rows, dtype, mode, interpret):
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=7),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        compiler_params=tpu_compiler_params(collective_id=7),
+        interpret=interpret_params() if interpret else False,
     )(my, chunks)
 
 
-def _segmented(flat, p, dtype, call):
+def _segmented(flat, p, dtype, call, row_align: Optional[int] = None,
+               max_seg_rows: Optional[int] = None):
     """Pad/segment a flat buffer into [p, seg_rows, 128] VMEM-sized pieces
     and run ``call(chunks, seg_rows)`` per segment (the reference's
-    kMin/kMaxBufferSize chunking, constants.cpp:142-145)."""
+    kMin/kMaxBufferSize chunking, constants.cpp:142-145). ``row_align`` /
+    ``max_seg_rows`` override the dtype-derived tile rounding and VMEM
+    bound (the quantized kernels need 128-row alignment so per-row scales
+    reshape into whole scale rows)."""
     n = flat.shape[0]
-    min_rows = _min_rows(dtype)
-    # per-chunk rows for p ring chunks (nested-ceil identity keeps this
-    # equal to ceil(n / (p * LANES)) rounded to tiles)
-    rows = _tile_rows(-(-n // p), dtype)
-    seg_rows = min(rows, _max_rows(p, jnp.dtype(dtype).itemsize, min_rows))
+    if row_align is not None:
+        raw = -(-(-(-n // p)) // _LANES)
+        rows = max(row_align, -(-raw // row_align) * row_align)
+        seg_rows = min(rows, max_seg_rows or rows)
+    else:
+        min_rows = _min_rows(dtype)
+        # per-chunk rows for p ring chunks (nested-ceil identity keeps this
+        # equal to ceil(n / (p * LANES)) rounded to tiles)
+        rows = _tile_rows(-(-n // p), dtype)
+        seg_rows = min(rows, _max_rows(p, jnp.dtype(dtype).itemsize, min_rows))
     padded = p * seg_rows * _LANES
     num_segments = -(-n // padded)
     total = num_segments * padded
@@ -336,15 +387,31 @@ def ring_allreduce_pallas(
     axis: str = "mpi",
     axis_size: Optional[int] = None,
     interpret: bool = False,
+    wire_dtype: Optional[str] = None,
 ):
     """Allreduce the per-device block ``x`` over mesh axis ``axis`` with the
     Pallas RDMA ring. Call inside ``shard_map`` (any mesh shape: devices are
     addressed by mesh coordinates along ``axis``). Dtype-preserving; any
     shape. Buffers larger than the VMEM budget are ring-reduced in
-    sequential segments."""
+    sequential segments. ``wire_dtype`` ('int8' | 'bf16') engages the
+    block-quantized wire kernel for f32 payloads above the
+    ``wire_quant_min_elements`` cutoff (f32 accumulate either way)."""
     p = axis_size or lax.axis_size(axis)
     if p == 1:
         return x
+    if _legacy_multiaxis(interpret or _FORCE_INTERPRET):
+        from ..collectives import primitives as _prim
+
+        # same ring economics; record the schedule for introspection
+        _LAST_STEP_COUNTS["allreduce"] = 2 * (p - 1)
+        return _prim.ring_allreduce(
+            x, axis, axis_size=axis_size, wire_dtype=wire_dtype
+        )
+    wire = _wire_requested(x, wire_dtype)
+    if wire is not None:
+        return ring_allreduce_quant_pallas(
+            x, wire, axis, axis_size=axis_size, interpret=interpret
+        )
     interpret = interpret or _FORCE_INTERPRET
     orig_shape, orig_dtype = x.shape, x.dtype
     carrier = _carrier_dtype(orig_dtype)
@@ -372,17 +439,32 @@ def ring_reduce_scatter_pallas(
     axis: str = "mpi",
     axis_size: Optional[int] = None,
     interpret: bool = False,
+    wire_dtype: Optional[str] = None,
 ):
     """Reduce-scatter along dim 0 (``lax.psum_scatter`` tiled semantics:
     device r receives the sum of every device's segment r). The pallas
     analog of the reference ring's reduce-scatter phase
     (``detail/collectives_cuda.cpp:202-330``), exposed standalone.
+    ``wire_dtype`` engages the block-quantized wire kernel (same contract
+    as :func:`ring_allreduce_pallas`).
 
     Requires ``x.shape[0] % p == 0``.
     """
     p = axis_size or lax.axis_size(axis)
     if p == 1:
         return x
+    if _legacy_multiaxis(interpret or _FORCE_INTERPRET):
+        from ..collectives import primitives as _prim
+
+        _LAST_STEP_COUNTS["reduce_scatter"] = p - 1
+        return _prim.ring_reduce_scatter(
+            x, axis, dim=0, axis_size=axis_size, wire_dtype=wire_dtype
+        )
+    wire = _wire_requested(x, wire_dtype)
+    if wire is not None:
+        return ring_reduce_scatter_quant_pallas(
+            x, wire, axis, axis_size=axis_size, interpret=interpret
+        )
     if x.shape[0] % p != 0:
         raise ValueError(
             f"reduce_scatter dim 0 ({x.shape[0]}) must be divisible by the "
@@ -428,6 +510,324 @@ def ring_reduce_scatter_pallas(
     return full.reshape(-1)[:seg_n].reshape(seg_shape).astype(orig_dtype)
 
 
+# ---------------------------------------------------------------------------
+# block-quantized wire format (EQuARX-style): int8 / bf16 on the wire,
+# fp32 accumulate, requantize per hop — fused into the ring schedule
+# ---------------------------------------------------------------------------
+
+# the quantized kernels tile chunks to whole 128-row groups so the
+# per-row scales ([rows] f32) reshape into whole [rows/128, 128] scale
+# rows for their own DMA stream
+_QUANT_ROW_ALIGN = 128
+
+
+def _quant_rows(nchunk: int) -> int:
+    """Rows for an ``nchunk``-element ring chunk, 128-row aligned."""
+    raw = -(-nchunk // _LANES)
+    return max(
+        _QUANT_ROW_ALIGN, -(-raw // _QUANT_ROW_ALIGN) * _QUANT_ROW_ALIGN
+    )
+
+
+def _quant_srows(rows: int):
+    """(scale buffer rows, used scale rows) for a [rows, 128] chunk: one
+    f32 scale per value row, packed 128 per scale row, padded to the f32
+    sublane tile."""
+    nsr = rows // _QUANT_ROW_ALIGN
+    return max(8, -(-nsr // 8) * 8), nsr
+
+
+def _max_rows_quant(p: int, wire: str) -> int:
+    """VMEM bound for the quantized kernels: x + o are [p, rows, 128] f32,
+    plus the double-buffered wire slots, staging, and scales."""
+    wire_itemsize = 1 if wire == "int8" else 2
+    per_row = (2 * p * 4 + 3 * wire_itemsize) * _LANES + 16
+    rows = _VMEM_BUDGET_BYTES // per_row
+    return max(
+        _QUANT_ROW_ALIGN, rows // _QUANT_ROW_ALIGN * _QUANT_ROW_ALIGN
+    )
+
+
+def _ring_quant_kernel(
+    p: int,
+    axis: str,
+    mode: str,
+    wire: str,
+    fc: bool,
+    nsr: int,
+    my_ref,
+    x_ref,
+    o_ref,
+    *scratch,
+):
+    """Block-quantized variant of :func:`_ring_phases_kernel` (same step
+    schedule, same capacity discipline): x_ref/o_ref are [p, rows, 128]
+    float32 — o_ref doubles as the HIGHER-PRECISION accumulator — and
+    every hop ships the wire encoding instead of the raw chunk:
+
+    - ``wire='int8'``: the outgoing chunk is quantized per 128-lane row
+      (symmetric, scale = rowmax/127) into an int8 staging buffer, the
+      row scales pack into a second f32 buffer ([nsr, 128], own DMA
+      stream + semaphores), the receiver dequantizes into f32 and
+      accumulates; the next hop REQUANTIZES the running partial. The
+      all-gather phase forwards reduced chunks the same way — re-encoding
+      a just-decoded chunk reproduces the same code points, so AG
+      forwarding is lossless up to fp rounding.
+    - ``wire='bf16'``: the staging/wire buffers are bf16 casts, no
+      scales; accumulation still f32.
+
+    Wire bytes per hop: rows*128 + 4*rows (int8 + scales) vs rows*128*4
+    for the fp32 kernel — ~3.9x less on the bandwidth-bound links.
+    """
+    if wire == "int8":
+        (comm_q, comm_s, qstage, sstage,
+         send_q, recv_q, send_s, recv_s, cap_sem) = scratch
+    else:
+        comm_q, qstage, send_q, recv_q, cap_sem = scratch
+        comm_s = sstage = send_s = recv_s = None
+    my = my_ref[0]
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my + p - 1, p)
+    rows = o_ref.shape[1]
+    o_ref[:] = x_ref[:]
+    if wire == "int8":
+        # deterministic bytes in the padded scale rows (never read back)
+        sstage[...] = jnp.zeros_like(sstage)
+
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: left},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_wait(barrier, 2)
+
+    total = 2 * (p - 1) if mode == "allreduce" else (p - 1)
+
+    def encode(idx):
+        xv = o_ref[idx]  # [rows, 128] f32
+        if wire == "int8":
+            scale = jnp.maximum(
+                jnp.max(jnp.abs(xv), axis=1, keepdims=True), 1e-30
+            ) / 127.0
+            qstage[...] = jnp.round(xv / scale).astype(jnp.int8)
+            sstage[0:nsr] = scale.reshape(nsr, _LANES)
+        else:
+            qstage[...] = xv.astype(jnp.bfloat16)
+
+    def decode(slot: int):
+        if wire == "int8":
+            sc = comm_s[slot, 0:nsr].reshape(rows, 1)
+            return comm_q[slot].astype(jnp.float32) * sc
+        return comm_q[slot].astype(jnp.float32)
+
+    def ring_step(t: int, send_idx, recv_idx, accumulate: bool):
+        slot = t % 2
+        # staging reuse is safe: step t-1's copy.wait() proved the
+        # previous staging bytes left the chip
+        encode(send_idx)
+        if fc and t >= 2:
+            pltpu.semaphore_wait(cap_sem.at[slot], 1)
+        copies = [
+            pltpu.make_async_remote_copy(
+                src_ref=qstage,
+                dst_ref=comm_q.at[slot],
+                send_sem=send_q.at[slot],
+                recv_sem=recv_q.at[slot],
+                device_id=dma_device_id(axis, right, not fc),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        ]
+        if wire == "int8":
+            copies.append(
+                pltpu.make_async_remote_copy(
+                    src_ref=sstage,
+                    dst_ref=comm_s.at[slot],
+                    send_sem=send_s.at[slot],
+                    recv_sem=recv_s.at[slot],
+                    device_id=dma_device_id(axis, right, not fc),
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+            )
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+        val = decode(slot)
+        if accumulate:
+            o_ref[recv_idx] = o_ref[recv_idx] + val
+        else:
+            o_ref[recv_idx] = val
+        if fc and t < total - 2:
+            pltpu.semaphore_signal(
+                cap_sem.at[slot], inc=1, device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+    # reduce-scatter: step s sends chunk (my - s), accumulates (my - s - 1)
+    for s in range(p - 1):
+        ring_step(
+            s,
+            lax.rem(my - s + p, p),
+            lax.rem(my - s - 1 + p, p),
+            accumulate=True,
+        )
+    if mode == "rs":
+        return
+
+    # all-gather: step s sends (my + 1 - s) (fully reduced), installs (my - s)
+    for s in range(p - 1):
+        ring_step(
+            p - 1 + s,
+            lax.rem(my + 1 - s + 2 * p, p),
+            lax.rem(my - s + p, p),
+            accumulate=False,
+        )
+
+
+def _ring_quant_call(chunks, p, axis, rows, mode, wire, interpret):
+    my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
+    srows, nsr = _quant_srows(rows)
+    if wire == "int8":
+        scratch = [
+            pltpu.VMEM((2, rows, _LANES), jnp.int8),
+            pltpu.VMEM((2, srows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.int8),
+            pltpu.VMEM((srows, _LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ]
+    else:
+        scratch = [
+            pltpu.VMEM((2, rows, _LANES), jnp.bfloat16),
+            pltpu.VMEM((rows, _LANES), jnp.bfloat16),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ]
+    kernel = functools.partial(
+        _ring_quant_kernel, p, axis, mode, wire,
+        kernel_flow_control(interpret), nsr,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((p, rows, _LANES), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=scratch,
+        compiler_params=tpu_compiler_params(collective_id=14),
+        interpret=interpret_params() if interpret else False,
+    )(my, chunks)
+
+
+def _wire_requested(x, wire_dtype: Optional[str]) -> Optional[str]:
+    """Resolve a wrapper's wire_dtype argument against the engagement
+    gates (f32 payload, min-elements cutoff); None = ship verbatim."""
+    if wire_dtype not in ("int8", "bf16"):
+        return None
+    from ..collectives.primitives import wire_engages
+
+    n = 1
+    for d in x.shape:
+        n *= d
+    return wire_dtype if wire_engages(wire_dtype, x.dtype, n) else None
+
+
+def ring_allreduce_quant_pallas(
+    x,
+    wire: str,
+    axis: str = "mpi",
+    axis_size: Optional[int] = None,
+    interpret: bool = False,
+):
+    """Block-quantized allreduce on the Pallas RDMA ring: ``wire`` bytes
+    on every hop, f32 accumulation, dequantized once at the end. Same
+    shard_map/segmentation contract as :func:`ring_allreduce_pallas`
+    (which routes here when its ``wire_dtype`` engages)."""
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x
+    interpret = interpret or _FORCE_INTERPRET
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    _LAST_STEP_COUNTS["allreduce"] = 2 * (p - 1)
+    outs, n = _segmented(
+        flat,
+        p,
+        jnp.float32,
+        lambda chunk, rows: _ring_quant_call(
+            chunk, p, axis, rows, "allreduce", wire, interpret
+        ),
+        row_align=_QUANT_ROW_ALIGN,
+        max_seg_rows=_max_rows_quant(p, wire),
+    )
+    out = (
+        jnp.concatenate([o.reshape(-1) for o in outs])
+        if len(outs) > 1
+        else outs[0].reshape(-1)
+    )
+    return out[:n].reshape(orig_shape).astype(orig_dtype)
+
+
+def ring_reduce_scatter_quant_pallas(
+    x,
+    wire: str,
+    axis: str = "mpi",
+    axis_size: Optional[int] = None,
+    interpret: bool = False,
+):
+    """Block-quantized reduce-scatter (dim 0, psum_scatter tiled
+    semantics) on the Pallas ring — the 'rs' phase of the quantized
+    kernel, standalone."""
+    p = axis_size or lax.axis_size(axis)
+    if p == 1:
+        return x
+    if x.shape[0] % p != 0:
+        raise ValueError(
+            f"reduce_scatter dim 0 ({x.shape[0]}) must be divisible by the "
+            f"axis size ({p})"
+        )
+    interpret = interpret or _FORCE_INTERPRET
+    orig_dtype = x.dtype
+    seg_shape = (x.shape[0] // p,) + x.shape[1:]
+    seg_n = 1
+    for d in seg_shape:
+        seg_n *= d
+    segs = x.reshape((p, seg_n)).astype(jnp.float32)
+    rows = _quant_rows(seg_n)
+    padded = rows * _LANES
+    if padded != seg_n:
+        segs = jnp.concatenate(
+            [segs, jnp.zeros((p, padded - seg_n), jnp.float32)], axis=1
+        )
+    chunks = segs.reshape(p, rows, _LANES)
+    # pre-roll: the kernel leaves rank r owning chunk (r+1) mod p
+    chunks = jnp.roll(chunks, 1, axis=0)
+    seg_rows = min(rows, _max_rows_quant(p, wire))
+    my = lax.axis_index(axis)
+    owned_idx = lax.rem(my + 1, p)
+    _LAST_STEP_COUNTS["reduce_scatter"] = p - 1
+    outs = []
+    for r0 in range(0, rows, seg_rows):
+        r1 = min(rows, r0 + seg_rows)
+        piece = chunks[:, r0:r1, :]
+        out = _ring_quant_call(piece, p, axis, r1 - r0, "rs", wire, interpret)
+        owned = lax.dynamic_index_in_dim(out, owned_idx, 0, keepdims=False)
+        outs.append(owned)
+    full = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+    return full.reshape(-1)[:seg_n].reshape(seg_shape).astype(orig_dtype)
+
+
 def ring_allgather_pallas(
     x,
     axis: str = "mpi",
@@ -451,6 +851,10 @@ def ring_allgather_pallas(
     if p == 1:
         return x[None]
     interpret = interpret or _FORCE_INTERPRET
+    if _legacy_multiaxis(interpret):
+        # XLA transport stand-in (legacy interpreter, multi-axis mesh):
+        # same stacked-[p, ...] contract
+        return lax.all_gather(x, axis, axis=0)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat, restore = _bitcast_to_bytes(x.reshape(-1))
     carrier = flat.dtype
@@ -493,6 +897,7 @@ def ring_allgather_pallas(
 def _ring_bidir_kernel(
     p: int,
     axis: str,
+    fc: bool,
     my_ref,
     xa_ref,
     xb_ref,
@@ -526,16 +931,17 @@ def _ring_bidir_kernel(
     oa_ref[:] = xa_ref[:]
     ob_ref[:] = xb_ref[:]
 
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id={axis: left},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id={axis: right},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_wait(barrier, 2)
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: left},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_wait(barrier, 2)
 
     total = 2 * (p - 1)
 
@@ -545,14 +951,14 @@ def _ring_bidir_kernel(
         slot = t % 2
         to = right if d == 1 else left
         frm = left if d == 1 else right
-        if t >= 2:
+        if fc and t >= 2:
             pltpu.semaphore_wait(cap_sem.at[slot], 1)
         copy = pltpu.make_async_remote_copy(
             src_ref=o_ref.at[send_idx],
             dst_ref=comm_buf.at[slot],
             send_sem=send_sem.at[slot],
             recv_sem=recv_sem.at[slot],
-            device_id={axis: to},
+            device_id=dma_device_id(axis, to, not fc),
             device_id_type=pltpu.DeviceIdType.MESH,
         )
         copy.start()
@@ -563,7 +969,7 @@ def _ring_bidir_kernel(
                 o_ref[recv_idx] = o_ref[recv_idx] + comm_buf[slot]
             else:
                 o_ref[recv_idx] = comm_buf[slot]
-            if t < total - 2:
+            if fc and t < total - 2:
                 pltpu.semaphore_signal(
                     cap_sem.at[slot], inc=1, device_id={axis: frm},
                     device_id_type=pltpu.DeviceIdType.MESH,
@@ -611,10 +1017,11 @@ def ring_allreduce_bidir_pallas(
     p = axis_size or lax.axis_size(axis)
     if p == 1:
         return x
-    if p == 2:
+    if p == 2 or _legacy_multiaxis(interpret or _FORCE_INTERPRET):
         # two devices: both "directions" address the same single neighbor
         # link; the unidirectional kernel is the same schedule with half
-        # the semaphore traffic
+        # the semaphore traffic. (The legacy multi-axis case delegates
+        # for its ppermute fallback.)
         return ring_allreduce_pallas(
             x, axis, axis_size=axis_size, interpret=interpret
         )
@@ -637,7 +1044,9 @@ def ring_allreduce_bidir_pallas(
     # both halves are padded to the SAME tile geometry (equal half sizes)
     assert rows_a == rows_b
     my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
-    kernel = functools.partial(_ring_bidir_kernel, p, axis)
+    kernel = functools.partial(
+        _ring_bidir_kernel, p, axis, kernel_flow_control(interpret)
+    )
     outs = []
     for seg_a, seg_b in zip(ca, cb):
         rows = seg_a.shape[1]
@@ -666,8 +1075,8 @@ def ring_allreduce_bidir_pallas(
                 pltpu.SemaphoreType.REGULAR((2,)),
                 pltpu.SemaphoreType.REGULAR((2,)),
             ],
-            compiler_params=pltpu.CompilerParams(collective_id=10),
-            interpret=pltpu.InterpretParams() if interpret else False,
+            compiler_params=tpu_compiler_params(collective_id=10),
+            interpret=interpret_params() if interpret else False,
         )(my, seg_a, seg_b)
         outs.append((oa, ob))
     flat_a = jnp.concatenate([o.reshape(-1) for o, _ in outs])[:half]
@@ -709,7 +1118,7 @@ def _segmented_pair_ready(flat, p, dtype):
 
 
 def _ring_gather_root_kernel(
-    p: int, axis: str, root: int, my_ref, x_ref, o_ref,
+    p: int, axis: str, root: int, fc: bool, my_ref, x_ref, o_ref,
     send_sem, recv_sem, cap_sem
 ):
     """Gather every device's owned chunk to ``root`` along the ring — the
@@ -738,16 +1147,17 @@ def _ring_gather_root_kernel(
     left_d = lax.rem(d + p - 1, p)
     o_ref[:] = x_ref[:]
 
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id={axis: left},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id={axis: right},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_wait(barrier, 2)
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: left},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_wait(barrier, 2)
 
     for s in range(p - 1):
         slot = s % 2
@@ -761,23 +1171,25 @@ def _ring_gather_root_kernel(
                 dst_ref=o_ref.at[ridx],
                 send_sem=send_sem.at[slot],
                 recv_sem=recv_sem.at[slot],
-                device_id={axis: right},
+                device_id=dma_device_id(axis, right, not fc),
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
             incoming.wait_recv()
 
-        @pl.when(recv_now & (s + 2 < left_d))
-        def _():
-            pltpu.semaphore_signal(
-                cap_sem.at[slot], inc=1, device_id={axis: left},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
+        if fc:
+            @pl.when(recv_now & (s + 2 < left_d))
+            def _():
+                pltpu.semaphore_signal(
+                    cap_sem.at[slot], inc=1, device_id={axis: left},
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
 
         send_now = s < d
 
-        @pl.when(send_now & (s >= 2))
-        def _():
-            pltpu.semaphore_wait(cap_sem.at[slot], 1)
+        if fc:
+            @pl.when(send_now & (s >= 2))
+            def _():
+                pltpu.semaphore_wait(cap_sem.at[slot], 1)
 
         @pl.when(send_now)
         def _():
@@ -787,7 +1199,7 @@ def _ring_gather_root_kernel(
                 dst_ref=o_ref.at[idx],  # same slot in the consumer
                 send_sem=send_sem.at[slot],
                 recv_sem=recv_sem.at[slot],
-                device_id={axis: right},
+                device_id=dma_device_id(axis, right, not fc),
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
             copy.start()
@@ -796,7 +1208,9 @@ def _ring_gather_root_kernel(
 
 def _ring_gather_call(chunks, p, axis, root, rows, dtype, interpret):
     my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
-    kernel = functools.partial(_ring_gather_root_kernel, p, axis, root)
+    kernel = functools.partial(
+        _ring_gather_root_kernel, p, axis, root, kernel_flow_control(interpret)
+    )
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((p, rows, _LANES), dtype),
@@ -810,8 +1224,8 @@ def _ring_gather_call(chunks, p, axis, root, rows, dtype, interpret):
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=9),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        compiler_params=tpu_compiler_params(collective_id=9),
+        interpret=interpret_params() if interpret else False,
     )(my, chunks)
 
 
@@ -832,6 +1246,15 @@ def ring_reduce_pallas(
     if p == 1:
         return x
     interpret = interpret or _FORCE_INTERPRET
+    if _legacy_interpret(interpret):
+        # the root-directed gather's conditional DMAs cannot discharge on
+        # the legacy interpreter: reduce = allreduce (same phases kernel)
+        # masked to root — identical results, full-ring wire traffic
+        total = ring_allreduce_pallas(
+            x, axis, axis_size=axis_size, interpret=interpret
+        )
+        _LAST_STEP_COUNTS["reduce"] = 2 * (p - 1)
+        return jnp.where(lax.axis_index(axis) == root, total, x)
     orig_shape, orig_dtype = x.shape, x.dtype
     carrier = _carrier_dtype(orig_dtype)
     flat = x.reshape(-1).astype(carrier)
@@ -857,7 +1280,7 @@ def ring_reduce_pallas(
 
 
 def _ring_broadcast_kernel(
-    p: int, k: int, axis: str, root: int, my_ref, x_ref, o_ref,
+    p: int, k: int, axis: str, root: int, fc: bool, my_ref, x_ref, o_ref,
     send_sem, recv_sem, cap_sem
 ):
     """Pipelined chunk flow down the ring (the reference's large-message
@@ -886,16 +1309,17 @@ def _ring_broadcast_kernel(
     def _():
         o_ref[:] = x_ref[:]
 
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id={axis: left},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id={axis: right},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    pltpu.semaphore_wait(barrier, 2)
+    if fc:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: left},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        pltpu.semaphore_wait(barrier, 2)
 
     for t in range(k + p - 2):
         # receive chunk c_recv = t - d + 1 (sent by left at distance d-1):
@@ -913,20 +1337,21 @@ def _ring_broadcast_kernel(
                 dst_ref=o_ref.at[ridx],
                 send_sem=send_sem.at[t % 2],
                 recv_sem=recv_sem.at[t % 2],
-                device_id={axis: right},
+                device_id=dma_device_id(axis, right, not fc),
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
             incoming.wait_recv()
 
         # free the consumed slot for the sender's next-but-one send
-        @pl.when(recv_now & (c_recv <= k - 3))
-        def _():
-            pltpu.semaphore_signal(
-                cap_sem.at[t % 2],
-                inc=1,
-                device_id={axis: left},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
+        if fc:
+            @pl.when(recv_now & (c_recv <= k - 3))
+            def _():
+                pltpu.semaphore_signal(
+                    cap_sem.at[t % 2],
+                    inc=1,
+                    device_id={axis: left},
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
 
         # send chunk c_send = t - d to right (received at step t-1; root
         # sends its own chunks). The receiver at distance d+1 waits for it
@@ -938,9 +1363,10 @@ def _ring_broadcast_kernel(
 
         # slot reuse (3rd+ send): wait until right consumed the chunk sent
         # two steps ago on this slot
-        @pl.when(send_now & (c_send >= 2))
-        def _():
-            pltpu.semaphore_wait(cap_sem.at[t % 2], 1)
+        if fc:
+            @pl.when(send_now & (c_send >= 2))
+            def _():
+                pltpu.semaphore_wait(cap_sem.at[t % 2], 1)
 
         @pl.when(send_now)
         def _():
@@ -950,7 +1376,7 @@ def _ring_broadcast_kernel(
                 dst_ref=o_ref.at[idx],  # same offset in the consumer
                 send_sem=send_sem.at[t % 2],
                 recv_sem=recv_sem.at[t % 2],
-                device_id={axis: right},
+                device_id=dma_device_id(axis, right, not fc),
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
             copy.start()
@@ -975,6 +1401,18 @@ def ring_broadcast_pallas(
     if p == 1:
         return x
     interpret = interpret or _FORCE_INTERPRET
+    if _legacy_interpret(interpret):
+        # (covers the multi-axis case too)
+        # the pipelined chunk flow's conditional DMAs cannot discharge on
+        # the legacy interpreter: ride the ppermute pipelined broadcast
+        # (identical chunk schedule, XLA transport)
+        from ..collectives.primitives import ring_broadcast as _ring_bcast
+
+        k = num_chunks or min(8, max(1, p))
+        _LAST_STEP_COUNTS["broadcast"] = k + p - 2
+        return _ring_bcast(
+            x, root, axis, axis_size=axis_size, num_chunks=num_chunks
+        )
     orig_shape, orig_dtype = x.shape, x.dtype
     flat, restore = _bitcast_to_bytes(x.reshape(-1))
     carrier = flat.dtype
@@ -1000,7 +1438,10 @@ def ring_broadcast_pallas(
             )
         chunks = seg_flat.reshape(k, rows, _LANES)
         my = lax.axis_index(axis).astype(jnp.int32).reshape(1)
-        kernel = functools.partial(_ring_broadcast_kernel, p, k, axis, root)
+        kernel = functools.partial(
+            _ring_broadcast_kernel, p, k, axis, root,
+            kernel_flow_control(interpret),
+        )
         out = pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((k, rows, _LANES), carrier),
@@ -1014,8 +1455,8 @@ def ring_broadcast_pallas(
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR((2,)),
             ],
-            compiler_params=pltpu.CompilerParams(collective_id=8),
-            interpret=pltpu.InterpretParams() if interpret else False,
+            compiler_params=tpu_compiler_params(collective_id=8),
+            interpret=interpret_params() if interpret else False,
         )(my, chunks)
         return out.reshape(-1)[:n]
 

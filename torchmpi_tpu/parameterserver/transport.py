@@ -69,6 +69,15 @@ _MULTI_ITEM = struct.Struct(">IQ")
 # is deduped as a unit, not per rank)
 _MULTI_RANK = 0xFFFFFFFF
 
+# bound on retained poison records (_failed). The record protecting a
+# failed seq must outlive its reconnect replay; now that SINGLE update
+# failures are poisoned too (not just partially-applied multis), a tight
+# cap could be churned through before the replay arrives and the evicted
+# seq would be answered from the _applied high-water mark — a false ACK.
+# Entries are one small string each; failures are rare and fatal to the
+# owning client anyway, so a generous cap costs nothing.
+_FAILED_CAP = 4096
+
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
 #        fp u32, token u32, rule_len u16, dtype_len u16, payload_len u64
 #
@@ -285,10 +294,38 @@ class _Listener:
         self._barrier_cv = threading.Condition()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # ONE listener-wide pool for applied-waits and replies, sized
+        # from the expected in-flight frames (the PS pool size bounds
+        # concurrent applies; 2x covers waits stacked behind them). A
+        # per-connection pool multiplied threads on reconnect churn: a
+        # flapping peer left dozens of idle pools behind (ADVICE r5).
+        # Invariant that keeps the bounded pool deadlock-free: pool
+        # tasks block only on SERVER-thread progress (apply events /
+        # trigger futures), never on other pool tasks — which is why
+        # replay waiters (_await_other_apply, which block on a FINISHER
+        # task's event) run on their own short-lived threads instead.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(
+                4, constants.get("parameterserver_thread_pool_size") * 2
+            ),
+            thread_name_prefix="tm-ps-apply",
+        )
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="tm-ps-listener", daemon=True
         )
         self._accept_thread.start()
+
+    def _submit(self, fn, *args) -> None:
+        """Schedule reply work on the shared pool; during close() the
+        pool may already be shut down while a reader drains its socket —
+        drop the work instead of killing the reader with RuntimeError."""
+        try:
+            self._pool.submit(fn, *args)
+        except RuntimeError:
+            if not self._stop.is_set():
+                raise
 
     def barrier_arrived(self, tag: str, origin: int) -> None:
         with self._barrier_cv:
@@ -370,19 +407,17 @@ class _Listener:
         """Per-connection reader. Frames are READ and POSTED in wire order
         on this thread (per-(inst, rank) apply order is mailbox order, so
         a client's updates to one shard still apply in its program order),
-        but the applied-WAITS and replies run on a small worker pool:
-        replies are correlated by the echoed frame seq, not FIFO, so one
-        slow shard apply no longer head-of-line-blocks every later frame
-        on the connection — the per-instance independence of the
-        reference's Iprobe dispatch (``parameterserver.cpp:404-541``)."""
+        but the applied-WAITS and replies run on the LISTENER-WIDE worker
+        pool (``self._pool``): replies are correlated by the echoed frame
+        seq, not FIFO, so one slow shard apply no longer head-of-line-
+        blocks every later frame on the connection — the per-instance
+        independence of the reference's Iprobe dispatch
+        (``parameterserver.cpp:404-541``). The pool is shared across
+        connections so reconnect churn cannot multiply threads."""
         import threading as _threading
-        from concurrent.futures import Future, ThreadPoolExecutor
+        from concurrent.futures import Future
 
         send_lock = _threading.Lock()
-        pool = ThreadPoolExecutor(
-            max_workers=constants.get("parameterserver_thread_pool_size") * 2,
-            thread_name_prefix="tm-ps-apply",
-        )
 
         def reply(kind: int, seq: int, **kw) -> None:
             try:
@@ -444,6 +479,7 @@ class _Listener:
                     owner = True
                     pending: Optional[_threading.Event] = None
                     poisoned = None
+                    replay_applied = False
                     with self._applied_lock:
                         # applied / poisoned / inflight are decided in ONE
                         # critical section: were the applied-check and the
@@ -452,33 +488,53 @@ class _Listener:
                         # inflight entry) between them, and a reconnect
                         # retry would register itself as a fresh owner and
                         # re-post a non-idempotent rule.
-                        if seq and self._applied.get(dkey, 0) >= seq:
-                            # retry of an already-applied update: ack only
-                            reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
-                            continue
+                        #
+                        # _failed is consulted BEFORE the _applied high-
+                        # water check: seqs are channel-monotone, so a
+                        # LATER update's success advances the mark past a
+                        # failed seq — the replay of the failed frame
+                        # must be re-answered with its recorded ERROR,
+                        # never a false ACK (ADVICE r5).
                         if seq:
                             poisoned = self._failed.get(ikey)
                             if poisoned is None:
-                                pending = self._inflight.get(ikey)
-                                if pending is None:
-                                    self._inflight[ikey] = _threading.Event()
+                                if self._applied.get(dkey, 0) >= seq:
+                                    replay_applied = True
                                 else:
-                                    owner = False
+                                    pending = self._inflight.get(ikey)
+                                    if pending is None:
+                                        self._inflight[ikey] = (
+                                            _threading.Event()
+                                        )
+                                    else:
+                                        owner = False
                     if poisoned is not None:
-                        # retry of a partially-applied multi frame whose
-                        # ERROR response was lost: re-report, never
-                        # re-apply (items that succeeded would double)
+                        # retry of a failed frame whose ERROR response was
+                        # lost (single UPDATE, or a partially-applied
+                        # multi): re-report from the record, never
+                        # re-apply (multi items that succeeded would
+                        # double)
                         reply(_KIND_ERROR, seq, rule=poisoned)
+                        continue
+                    if replay_applied:
+                        # retry of an already-applied update: ack only
+                        reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
                         continue
                     if not owner:
                         # a reconnect retry racing the FIRST apply (its
                         # seq not yet recorded): wait for that apply and
                         # report ITS outcome — re-posting would apply a
-                        # non-idempotent rule ('add') twice.
-                        pool.submit(
-                            self._await_other_apply, reply, dkey, seq,
-                            pending, inst_id, rank, timeout,
-                        )
+                        # non-idempotent rule ('add') twice. Own thread,
+                        # NOT the pool: this wait completes only when the
+                        # owner's _finish_update (a pool task) sets the
+                        # event — parked on a pool worker it could starve
+                        # the very task it waits for.
+                        _threading.Thread(
+                            target=self._await_other_apply,
+                            args=(reply, dkey, seq, pending, inst_id,
+                                  rank, timeout),
+                            name="tm-ps-replay-wait", daemon=True,
+                        ).start()
                         continue
                     try:
                         dt = np.dtype(dtype)
@@ -517,19 +573,19 @@ class _Listener:
                         # reply ERROR, and release the inflight slot the
                         # old inline finally covered — leaking it would
                         # hang the channel replay's not-owner wait forever
-                        pool.submit(
+                        self._submit(
                             self._abort_partial_post, reply, kind, ikey,
                             seq, posted, f"update post failed: {e}",
                         )
                         continue
-                    pool.submit(
+                    self._submit(
                         self._finish_update, reply, kind, dkey, ikey, seq,
                         inst_id, rank, posted, timeout,
                     )
                 elif kind == _KIND_TRIGGER:
                     f: Future = Future()
                     inst.post(rank, _Message("trigger", client=client, reply=f))
-                    pool.submit(
+                    self._submit(
                         self._finish_trigger, reply, f, seq, inst_id, rank,
                         timeout,
                     )
@@ -538,7 +594,8 @@ class _Listener:
         except (ConnectionError, OSError):
             pass
         finally:
-            pool.shutdown(wait=False)
+            # the pool is listener-owned (shared): only the socket dies
+            # with the connection
             try:
                 conn.close()
             except OSError:
@@ -558,7 +615,7 @@ class _Listener:
                 # items that DID apply must never re-apply on a replay
                 # whose ERROR response was lost: poison the (key, seq)
                 with self._applied_lock:
-                    while len(self._failed) >= 64:
+                    while len(self._failed) >= _FAILED_CAP:
                         self._failed.pop(next(iter(self._failed)))
                     self._failed[ikey] = failure
             reply(_KIND_ERROR, seq, rule=failure)
@@ -602,15 +659,18 @@ class _Listener:
                 if msg.error is not None:
                     failure = f"update apply failed: {msg.error}"
             if failure is not None:
-                # A multi frame is acked/deduped as a UNIT. The error is
-                # fatal client-side (the pool never resends on
-                # _KIND_ERROR) — but the ERROR response itself can be
-                # lost to a connection drop, and the reconnect RESEND
-                # must not re-apply the items that succeeded: poison this
-                # (key, seq) so the retry is answered from the record.
-                if kind == _KIND_UPDATE_MULTI and seq:
+                # A frame is acked/deduped as a UNIT. The error is fatal
+                # client-side (the pool never resends on _KIND_ERROR) —
+                # but the ERROR response itself can be lost to a
+                # connection drop, and the reconnect RESEND must be
+                # answered from the record: for a multi frame re-applying
+                # would double the items that succeeded; for a single
+                # UPDATE a LATER update's success advances the _applied
+                # high-water mark past this seq, and an unpoisoned replay
+                # would then be answered with a false ACK (ADVICE r5).
+                if seq:
                     with self._applied_lock:
-                        while len(self._failed) >= 64:
+                        while len(self._failed) >= _FAILED_CAP:
                             self._failed.pop(next(iter(self._failed)))
                         self._failed[ikey] = failure
                 reply(_KIND_ERROR, seq, rule=failure)
@@ -649,6 +709,7 @@ class _Listener:
             self._sock.close()
         except OSError:
             pass
+        self._pool.shutdown(wait=False)
 
 
 class _Waiter:

@@ -17,6 +17,9 @@ measurement on the *actual* communicator —
 - :func:`tune_ring_implementation`: ppermute vs pallas for the custom
   ring, measured — the preference table stops asserting and starts
   citing numbers (the round-1 verdict's demand).
+- :func:`tune_wire_dtype`: full vs bf16 vs int8 on-wire encoding for the
+  bandwidth-path reductions (EQuARX-style block quantization) — measures
+  whether compression wins on THIS fabric and persists the answer.
 
 :func:`tune_all` runs everything; results persist per
 ``(platform, world size)`` in a JSON cache
@@ -44,6 +47,7 @@ _TUNABLE = (
     "min_buffer_size_{s}",
     "max_buffer_size_{s}",
     "ring_implementation",
+    "wire_dtype",
 )
 
 
@@ -278,6 +282,60 @@ def tune_ring_implementation(
     return winner, results
 
 
+def tune_wire_dtype(
+    comm: Optional[Communicator] = None,
+    nelem: int = 1 << 20,
+    warmup: int = 2,
+    timed: int = 4,
+    apply: bool = True,
+) -> Tuple[str, List]:
+    """Measure the wire encodings ('full', 'bf16', 'int8') for the large
+    custom-ring allreduce and set ``wire_dtype`` to the fastest CORRECT
+    one. Quantization must EARN its place on the wire: on fabrics where
+    the encode/decode cost exceeds the bandwidth saving (fast ICI, small
+    worlds) the tuner keeps 'full', and the persisted entry per
+    (platform, world size) means ``start()`` re-applies the measured
+    answer, never a guess.
+
+    Measures the ring that would actually serve the traffic: the pallas
+    RDMA ring when available (via the already-tuned
+    ``ring_implementation``), else the ppermute ring.
+
+    Requires unfrozen constants even with ``apply=False``: the sweep pins
+    each encoding by temporarily setting the ``wire_dtype`` constant."""
+    comm = _comm(comm)
+    _check_unfrozen(apply, measure_mutates=True)
+    from ..collectives.selector import backend_availability
+
+    backend = (
+        "pallas"
+        if (
+            backend_availability().get("pallas")
+            and constants.get("ring_implementation")
+            in ("pallas", "pallas_bidir")
+        )
+        else "ring"
+    )
+    prev = constants.get("wire_dtype")
+    results: List = []
+    best = (float("inf"), "full")
+    try:
+        for wire in ("full", "bf16", "int8"):
+            constants.set("wire_dtype", wire)
+            res = run_one_config(
+                "allreduce", nelem, comm, backend=backend, benchmark=True,
+                warmup=warmup, timed=timed, route_override=False,
+            )
+            results.append((wire, res.mean_us))
+            if res.correct and res.mean_us < best[0]:
+                best = (res.mean_us, wire)
+    finally:
+        constants.set("wire_dtype", prev)
+    if apply:
+        constants.set("wire_dtype", best[1])
+    return best[1], results
+
+
 def tune_all(
     comm: Optional[Communicator] = None,
     quick: bool = True,
@@ -305,6 +363,7 @@ def tune_all(
     out["ring_implementation"] = tune_ring_implementation(
         comm, nelem=big, apply=apply
     )[0]
+    out["wire_dtype"] = tune_wire_dtype(comm, nelem=big, apply=apply)[0]
     if apply and persist:
         save_tuning(comm)
     return out

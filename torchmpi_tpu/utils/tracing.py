@@ -11,6 +11,9 @@ Reference analogs (SURVEY.md §5):
 - per-rank log redirection ``LOG_TO_FILE=1`` → ``/tmp/mpi_<rank>``
   (``wrap.sh:70-77``) → :func:`redirect_logs_per_process`.
 - ``torch.Timer`` benchmark timing (``tester.lua``) → :class:`Timer`.
+- logical-vs-on-wire byte accounting for the compressed wire formats
+  (``wire_dtype``) → :data:`wire_stats` (no reference analog: the 2017
+  reference shipped full-precision bytes only).
 """
 
 from __future__ import annotations
@@ -21,7 +24,7 @@ import sys
 import threading
 import time
 from pathlib import Path
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 _DEBUG_LEVEL = int(os.environ.get("TORCHMPI_TPU_DEBUG", "0") or 0)
 
@@ -108,3 +111,70 @@ def annotate(name: str):
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+class WireByteCounters:
+    """Logical-vs-on-wire byte accounting for the bandwidth-path
+    collectives: every eager dispatch through a ring backend records its
+    per-rank payload bytes (``logical``) and the bytes its wire encoding
+    actually puts on each hop (``wire`` — int8 values padded to whole
+    blocks plus one f32 scale per block; bf16 = half; full = identity).
+    ``compression_ratio()`` is the observable the wire-format autotuner
+    and the acceptance tests read. Thread-safe; counts accumulate until
+    :meth:`reset`.
+
+    Accounting model, not a packet capture: bytes are computed from the
+    static encoding at dispatch time (compiled executables are cached, so
+    in-graph instrumentation would count once per compile, not per call).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.calls = 0
+            self.logical_bytes = 0
+            self.wire_bytes = 0
+            # (op, wire_format) -> [calls, logical, wire]
+            self.by_format: Dict[Tuple[str, str], list] = {}
+
+    def record(self, op: str, wire_format: str, logical: int,
+               wire: int) -> None:
+        with self._lock:
+            self.calls += 1
+            self.logical_bytes += int(logical)
+            self.wire_bytes += int(wire)
+            ent = self.by_format.setdefault((op, wire_format), [0, 0, 0])
+            ent[0] += 1
+            ent[1] += int(logical)
+            ent[2] += int(wire)
+
+    def compression_ratio(self) -> float:
+        """logical/wire over everything recorded (1.0 when nothing is)."""
+        with self._lock:
+            if not self.wire_bytes:
+                return 1.0
+            return self.logical_bytes / self.wire_bytes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "calls": self.calls,
+                "logical_bytes": self.logical_bytes,
+                "wire_bytes": self.wire_bytes,
+                "compression_ratio": (
+                    self.logical_bytes / self.wire_bytes
+                    if self.wire_bytes
+                    else 1.0
+                ),
+                "by_format": {
+                    f"{op}:{fmt}": tuple(v)
+                    for (op, fmt), v in self.by_format.items()
+                },
+            }
+
+
+#: process-global wire-format byte counters (see :class:`WireByteCounters`)
+wire_stats = WireByteCounters()
