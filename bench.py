@@ -110,9 +110,20 @@ def _probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> bool:
     return _PROBE_PASSED
 
 
-def _run_worker(model: str, timeout_s: float):
+def _metrics_path(base: str, model: str) -> str:
+    """Per-model telemetry snapshot path: ``m.json`` -> ``m.<model>.json``
+    (one launcher run measures several models; each worker dumps its own
+    snapshot next to the bench result)."""
+    p = Path(base)
+    suffix = p.suffix or ".json"
+    return str(p.with_name(f"{p.stem}.{model}{suffix}"))
+
+
+def _run_worker(model: str, timeout_s: float, metrics_out=None):
     """Run one measurement in a child process; return (json_dict|None, err)."""
     cmd = [sys.executable, str(HERE / "bench.py"), "--worker", model]
+    if metrics_out:
+        cmd += ["--metrics-out", metrics_out]
     try:
         # tell the worker the budget it ACTUALLY runs under (deadline
         # pressure can shrink it below WORKER_TIMEOUT_S) so its optional
@@ -182,7 +193,7 @@ def _save_last_good(model: str, obj: dict) -> None:
         pass
 
 
-def _measure(model, t0, max_attempts):
+def _measure(model, t0, max_attempts, metrics_out=None):
     """Retry-with-backoff capture of one model; returns a JSON dict always
     (an ``error`` record after final failure — carrying, clearly labeled,
     the most recent SUCCESSFUL capture of this metric if one exists, so a
@@ -222,7 +233,14 @@ def _measure(model, t0, max_attempts):
             if pause > 0:
                 time.sleep(pause)
             continue
-        obj, err = _run_worker(model, min(WORKER_TIMEOUT_S, remaining))
+        # metrics_out rides along only when requested (keeps the worker
+        # cmdline — and test doubles of _run_worker — unchanged otherwise)
+        kw = (
+            {"metrics_out": _metrics_path(metrics_out, model)}
+            if metrics_out
+            else {}
+        )
+        obj, err = _run_worker(model, min(WORKER_TIMEOUT_S, remaining), **kw)
         if obj is not None:
             if obj.get("platform") == "tpu":
                 # only real-hardware captures are evidence; a CPU dev run
@@ -253,7 +271,7 @@ def _measure(model, t0, max_attempts):
     return record
 
 
-def _launcher(models):
+def _launcher(models, metrics_out=None):
     """Capture + print each model's JSON line. Ordering is the evidence
     strategy (BENCH_r02/r03 were both lost to kills/tunnel outages):
 
@@ -264,7 +282,12 @@ def _launcher(models):
     3. Measure the secondary models (bounded attempts); print each.
     4. Re-print the north-star LAST: the fresh capture when it succeeded,
        else the stale capture (still labeled), else the error record —
-       whatever the best available evidence is. Exits 0 always."""
+       whatever the best available evidence is. Exits 0 always.
+
+    ``metrics_out``: base path for per-worker telemetry snapshots
+    (``--metrics-out``); each worker dumps its snapshot to
+    ``_metrics_path(metrics_out, model)``. Stdout stays JSON-only — the
+    metrics land in files, never in the driver-parsed stream."""
     t0 = time.monotonic()
     star_model = "mnist" if "mnist" in models else None
     stale = None
@@ -275,12 +298,18 @@ def _launcher(models):
             print(json.dumps(stale), flush=True)
     star = None
     if star_model is not None:
-        star = _measure(star_model, t0, max_attempts=4)
+        star = _measure(star_model, t0, max_attempts=4,
+                        metrics_out=metrics_out)
         print(json.dumps(star), flush=True)
     for model in models:
         if model == star_model:
             continue
-        print(json.dumps(_measure(model, t0, max_attempts=2)), flush=True)
+        print(
+            json.dumps(
+                _measure(model, t0, max_attempts=2, metrics_out=metrics_out)
+            ),
+            flush=True,
+        )
     if star_model is not None:
         # a fresh line only outranks the stale TPU capture when it is
         # itself real-hardware evidence — a CPU-fallback measurement
@@ -712,7 +741,19 @@ def main(argv=None):
         action="store_true",
         help="internal: backend liveness check (one tiny op)",
     )
+    ap.add_argument(
+        "--metrics-out",
+        default=None,
+        help="dump a telemetry metrics snapshot JSON (plus a Perfetto "
+        "trace alongside) per measured model, next to the bench result: "
+        "PATH becomes PATH-stem.<model>.json. Stdout stays JSON-only.",
+    )
     args = ap.parse_args(argv)
+
+    if args.metrics_out and args.worker:
+        # enable BEFORE the worker imports torchmpi_tpu: the telemetry
+        # module reads the env at import, so every hot path records
+        os.environ["TORCHMPI_TPU_TELEMETRY"] = "1"
 
     if args.probe:
         devices, _ = _worker_setup()
@@ -729,12 +770,18 @@ def main(argv=None):
             "resnet50": _worker_resnet50,
             "lm": _worker_lm,
         }[args.worker]()
+        if args.metrics_out:
+            # after the measurement so the snapshot carries its series;
+            # files only — the launcher parses stdout as JSON lines
+            from torchmpi_tpu import telemetry
+
+            telemetry.dump(args.metrics_out)
         return 0
 
     models = (
         ["resnet50", "lm", "mnist"] if args.model == "all" else [args.model]
     )
-    return _launcher(models)
+    return _launcher(models, metrics_out=args.metrics_out)
 
 
 if __name__ == "__main__":

@@ -122,6 +122,48 @@ def test_probe_failure_budget_is_global(bench, monkeypatch):
     assert len(calls) == n_after_first  # no further probe attempts
 
 
+def test_metrics_out_per_model_files_and_json_only_stdout(
+    bench, capsys, monkeypatch, tmp_path
+):
+    """--metrics-out threads a per-model snapshot path to every worker
+    and never touches stdout (the driver parses it as JSON lines)."""
+    bench.LAST_GOOD_FILE.write_text(json.dumps({"mnist": _stale_record()}))
+    seen = []
+
+    def worker(model, timeout_s, metrics_out=None):
+        seen.append((model, metrics_out))
+        # a real worker dumps its telemetry snapshot at this path
+        Path(metrics_out).write_text(json.dumps({"metrics": {}}))
+        return dict(_stale_record()), None
+
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_run_worker", worker)
+    out = tmp_path / "metrics.json"
+    assert bench._launcher(["resnet50", "mnist"], metrics_out=str(out)) == 0
+    assert set(seen) == {
+        ("mnist", str(tmp_path / "metrics.mnist.json")),
+        ("resnet50", str(tmp_path / "metrics.resnet50.json")),
+    }
+    for model in ("mnist", "resnet50"):
+        path = Path(bench._metrics_path(str(out), model))
+        assert json.loads(path.read_text()) == {"metrics": {}}
+    for line in capsys.readouterr().out.splitlines():
+        if line.strip():
+            obj = json.loads(line)  # stdout stayed machine-parseable
+            assert "metric" in obj
+
+
+def test_metrics_out_absent_keeps_worker_signature(bench, capsys, monkeypatch):
+    """Without --metrics-out the worker is invoked with the original
+    2-arg shape — no stray kwarg (existing tooling monkeypatches it)."""
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "_run_worker", lambda m, t: (dict(_stale_record()), None)
+    )
+    assert bench._launcher(["mnist"]) == 0
+    assert _lines(capsys)[-1]["value"] == _stale_record()["value"]
+
+
 def test_stdout_is_json_only_under_backoff_noise(bench, capsys, monkeypatch):
     """Probe/backoff/attempt-failure noise must land on STDERR only: the
     driver parses the LAST stdout line as JSON, so a single stray

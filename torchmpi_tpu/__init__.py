@@ -24,7 +24,7 @@ from . import _compat
 # submodule (and downstream user code) sees the current API surface.
 _compat.install_jax_aliases()
 
-from . import constants
+from . import constants, telemetry
 from .collectives import (
     allgather_tensor,
     allgatherv_tensor,
@@ -116,5 +116,6 @@ __all__ = [
     "collective_availability",
     "free_collective_resources",
     "constants",
+    "telemetry",
     "__version__",
 ]

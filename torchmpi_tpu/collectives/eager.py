@@ -31,6 +31,7 @@ Key reference mechanics preserved:
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from functools import partial
 from typing import Any, Callable, Optional, Tuple
@@ -41,12 +42,64 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import constants
+from .. import constants, telemetry as _telemetry
 from ..runtime.communicator import Communicator
 from ..runtime.handles import SyncHandle
 from . import primitives as prim
 
 _AXIS = "mpi"
+
+# telemetry handles, created on first instrumented dispatch (the metric
+# objects are process-lived; the disabled path never touches them)
+_MET = None
+
+
+def _metric_handles():
+    global _MET
+    if _MET is None:
+        m = _telemetry.metrics
+        _MET = (
+            m.counter(
+                "tm_collective_calls_total",
+                "eager collective dispatches by op/backend/wire",
+            ),
+            m.histogram(
+                "tm_collective_dispatch_seconds",
+                "host-side dispatch wall time per eager collective "
+                "(XLA dispatch is async: submit cost, not completion)",
+            ),
+            m.counter(
+                "tm_collective_compiles_total",
+                "executable-cache misses (compilations) by op/backend",
+            ),
+            m.counter(
+                "tm_collective_cache_hits_total",
+                "executable-cache hits by op/backend",
+            ),
+        )
+    return _MET
+
+
+def _dispatch(fn, x, op: str, backend: str, wire: str, nelem: int,
+              cache_hit: Optional[bool]):
+    """Run ``fn(x)`` (a compiled eager executable, or a composition like
+    the staged allreduce), recording the dispatch (span + metrics) when
+    telemetry is enabled; one branch when disabled. ``cache_hit=None``
+    means no single executable cache applies (multi-phase compositions)."""
+    if not _telemetry.enabled():
+        return fn(x)
+    calls, lat, compiles, hits = _metric_handles()
+    attrs = {"backend": backend, "wire_dtype": wire, "nelem": nelem}
+    if cache_hit is not None:
+        attrs["cache"] = "hit" if cache_hit else "miss"
+    t0 = time.perf_counter()
+    with _telemetry.span(f"collective.{op}", **attrs):
+        out = fn(x)
+    calls.inc(op=op, backend=backend, wire=wire)
+    lat.observe(time.perf_counter() - t0, op=op, backend=backend)
+    if cache_hit is not None:
+        (hits if cache_hit else compiles).inc(op=op, backend=backend)
+    return out
 
 
 class CollectiveArgumentError(ValueError):
@@ -142,24 +195,26 @@ def _compile(
     static: Tuple,
     build_kernel: Callable[[], Callable],
 ):
-    """Fetch-or-build the jitted executable for this (op, comm, aval)."""
+    """Fetch-or-build the jitted executable for this (op, comm, aval).
+    Returns ``(fn, cache_hit)`` so dispatch telemetry can label the call."""
     cache = _resource_cache(comm)
     donate = constants.get("donate_eager_buffers")
     # donate participates in the key: toggling the constant after first use
     # must not silently keep the old executable's aliasing behavior.
     key = (op, backend, aval, static, donate)
     fn = cache.get(key)
-    if fn is None:
-        mesh = _flat_mesh(comm)
-        ndim = len(aval[0])
-        spec = _rank_spec(ndim)
-        kernel = build_kernel()
-        shmapped = jax.shard_map(
-            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
-        )
-        fn = jax.jit(shmapped, donate_argnums=(0,) if donate else ())
-        cache[key] = fn
-    return fn
+    if fn is not None:
+        return fn, True
+    mesh = _flat_mesh(comm)
+    ndim = len(aval[0])
+    spec = _rank_spec(ndim)
+    kernel = build_kernel()
+    shmapped = jax.shard_map(
+        kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+    fn = jax.jit(shmapped, donate_argnums=(0,) if donate else ())
+    cache[key] = fn
+    return fn, False
 
 
 def _per_rank_shape(x_shape: Tuple[int, ...]) -> Tuple[int, ...]:
@@ -471,13 +526,15 @@ def run(
         elif jnp.dtype(dt).kind == "c":
             effective = "ring"
     # wire-format decision (made once, BEFORE the hierarchical split, so
-    # flat and hierarchical routes ship the same bytes) + byte accounting
+    # flat and hierarchical routes ship the same bytes). Byte accounting
+    # happens at the TERMINAL dispatch — the flat path below, or inside
+    # the hierarchical composition this call may delegate to (which also
+    # covers direct run_hierarchical_* callers).
     wire = "full"
     if effective in ("ring", "pallas") and op in _WIRE_OPS:
         wire = resolve_wire_dtype(
             op, _nelem_per_rank(x), jnp.result_type(x), wire_dtype
         )
-        _record_wire(op, _nelem_per_rank(x), jnp.result_type(x), wire)
     hier = (
         effective in ("ring", "pallas")
         # route_small=False pins the EXACT backend (tester/autotuner
@@ -517,6 +574,9 @@ def run(
         # exchange + the trailing intra broadcast
         # (collectives_cuda.cpp:569-579)
         return run_tree_hierarchical_allreduce(x, comm, wire=wire)
+    # flat terminal path: the byte accounting for this dispatch
+    if effective in ("ring", "pallas") and op in _WIRE_OPS:
+        _record_wire(op, _nelem_per_rank(x), jnp.result_type(x), wire)
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
     if (
         effective == "pallas"
@@ -546,7 +606,7 @@ def run(
     )
     aval = (tuple(x.shape), jnp.result_type(x))
     static = (root,) + extra + (tuning, wire_key)
-    fn = _compile(
+    fn, hit = _compile(
         comm,
         op,
         effective,
@@ -558,7 +618,7 @@ def run(
     sharding = _rank_sharding(comm, x.ndim)
     if getattr(x, "sharding", None) != sharding:
         x = jax.device_put(x, sharding)
-    return fn(x)
+    return _dispatch(fn, x, op, effective, wire, _nelem_per_rank(x), hit)
 
 
 def run_allgatherv(blocks, comm: Communicator, backend: str = "xla"):
@@ -624,7 +684,7 @@ def run_allgatherv(blocks, comm: Communicator, backend: str = "xla"):
         return kernel
 
     stacked_shape = (p,) + base + (nmax,)
-    fn = _compile(
+    fn, hit = _compile(
         comm, "allgatherv", backend, (stacked_shape, dtype), (sizes,),
         build_kernel,
     )
@@ -642,7 +702,9 @@ def run_allgatherv(blocks, comm: Communicator, backend: str = "xla"):
     sharding = _rank_sharding(comm, padded.ndim)
     if getattr(padded, "sharding", None) != sharding:
         padded = jax.device_put(padded, sharding)
-    return fn(padded)
+    return _dispatch(
+        fn, padded, "allgatherv", backend, "full", int(sum(sizes)), hit
+    )
 
 
 def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
@@ -689,9 +751,20 @@ def run_hierarchical_allreduce(
             "hierarchical allreduce needs a cartesian communicator with "
             "multiple intra groups of size > 1"
         )
+    # byte accounting for the composition (once per dispatch, like the
+    # flat path — run() no longer records for calls it delegates here, so
+    # direct callers and routed calls count identically)
+    if impl in ("ring", "pallas", "staged"):
+        _record_wire(
+            "allreduce", _nelem_per_rank(x), jnp.result_type(x), wire
+        )
     if impl == "staged":
-        return _run_staged_hierarchical_allreduce(
-            x, comm, staged_intra, wire
+        return _dispatch(
+            lambda a: _run_staged_hierarchical_allreduce(
+                a, comm, staged_intra, wire
+            ),
+            x, "staged_allreduce", staged_intra, wire,
+            _nelem_per_rank(x), None,
         )
     donate = constants.get("donate_eager_buffers")
     tuning = (
@@ -749,7 +822,10 @@ def run_hierarchical_allreduce(
         def kernel(b):
             return jax.lax.psum(jax.lax.psum(b, "intra"), "inter")
 
-    return _hier_compile(comm, key, x.ndim, donate, kernel)(x)
+    fn, hit = _hier_compile(comm, key, x.ndim, donate, kernel)
+    return _dispatch(
+        fn, x, "hier_allreduce", impl, wire, _nelem_per_rank(x), hit
+    )
 
 
 def _pallas_intra_ring(wire_arg: Optional[str] = None):
@@ -928,26 +1004,27 @@ def _hier_compile(comm: Communicator, key, ndim: int, donate: bool, kernel,
     """Shared scaffolding for 2-level (cartesian) compositions: permute the
     rank-stacked rows into group-major mesh order, shard_map ``kernel`` over
     the (inter, intra) mesh, permute back (+ optional ``post(out, inv)``),
-    jit with donation, memoize under ``key``."""
+    jit with donation, memoize under ``key``. Returns ``(fn, cache_hit)``."""
     cache = _resource_cache(comm)
     fn = cache.get(key)
-    if fn is None:
-        perm = np.concatenate(comm._groups).astype(np.int32)
-        inv = np.argsort(perm).astype(np.int32)
-        mesh = comm.mesh  # 2D (inter, intra)
-        spec = P(("inter", "intra"), *([None] * (ndim - 1)))
-        shmapped = jax.shard_map(
-            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
-        )
-        perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
+    if fn is not None:
+        return fn, True
+    perm = np.concatenate(comm._groups).astype(np.int32)
+    inv = np.argsort(perm).astype(np.int32)
+    mesh = comm.mesh  # 2D (inter, intra)
+    spec = P(("inter", "intra"), *([None] * (ndim - 1)))
+    shmapped = jax.shard_map(
+        kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+    perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
 
-        def run_fn(a):
-            out = jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
-            return out if post is None else post(out, inv_j)
+    def run_fn(a):
+        out = jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
+        return out if post is None else post(out, inv_j)
 
-        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
-        cache[key] = fn
-    return fn
+    fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
+    cache[key] = fn
+    return fn, False
 
 
 def run_hierarchical_collective(
@@ -1060,7 +1137,10 @@ def run_hierarchical_collective(
             blocks = out.reshape(out.shape[:-1] + (p, d))
             return jnp.take(blocks, inv_j, axis=-2).reshape(out.shape)
 
-    return _hier_compile(comm, key, x.ndim, donate, kernel, post)(x)
+    fn, hit = _hier_compile(comm, key, x.ndim, donate, kernel, post)
+    return _dispatch(
+        fn, x, f"hier_{op}", ring_impl, "full", _nelem_per_rank(x), hit
+    )
 
 
 def _binomial_reduce_steps(groups, p: int):
@@ -1110,6 +1190,8 @@ def run_tree_hierarchical_allreduce(x, comm: Communicator,
         raise CollectiveArgumentError(
             "hierarchical allreduce needs a communicator with both levels"
         )
+    # byte accounting (once per dispatch; run() delegates before recording)
+    _record_wire("allreduce", _nelem_per_rank(x), jnp.result_type(x), wire)
     cache = _resource_cache(comm)
     donate = constants.get("donate_eager_buffers")
     wire_arg = wire if wire != "full" else None
@@ -1119,6 +1201,7 @@ def run_tree_hierarchical_allreduce(x, comm: Communicator,
         (wire, block) if wire_arg else ("full",),
     )
     fn = cache.get(key)
+    hit = fn is not None
     if fn is None:
         p = comm.size
         groups = [list(map(int, g)) for g in comm._groups]
@@ -1159,7 +1242,9 @@ def run_tree_hierarchical_allreduce(x, comm: Communicator,
 
         fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
         cache[key] = fn
-    return fn(x)
+    return _dispatch(
+        fn, x, "tree_hier_allreduce", "ring", wire, _nelem_per_rank(x), hit
+    )
 
 
 def run_group_broadcast(x, comm: Communicator, root: int = 0):

@@ -34,11 +34,50 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import nn as mpinn
+from .. import nn as mpinn, telemetry as _telemetry
 from ..nn import GradientBuckets
 from ..runtime.communicator import Communicator
 
 _AXIS = "mpi"
+
+# engine telemetry handles (created on first telemetry-enabled engine)
+_ENG_MET = None
+
+
+def _engine_metrics():
+    global _ENG_MET
+    if _ENG_MET is None:
+        m = _telemetry.metrics
+        _ENG_MET = (
+            m.counter("tm_engine_steps_total", "optimizer steps taken"),
+            m.histogram(
+                "tm_engine_step_seconds",
+                "blocking wall time per training step (telemetry-enabled "
+                "engines block on the step to time it honestly)",
+            ),
+            m.histogram(
+                "tm_engine_epoch_seconds",
+                "wall time per device-resident epoch",
+            ),
+            m.gauge(
+                "tm_engine_examples_per_sec",
+                "training throughput over the last step/epoch",
+            ),
+            m.gauge(
+                "tm_engine_grad_norm",
+                "global gradient norm after synchronization",
+            ),
+            m.gauge(
+                "tm_engine_mfu",
+                "model-FLOPs utilization vs the chip's bf16 peak "
+                "(engines constructed with flops_per_sample only)",
+            ),
+            m.gauge(
+                "tm_engine_tflops_per_chip",
+                "achieved TFLOP/s per chip (flops_per_sample engines)",
+            ),
+        )
+    return _ENG_MET
 
 
 class _IdRef:
@@ -142,6 +181,7 @@ class AllReduceSGDEngine:
         accum_steps: int = 1,
         remat: bool = False,
         wire_dtype: Optional[str] = None,
+        flops_per_sample: Optional[int] = None,
     ):
         """``model_state``: optional mutable-collection pytree (e.g. flax
         ``batch_stats``). When given, ``loss_fn`` must have the signature
@@ -185,7 +225,14 @@ class AllReduceSGDEngine:
         the bucketed compressed-wire ring (block-quantized send, f32
         accumulate) — sync mode gets a single bucket. Replicated
         param_sharding only: fsdp/zero1 leave the collectives to GSPMD,
-        which has no wire-format hook."""
+        which has no wire-format hook.
+
+        ``flops_per_sample``: analytic per-sample training FLOPs (see
+        ``utils/flops.py``). Only consulted when telemetry is enabled:
+        per-step/epoch throughput is converted to achieved TFLOP/s and
+        MFU gauges. Telemetry state is captured at construction — the
+        step function is compiled against it (enabled engines additionally
+        return the global grad norm from the jitted step)."""
         if comm is None:
             from .. import runtime_state
 
@@ -238,6 +285,9 @@ class AllReduceSGDEngine:
                 "inserted by GSPMD, which has no wire-format hook)"
             )
         self.wire_dtype = wire_dtype
+        # captured once: the compiled step's output tree depends on it
+        self._telemetry = _telemetry.enabled()
+        self.flops_per_sample = flops_per_sample
         self.accum_steps = accum_steps
         self.param_sharding = param_sharding
         self.batch_format = batch_format
@@ -424,6 +474,9 @@ class AllReduceSGDEngine:
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         loss = jax.lax.pmean(loss, _AXIS)
+        if self._telemetry:
+            # grads are already synchronized: the norm is replica-identical
+            loss = (loss, optax.global_norm(grads))
         return params, opt_state, new_state, loss
 
     def _fsdp_step_core(self, params, opt_state, model_state, batch):
@@ -463,6 +516,8 @@ class AllReduceSGDEngine:
             new_state = model_state
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if self._telemetry:
+            loss = (loss, optax.global_norm(grads))
         return params, opt_state, new_state, loss
 
     def _build_step(self):
@@ -497,6 +552,45 @@ class AllReduceSGDEngine:
         return jax.jit(bcast)
 
     # ------------------------------------------------------------------
+    # telemetry plumbing: a telemetry-enabled engine's jitted step returns
+    # ``(loss, grad_norm)`` in the loss slot; these helpers unpack and
+    # feed the process-wide registry.
+    # ------------------------------------------------------------------
+    def _split_aux(self, aux):
+        """(loss, grad_norm-or-None) from a step/epoch fn's loss output."""
+        if self._telemetry:
+            return aux[0], aux[1]
+        return aux, None
+
+    def _record_step(self, examples: int, t0: float, t1: float,
+                     gnorm=None, steps: int = 1, epoch: bool = False):
+        (n_steps, step_s, epoch_s, eps, gn, mfu_g, tflops_g) = (
+            _engine_metrics()
+        )
+        dt = max(t1 - t0, 1e-12)
+        n_steps.inc(steps, mode=self.mode, sharding=self.param_sharding)
+        (epoch_s if epoch else step_s).observe(dt)
+        rate = examples / dt
+        eps.set(rate)
+        if gnorm is not None:
+            gn.set(float(gnorm))
+        if self.flops_per_sample:
+            from ..utils.flops import mfu
+
+            achieved, frac = mfu(
+                rate / self.comm.size, self.flops_per_sample,
+                self.comm._devices[0],
+            )
+            tflops_g.set(achieved / 1e12)
+            if frac is not None:
+                mfu_g.set(frac)
+        _telemetry.spans.record(
+            "engine.epoch" if epoch else "engine.step",
+            t0 * 1e6, dt * 1e6,
+            {"examples": examples, "steps": steps},
+        )
+
+    # ------------------------------------------------------------------
     # public step API (drivers/benches must not reach into privates)
     # ------------------------------------------------------------------
     def step(self, batch):
@@ -504,11 +598,26 @@ class AllReduceSGDEngine:
 
         ``batch`` may be flat ``[p*B, ...]`` or rank-stacked ``[p, B, ...]``
         (see ``batch_format``). Updates ``self.params/opt_state/model_state``
-        in place. The returned loss is a device scalar (not blocked on).
+        in place. The returned loss is a device scalar (not blocked on —
+        except under telemetry, which blocks to time the step honestly).
         """
-        self.params, self.opt_state, self.model_state, loss = self._step_fn(
-            self.params, self.opt_state, self.model_state,
-            self._prepare_batch(batch),
+        batch = self._prepare_batch(batch)
+        if not self._telemetry:
+            self.params, self.opt_state, self.model_state, loss = (
+                self._step_fn(
+                    self.params, self.opt_state, self.model_state, batch
+                )
+            )
+            return loss
+        t0 = time.perf_counter()
+        self.params, self.opt_state, self.model_state, aux = self._step_fn(
+            self.params, self.opt_state, self.model_state, batch
+        )
+        loss, gnorm = self._split_aux(aux)
+        jax.block_until_ready(loss)
+        self._record_step(
+            jax.tree_util.tree_leaves(batch)[0].shape[0],
+            t0, time.perf_counter(), gnorm,
         )
         return loss
 
@@ -710,7 +819,14 @@ class AllReduceSGDEngine:
             state["epoch_times"].append(time.perf_counter() - te)
             state["t"] += nb
             state["samples"] += nb * per_rank_batch * p
-            losses_h = np.asarray(jax.device_get(losses))
+            loss_arr, gnorms = self._split_aux(losses)
+            if self._telemetry:
+                self._record_step(
+                    nb * per_rank_batch * p,
+                    te, te + state["epoch_times"][-1],
+                    gnorms[-1], steps=nb, epoch=True,
+                )
+            losses_h = np.asarray(jax.device_get(loss_arr))
             state["loss"] = float(losses_h[-1])
             state["losses"].append(float(losses_h.mean()))
             if epoch_callback is not None:
@@ -759,50 +875,76 @@ class AllReduceSGDEngine:
             # device-syncs around the one-shot broadcast).
             self.broadcast_parameters_now()
 
-        profiling = False
+        # nvprof-window analog, managed by ProfilerWindow so the trace is
+        # ALWAYS stopped — including loops that end before the window does
+        # and exception exits (the old inline flag leaked an active trace
+        # on both). Bounds are validated by the window's constructor.
+        from ..utils.tracing import ProfilerWindow
+
+        win = (
+            ProfilerWindow(self.profile_dir, *self.profile_window)
+            if self.profile_dir
+            else None
+        )
         t_start = time.perf_counter()
-        for epoch in range(max_epochs):
-            state["epoch"] = epoch
-            loss = None
-            self._hook("on_start_epoch", state)
-            for batch in iterator_fn():
-                batch = self._prepare_batch(batch)
-                state["sample"] = batch
-                self._hook("on_sample", state)
+        try:
+            for epoch in range(max_epochs):
+                state["epoch"] = epoch
+                loss = None
+                self._hook("on_start_epoch", state)
+                for batch in iterator_fn():
+                    batch = self._prepare_batch(batch)
+                    state["sample"] = batch
+                    self._hook("on_sample", state)
 
-                if self.profile_dir and state["t"] == self.profile_window[0]:
-                    jax.profiler.start_trace(self.profile_dir)
-                    profiling = True
+                    if win is not None:
+                        if win.active and state["t"] >= win.end:
+                            # flush async dispatch before the window's
+                            # stopping step so the traced tail is complete
+                            # (params chain through every prior step)
+                            jax.block_until_ready(self.params)
+                        win.step(state["t"])
 
-                self.params, self.opt_state, self.model_state, loss = (
-                    self._step_fn(
-                        self.params, self.opt_state, self.model_state, batch
+                    if self._telemetry:
+                        t_step = time.perf_counter()
+                    self.params, self.opt_state, self.model_state, aux = (
+                        self._step_fn(
+                            self.params, self.opt_state, self.model_state,
+                            batch,
+                        )
                     )
-                )
-                state["loss"] = loss
-                self._hook("on_forward", state)
-                self._hook("on_backward", state)
-                self._hook("on_update", state)
+                    loss, gnorm = self._split_aux(aux)
+                    state["loss"] = loss
+                    self._hook("on_forward", state)
+                    self._hook("on_backward", state)
+                    self._hook("on_update", state)
 
-                if profiling and state["t"] == self.profile_window[1]:
-                    jax.block_until_ready(loss)
-                    jax.profiler.stop_trace()
-                    profiling = False
-
-                state["t"] += 1
-                state["samples"] += jax.tree_util.tree_leaves(batch)[0].shape[0]
-            if loss is None:
-                raise RuntimeError(
-                    f"iterator_fn() yielded no batches in epoch {epoch}; it "
-                    "must return a fresh iterator each call (pass a factory, "
-                    "e.g. lambda: iter(make_iterator()))"
-                )
-            state["losses"].append(float(jax.device_get(loss)))
-            self._hook("on_end_epoch", state)
+                    if self._telemetry:
+                        jax.block_until_ready(loss)
+                        self._record_step(
+                            jax.tree_util.tree_leaves(batch)[0].shape[0],
+                            t_step, time.perf_counter(), gnorm,
+                        )
+                    state["t"] += 1
+                    state["samples"] += jax.tree_util.tree_leaves(batch)[0].shape[0]
+                if loss is None:
+                    raise RuntimeError(
+                        f"iterator_fn() yielded no batches in epoch {epoch}; it "
+                        "must return a fresh iterator each call (pass a factory, "
+                        "e.g. lambda: iter(make_iterator()))"
+                    )
+                state["losses"].append(float(jax.device_get(loss)))
+                self._hook("on_end_epoch", state)
+        finally:
+            if win is not None:
+                if win.active:
+                    try:  # same flush for loops ending inside the window
+                        jax.block_until_ready(self.params)
+                    except Exception:  # noqa: BLE001 - close regardless
+                        pass
+                win.close()
         jax.block_until_ready(self.params)
         state["time"] = time.perf_counter() - t_start
-        if profiling:
-            jax.profiler.stop_trace()
         state["training"] = False
         self._hook("on_end", state)
         return state

@@ -59,6 +59,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="give each process a K-device virtual CPU mesh")
     ap.add_argument("--log-dir", default=None,
                     help="write rank_<i>.log files instead of streaming")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="enable telemetry in every rank and dump a "
+                    "per-rank metrics snapshot + Perfetto trace JSON "
+                    "(telemetry_rank_<i>.json / .trace.json) there on exit")
     ap.add_argument("--nnodes", type=int, default=1,
                     help="total hosts in the job")
     ap.add_argument("--node-rank", type=int, default=0,
@@ -147,6 +151,9 @@ def _run_world(args, target, extra, restart: int) -> int:
     log_dir = Path(args.log_dir) if args.log_dir else None
     if log_dir is not None:
         log_dir.mkdir(parents=True, exist_ok=True)
+    telemetry_dir = Path(args.telemetry_dir) if args.telemetry_dir else None
+    if telemetry_dir is not None:
+        telemetry_dir.mkdir(parents=True, exist_ok=True)
     for i in range(args.nproc):
         rank = base + i
         env = dict(
@@ -156,6 +163,16 @@ def _run_world(args, target, extra, restart: int) -> int:
             TORCHMPI_TPU_PROCESS_ID=str(rank),
             TORCHMPI_TPU_RESTART_COUNT=str(restart),
         )
+        if telemetry_dir is not None:
+            # the env var both enables telemetry in the rank and registers
+            # its atexit dump (torchmpi_tpu.telemetry import-time hook);
+            # restart attempts keep distinct files like the logs do
+            tname = (
+                f"telemetry_rank_{rank}.json" if restart == 0
+                else f"telemetry_rank_{rank}.restart{restart}.json"
+            )
+            env["TORCHMPI_TPU_TELEMETRY"] = "1"
+            env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(telemetry_dir / tname)
         if args.cpu_devices:
             env["XLA_FLAGS"] = (
                 env.get("XLA_FLAGS", "")

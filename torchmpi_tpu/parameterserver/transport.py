@@ -44,7 +44,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import constants
+from .. import constants, telemetry as _telemetry
 
 _MAGIC = 0x7E5B
 _KIND_UPDATE = 1
@@ -77,6 +77,52 @@ _MULTI_RANK = 0xFFFFFFFF
 # Entries are one small string each; failures are rare and fatal to the
 # owning client anyway, so a generous cap costs nothing.
 _FAILED_CAP = 4096
+
+# telemetry: RPC latency / retry / poison / replay series for the
+# cross-process PS path (one branch per call site when disabled)
+_KIND_NAMES = {
+    _KIND_UPDATE: "update",
+    _KIND_TRIGGER: "trigger",
+    _KIND_BARRIER: "barrier",
+    _KIND_GATHER: "gather",
+    _KIND_UPDATE_MULTI: "update_multi",
+}
+_MET = None
+
+
+def _metric_handles():
+    global _MET
+    if _MET is None:
+        m = _telemetry.metrics
+        _MET = (
+            m.counter(
+                "tm_ps_requests_total",
+                "PS transport frames submitted, by kind",
+            ),
+            m.histogram(
+                "tm_ps_rpc_latency_seconds",
+                "submit-to-reply latency per PS transport frame, by kind",
+            ),
+            m.counter(
+                "tm_ps_reconnects_total",
+                "peer-channel reconnect attempts (broken connections)",
+            ),
+            m.counter(
+                "tm_ps_replayed_frames_total",
+                "un-answered frames replayed after a reconnect",
+            ),
+            m.counter(
+                "tm_ps_poisoned_frames_total",
+                "frames recorded as failed so replays re-report the error",
+            ),
+            m.counter(
+                "tm_ps_replay_answered_total",
+                "listener-side replayed frames answered from the "
+                "dedup/poison/in-flight tables, by outcome",
+            ),
+        )
+    return _MET
+
 
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
 #        fp u32, token u32, rule_len u16, dtype_len u16, payload_len u64
@@ -312,6 +358,30 @@ class _Listener:
             ),
             thread_name_prefix="tm-ps-apply",
         )
+        # listener health producer: queue depth (frames waiting for a
+        # pool worker) + thread counts, read at snapshot time only. A
+        # weakref keeps the collector from pinning a closed listener; a
+        # rebootstrapped transport's listener re-registers over it.
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _listener_stats() -> dict:
+            listener = ref()
+            if listener is None:
+                return {"alive": False}
+            q = getattr(listener._pool, "_work_queue", None)
+            return {
+                "alive": not listener._stop.is_set(),
+                "queue_depth": q.qsize() if q is not None else None,
+                "pool_workers": len(getattr(listener._pool, "_threads", ())),
+                "conn_threads": sum(
+                    1 for t in listener._threads if t.is_alive()
+                ),
+                "port": listener.port,
+            }
+
+        _telemetry.metrics.register_collector("ps_listener", _listener_stats)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="tm-ps-listener", daemon=True
         )
@@ -514,10 +584,14 @@ class _Listener:
                         # multi): re-report from the record, never
                         # re-apply (multi items that succeeded would
                         # double)
+                        if _telemetry.enabled():
+                            _metric_handles()[5].inc(outcome="poisoned")
                         reply(_KIND_ERROR, seq, rule=poisoned)
                         continue
                     if replay_applied:
                         # retry of an already-applied update: ack only
+                        if _telemetry.enabled():
+                            _metric_handles()[5].inc(outcome="acked")
                         reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
                         continue
                     if not owner:
@@ -529,6 +603,8 @@ class _Listener:
                         # owner's _finish_update (a pool task) sets the
                         # event — parked on a pool worker it could starve
                         # the very task it waits for.
+                        if _telemetry.enabled():
+                            _metric_handles()[5].inc(outcome="waited")
                         _threading.Thread(
                             target=self._await_other_apply,
                             args=(reply, dkey, seq, pending, inst_id,
@@ -614,6 +690,8 @@ class _Listener:
             if kind == _KIND_UPDATE_MULTI and seq and applied_any:
                 # items that DID apply must never re-apply on a replay
                 # whose ERROR response was lost: poison the (key, seq)
+                if _telemetry.enabled():
+                    _metric_handles()[4].inc(site="partial_post")
                 with self._applied_lock:
                     while len(self._failed) >= _FAILED_CAP:
                         self._failed.pop(next(iter(self._failed)))
@@ -669,6 +747,8 @@ class _Listener:
                 # high-water mark past this seq, and an unpoisoned replay
                 # would then be answered with a false ACK (ADVICE r5).
                 if seq:
+                    if _telemetry.enabled():
+                        _metric_handles()[4].inc(site="apply_failed")
                     with self._applied_lock:
                         while len(self._failed) >= _FAILED_CAP:
                             self._failed.pop(next(iter(self._failed)))
@@ -714,15 +794,18 @@ class _Listener:
 
 class _Waiter:
     """One in-flight request: the raw frame (retained so a reconnect can
-    replay it in original order) and the completion slot."""
+    replay it in original order) and the completion slot. ``t0``/``kind``
+    are telemetry fields (set only when telemetry is enabled)."""
 
-    __slots__ = ("event", "frame", "reply", "error")
+    __slots__ = ("event", "frame", "reply", "error", "t0", "kind")
 
     def __init__(self, frame: bytes):
         self.event = threading.Event()
         self.frame = frame
         self.reply = None
         self.error: Optional[Exception] = None
+        self.t0: Optional[float] = None
+        self.kind: int = 0
 
 
 class _PeerChannel:
@@ -878,6 +961,10 @@ class _PeerChannel:
                 )
                 return
             self._unacked_replays += 1
+            if _telemetry.enabled():
+                _, _, reconnects, replayed, _, _ = _metric_handles()
+                reconnects.inc()
+                replayed.inc(len(self.pending))
             try:
                 sock = self._connected_locked()
                 for w in self.pending.values():
@@ -962,6 +1049,12 @@ class _PeerChannel:
                     payload_raw,
                 )
             )
+            if _telemetry.enabled():
+                w.t0 = time.monotonic()
+                w.kind = kind
+                _metric_handles()[0].inc(
+                    kind=_KIND_NAMES.get(kind, str(kind))
+                )
             sock = self._connected_locked()  # raises if unreachable
             self.pending[seq] = w
             try:
@@ -1002,6 +1095,11 @@ class _PeerChannel:
             )
         if w.error is not None:
             raise w.error
+        if w.t0 is not None and _telemetry.enabled():
+            _metric_handles()[1].observe(
+                time.monotonic() - w.t0,
+                kind=_KIND_NAMES.get(w.kind, str(w.kind)),
+            )
         rkind, _, _, _, _, _, rrule, rdtype, rpayload = w.reply
         if rkind == _KIND_ERROR:
             raise RuntimeError(f"parameter-server peer error: {rrule}")
@@ -1073,7 +1171,11 @@ class Transport:
         # fixed-width byte matrix: "host:port" padded to 256
         me = f"{host}:{port}".encode()[:256].ljust(256, b"\0")
         mine = np.frombuffer(me, np.uint8)
-        gathered = multihost_utils.process_allgather(mine)
+        # reshape defensively: a single-process allgather comes back flat
+        # (256,), not (1, 256) — indexing row p would slice one BYTE
+        gathered = np.asarray(
+            multihost_utils.process_allgather(mine)
+        ).reshape(n, -1)
         out: Dict[int, Tuple[str, int]] = {}
         for p in range(n):
             s = bytes(gathered[p]).rstrip(b"\0").decode()

@@ -1,0 +1,212 @@
+"""Unified telemetry: metrics registry, collective spans, trace export.
+
+The reference's observability was nvprof windows plus VLOG macros
+(SURVEY.md §5); this subsystem gives the grown framework the three pillars
+production serving actually needs:
+
+1. **Metrics** (:data:`metrics`): thread-safe labelled counters / gauges /
+   fixed-bucket histograms, exported as a JSON snapshot and as Prometheus
+   text (:func:`prometheus_text`). ``utils.tracing.wire_stats`` (the
+   logical-vs-wire byte accounting from the quantized wire formats) is
+   registered as a snapshot collector, so every dump carries it.
+2. **Spans** (:func:`span`): a low-overhead timed-region context manager
+   recording into a bounded ring buffer, exported as Chrome
+   ``trace_event`` JSON loadable in Perfetto / chrome://tracing
+   (:func:`export_trace`), with ``jax.profiler.TraceAnnotation``
+   pass-through so the same names appear in XLA traces.
+3. **Audit log** (:func:`audit`): a small bounded journal of discrete
+   decisions (autotuner knob choices, tuning-cache loads) included in
+   every snapshot.
+
+Gating: telemetry is OFF unless ``TORCHMPI_TPU_TELEMETRY`` is truthy or
+:func:`enable` is called. Instrumented hot paths pay exactly one branch
+when disabled, and ``span()`` returns a shared no-op singleton — no
+allocation per disabled call. Setting ``TORCHMPI_TPU_TELEMETRY_DUMP`` to a
+path enables telemetry AND registers an atexit dump there (how
+``python -m torchmpi_tpu.launch --telemetry-dir`` collects per-rank
+snapshots).
+
+This package imports only the standard library: the bench launcher and
+other jax-free processes may use it directly.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import List, Optional
+
+from .registry import (  # noqa: F401 - re-exported
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .spans import NOOP_SPAN, Span, SpanRecorder
+
+
+def _env_true(name: str, default: str = "") -> bool:
+    return os.environ.get(name, default).lower() in ("1", "true", "yes", "on")
+
+
+_enabled = _env_true("TORCHMPI_TPU_TELEMETRY")
+
+#: process-global metrics registry
+metrics = MetricsRegistry()
+
+#: process-global span ring buffer
+spans = SpanRecorder(
+    capacity=int(os.environ.get("TORCHMPI_TPU_TELEMETRY_SPANS", "4096") or 4096)
+)
+
+# decision audit journal (autotuner choices etc.) — tiny and always on:
+# decisions are rare and must be reconstructable even when the metric hot
+# paths were disabled at the time
+_audit_lock = threading.Lock()
+_audit: deque = deque(maxlen=256)
+
+
+def enabled() -> bool:
+    """Whether the instrumented hot paths record. One branch per call
+    site; the env var ``TORCHMPI_TPU_TELEMETRY`` sets the initial state."""
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def span(name: str, **attrs):
+    """Timed-region context manager. Disabled -> a shared no-op object
+    (zero allocation); enabled -> records wall time + ``attrs`` into the
+    ring buffer and passes through as a ``jax.profiler.TraceAnnotation``.
+
+    Hot paths that build attrs dicts should guard the whole call with
+    ``if telemetry.enabled():`` so the disabled path stays one branch.
+    """
+    if not _enabled:
+        return NOOP_SPAN
+    return Span(spans, name, attrs or None)
+
+
+def audit(event: str, **fields) -> None:
+    """Append one decision record to the bounded audit journal."""
+    rec = {"event": event, "time": time.time()}
+    rec.update(fields)
+    with _audit_lock:
+        _audit.append(rec)
+
+
+def audit_log() -> List[dict]:
+    with _audit_lock:
+        return list(_audit)
+
+
+def snapshot() -> dict:
+    """One JSON-serializable view of everything: metrics (+ collector
+    producers like ``wire_stats``), the audit journal, span-buffer
+    occupancy."""
+    return {
+        "enabled": _enabled,
+        "pid": os.getpid(),
+        "time": time.time(),
+        "metrics": metrics.snapshot(),
+        "audit": audit_log(),
+        "spans": {
+            "buffered": len(spans),
+            "recorded": spans.total_recorded,
+            "capacity": spans.capacity,
+        },
+    }
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition of the typed metrics."""
+    return metrics.prometheus()
+
+
+def trace_events() -> list:
+    """The span buffer as a Chrome ``trace_event`` list."""
+    return spans.trace_events()
+
+
+def export_trace(path) -> Path:
+    """Write the span buffer as Perfetto-loadable trace JSON."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    spans.export(path)
+    return path
+
+
+def trace_path_for(path) -> Path:
+    """The trace file that rides along with a snapshot at ``path``:
+    ``foo.json`` -> ``foo.trace.json``."""
+    path = Path(path)
+    suffix = path.suffix or ".json"
+    return path.with_name(f"{path.stem}.trace{suffix}")
+
+
+def dump(path) -> List[Path]:
+    """Write the metrics snapshot JSON to ``path`` and the span trace to
+    :func:`trace_path_for` ``(path)``; returns both paths. Safe to call
+    with telemetry disabled (dumps whatever was recorded)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+    tmp.write_text(json.dumps(snapshot(), indent=2, default=str))
+    os.replace(tmp, path)
+    trace = export_trace(trace_path_for(path))
+    return [path, trace]
+
+
+def reset() -> None:
+    """Clear recorded series, spans, and audit entries (metric objects and
+    collectors stay registered)."""
+    metrics.reset()
+    spans.reset()
+    with _audit_lock:
+        _audit.clear()
+
+
+# ---------------------------------------------------------------------------
+# wire_stats producer: the PR-2 logical-vs-wire byte counters ride along in
+# every snapshot. Lazy import: tracing pulls jax-adjacent utils only when
+# the snapshot is actually taken inside a framework process.
+# ---------------------------------------------------------------------------
+
+
+def _wire_stats_collector() -> dict:
+    from ..utils import tracing
+
+    return tracing.wire_stats.snapshot()
+
+
+metrics.register_collector("wire_stats", _wire_stats_collector)
+
+
+# ---------------------------------------------------------------------------
+# per-rank dump on exit (the launcher's --telemetry-dir sets the env var)
+# ---------------------------------------------------------------------------
+
+_DUMP_PATH = os.environ.get("TORCHMPI_TPU_TELEMETRY_DUMP", "")
+if _DUMP_PATH:
+    _enabled = True
+
+    def _dump_at_exit(path: str = _DUMP_PATH) -> None:
+        try:
+            dump(path)
+        except Exception:  # noqa: BLE001 - never break interpreter exit
+            pass
+
+    atexit.register(_dump_at_exit)

@@ -1,0 +1,256 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+The reference shipped no metric surface at all (nvprof windows and VLOG
+macros were the whole story, SURVEY.md §5); production serving needs the
+numbers themselves. This registry is deliberately tiny and dependency-free:
+
+- every metric is **labelled** (a ``dict`` of string label -> value) and
+  **thread-safe** (one lock per metric; the hot path is one dict update);
+- histograms use **fixed bucket boundaries** chosen at creation, so
+  ``observe`` is O(len(buckets)) with zero allocation after the first
+  labelset;
+- the registry renders both a JSON :meth:`snapshot` (the ``telemetry.dump``
+  payload) and Prometheus text exposition (:meth:`prometheus`);
+- external producers plug in as **collectors** — callables returning a
+  plain dict merged into the snapshot (``utils.tracing.wire_stats`` is
+  registered this way, so the logical-vs-wire byte accounting appears in
+  every snapshot without tracing depending on this module).
+
+Metric *objects* are process-lived: instrumented modules fetch them once at
+import and call ``inc``/``set``/``observe`` forever after; :meth:`reset`
+clears the recorded series but never invalidates the objects.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+# Default histogram boundaries: latency-shaped, spanning 10µs .. 100s.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    1e-5, 1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 10.0, 100.0
+)
+
+
+def _label_key(labels: Dict[str, object]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: Tuple[Tuple[str, str], ...]) -> str:
+    return ",".join(f"{k}={v}" for k, v in key)
+
+
+def _prom_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[Tuple[str, str], ...], object] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            series = {
+                _label_str(k): self._snap_value(v)
+                for k, v in self._series.items()
+            }
+        return {"kind": self.kind, "help": self.help, "series": series}
+
+    def _snap_value(self, v):
+        return v
+
+    def _prom_lines(self):
+        with self._lock:
+            items = list(self._series.items())
+        for key, v in items:
+            yield f"{self.name}{_prom_labels(key)} {v}"
+
+    def prometheus(self) -> str:
+        head = []
+        if self.help:
+            head.append(f"# HELP {self.name} {self.help}")
+        head.append(f"# TYPE {self.name} {self.kind}")
+        return "\n".join(head + list(self._prom_lines()))
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0) + value
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+    def total(self) -> float:
+        """Sum over every labelset (the 'is anything happening' read)."""
+        with self._lock:
+            return sum(self._series.values())
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = value
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._series.get(_label_key(labels))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket boundary")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            state = self._series.get(k)
+            if state is None:
+                # counts per finite bucket + one +Inf overflow slot
+                state = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[k] = state
+            counts, _, _ = state
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            state[1] += value
+            state[2] += 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            state = self._series.get(_label_key(labels))
+            return state[2] if state else 0
+
+    def total_count(self) -> int:
+        with self._lock:
+            return sum(s[2] for s in self._series.values())
+
+    def _snap_value(self, state):
+        counts, total, n = state
+        return {
+            "buckets": {
+                **{str(b): counts[i] for i, b in enumerate(self.buckets)},
+                "+Inf": counts[-1],
+            },
+            "sum": total,
+            "count": n,
+        }
+
+    def _prom_lines(self):
+        with self._lock:
+            items = [
+                (k, (list(s[0]), s[1], s[2])) for k, s in self._series.items()
+            ]
+        for key, (counts, total, n) in items:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                le = 'le="%s"' % b
+                yield f"{self.name}_bucket{_prom_labels(key, le)} {cum}"
+            inf = 'le="+Inf"'
+            yield f"{self.name}_bucket{_prom_labels(key, inf)} {n}"
+            yield f"{self.name}_sum{_prom_labels(key)} {total}"
+            yield f"{self.name}_count{_prom_labels(key)} {n}"
+
+
+class MetricsRegistry:
+    """Name -> metric table plus pluggable snapshot collectors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: Dict[str, Callable[[], dict]] = {}
+
+    def _get(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            elif "buckets" in kw and tuple(
+                sorted(float(b) for b in kw["buckets"])
+            ) != m.buckets:
+                # silently bucketing a second caller's observations by the
+                # first caller's boundaries would corrupt its distribution
+                raise ValueError(
+                    f"histogram {name!r} already registered with buckets "
+                    f"{m.buckets}, requested {tuple(kw['buckets'])}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def register_collector(self, name: str, fn: Callable[[], dict]) -> None:
+        """Attach an external producer; ``fn()`` runs at snapshot time and
+        its dict lands under ``name``. Re-registering replaces (the PS
+        listener re-registers on every transport bootstrap)."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            metrics = list(self._metrics.values())
+            collectors = list(self._collectors.items())
+        out = {m.name: m.snapshot() for m in metrics}
+        for name, fn in collectors:
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001 - a broken producer must
+                # never take the snapshot down with it
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def prometheus(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.prometheus() for m in metrics) + (
+            "\n" if metrics else ""
+        )
+
+    def reset(self) -> None:
+        """Clear every recorded series; metric objects (held by the
+        instrumented modules) and collectors stay registered."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.reset()

@@ -35,9 +35,23 @@ import os
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-from .. import constants
+from .. import constants, telemetry
 from ..runtime.communicator import Communicator
 from .tester import run_one_config, sweep_sizes
+
+
+def _audit_decision(knob: str, chosen, applied: bool, candidates) -> None:
+    """Every tuned knob lands in the telemetry audit journal with the
+    measurements that justified it — the decision log the reference's
+    'YMMV' comment never had. Always on: tuning is a cold path and the
+    journal is bounded."""
+    telemetry.audit(
+        "autotune",
+        knob=knob,
+        chosen=chosen,
+        applied=bool(applied),
+        candidates=[list(c) for c in candidates],
+    )
 
 # constants a tuning run may set; only these are persisted/applied
 _TUNABLE = (
@@ -109,6 +123,7 @@ def _tune_small_cutoff(
     cutoff = crossover if crossover is not None else 1 << (max_pow + 4)
     if apply:
         constants.set(f"small_{op}_size_{suffix}", int(cutoff))
+    _audit_decision(f"small_{op}_size_{suffix}", int(cutoff), apply, results)
     return int(cutoff), results
 
 
@@ -189,6 +204,9 @@ def tune_tree_pipeline_switch(
     switch = crossover_bytes if crossover_bytes is not None else 1 << 62
     if apply:
         constants.set(f"broadcast_size_tree_based_{suffix}", int(switch))
+    _audit_decision(
+        f"broadcast_size_tree_based_{suffix}", int(switch), apply, results
+    )
     return int(switch), results
 
 
@@ -231,6 +249,7 @@ def tune_chunk_size(
     if apply:
         constants.set(max_name, int(best[1]))
         constants.set(min_name, int(max(1, best[1] // 8)))
+    _audit_decision(max_name, int(best[1]), apply, results)
     return int(best[1]), results
 
 
@@ -279,6 +298,7 @@ def tune_ring_implementation(
             constants.set("ring_implementation", prev)
     if apply:
         constants.set("ring_implementation", winner)
+    _audit_decision("ring_implementation", winner, apply, results)
     return winner, results
 
 
@@ -333,6 +353,7 @@ def tune_wire_dtype(
         constants.set("wire_dtype", prev)
     if apply:
         constants.set("wire_dtype", best[1])
+    _audit_decision("wire_dtype", best[1], apply, results)
     return best[1], results
 
 
@@ -435,10 +456,15 @@ def load_tuning(
     if apply:
         suffix = _suffix(comm)
         valid = {t.format(s=suffix) for t in _TUNABLE}
+        applied = {}
         for name, value in entry.items():
             if name in valid:
                 try:
                     constants.set(name, value)
+                    applied[name] = value
                 except Exception:
                     pass  # type drift in an old cache: keep the default
+        telemetry.audit(
+            "autotune_load", key=_cache_key(comm), applied=applied
+        )
     return entry

@@ -63,15 +63,34 @@ class ProfilerWindow:
     nvprof-window analog, usable standalone:
 
         win = ProfilerWindow('/tmp/trace', 3, 8)
-        for step in ...:
-            win.step(step)   # starts/stops the trace at the boundaries
+        try:
+            for step in ...:
+                win.step(step)   # starts/stops the trace at the boundaries
+        finally:
+            win.close()          # loops shorter than the window, and
+                                 # exception exits, must still stop it
     """
 
     def __init__(self, log_dir: str, begin: int = 3, end: int = 8):
+        begin, end = int(begin), int(end)
+        if begin < 0 or end <= begin:
+            # a [begin, end) window with end <= begin would start a trace
+            # it stops one step late (or never, if the loop ends first)
+            raise ValueError(
+                f"profiler window must satisfy 0 <= begin < end, got "
+                f"[{begin}, {end})"
+            )
         self.log_dir = log_dir
         self.begin = begin
         self.end = end
         self._active = False
+
+    @property
+    def active(self) -> bool:
+        """Whether a trace is currently open (callers that can name the
+        in-flight arrays should block on them before the stopping
+        ``step``/``close`` so async dispatch tails land in the trace)."""
+        return self._active
 
     def step(self, step: int) -> None:
         import jax
