@@ -69,6 +69,13 @@ PROBE_TIMEOUT_S = int(os.environ.get("TORCHMPI_TPU_BENCH_PROBE_TIMEOUT", "60"))
 MAX_PROBE_FAILURES = 3
 BACKOFFS_S = (15, 30, 60)
 LAST_GOOD_FILE = HERE / ".bench_last_good.json"
+# Oldest last-good capture the launcher will still REPLAY as evidence.
+# Stale r3 data was re-emitted verbatim in rounds 4/5 with no age signal;
+# now every replayed line carries ``stale_age_days`` and a capture older
+# than this is refused (the error record still cites it, clearly labeled).
+MAX_STALE_DAYS = float(
+    os.environ.get("TORCHMPI_TPU_BENCH_MAX_STALE_DAYS", "45")
+)
 
 
 _PROBE_PASSED = False  # once alive, stay trusted (workers have timeouts)
@@ -181,6 +188,40 @@ def _load_last_good() -> dict:
         return {}
 
 
+def _stale_age_days(rec: dict):
+    """Age in days of a last-good capture, from its ``captured_at`` stamp
+    (UTC); None when the stamp is absent or unparseable (old caches)."""
+    ts = rec.get("captured_at")
+    if not ts:
+        return None
+    try:
+        import calendar
+
+        t = calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except (ValueError, TypeError):
+        return None
+    return max(0.0, (time.time() - t) / 86400.0)
+
+
+def _replayable_stale(rec: dict):
+    """The stale line for a last-good capture: annotated with its age, or
+    None when the capture is older than MAX_STALE_DAYS (refuse to replay
+    evidence that old — an error record is more honest)."""
+    age = _stale_age_days(rec)
+    if age is not None and age > MAX_STALE_DAYS:
+        print(
+            f"# last-good capture is {age:.1f} days old "
+            f"(> {MAX_STALE_DAYS:g}); refusing to replay it as evidence",
+            file=sys.stderr,
+            flush=True,
+        )
+        return None
+    out = dict(rec, stale=True)
+    if age is not None:
+        out["stale_age_days"] = round(age, 1)
+    return out
+
+
 def _save_last_good(model: str, obj: dict) -> None:
     try:
         rec = _load_last_good()
@@ -267,7 +308,14 @@ def _measure(model, t0, max_attempts, metrics_out=None):
     }
     prior = _load_last_good().get(model)
     if prior is not None:
-        record["last_good_capture"] = prior
+        # cited, not replayed: age-annotated so a reader knows how old the
+        # evidence is even when it exceeds the replay window
+        age = _stale_age_days(prior)
+        record["last_good_capture"] = (
+            dict(prior, stale_age_days=round(age, 1))
+            if age is not None
+            else prior
+        )
     return record
 
 
@@ -294,8 +342,9 @@ def _launcher(models, metrics_out=None):
     if star_model is not None:
         prior = _load_last_good().get(star_model)
         if prior is not None:
-            stale = dict(prior, stale=True)
-            print(json.dumps(stale), flush=True)
+            stale = _replayable_stale(prior)
+            if stale is not None:
+                print(json.dumps(stale), flush=True)
     star = None
     if star_model is not None:
         star = _measure(star_model, t0, max_attempts=4,
@@ -719,6 +768,139 @@ def _worker_lm():
     mpi.stop()
 
 
+# --------------------------------------------------------------------------
+# Eager-dispatch latency microbench (CPU-capturable): perf evidence for the
+# latency path that does not need the TPU tunnel at all.
+# --------------------------------------------------------------------------
+
+
+def _microbench(check: bool = False, iters: int = 30) -> int:
+    """Measure eager-dispatch latency for the canonical LeNet gradient
+    set, fused (FusionBuffer coalescing) vs unfused (one ``run_async``
+    per tensor), cold cache vs warm — entirely on CPU, so the number is
+    capturable while the TPU tunnel is dead. The timed region is the
+    SUBMIT side only (handle creation + flush dispatch), matching the
+    reference's <50µs async-launch framing (test/collectives_all.lua:
+    192-199); completion is drained between laps, untimed.
+
+    Also asserts the AOT contract: after ``precompile()`` of the declared
+    specs, a full fused+unfused pass must add ZERO entries to the
+    telemetry compile-cache miss counter. ``check`` turns the two
+    correctness-of-direction assertions (fused <= unfused per-tensor,
+    zero post-precompile compiles) into the exit code for CI."""
+    os.environ.setdefault("TORCHMPI_TPU_FORCE_CPU", "1")
+    _worker_setup()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import constants, telemetry
+    from torchmpi_tpu.collectives import eager, get_fusion_buffer
+    from torchmpi_tpu.utils.autotune import LENET_LEAF_SIZES
+
+    telemetry.enable()
+    mpi.start()
+    comm = mpi.current_communicator()
+    p = comm.size
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # device-resident, rank-sharded tensors — where gradients actually
+    # live in training; dispatch is measured without staging noise
+    sharding = NamedSharding(comm.flat_mesh("mpi"), P("mpi"))
+    xs = [
+        jax.device_put(jnp.ones((p, n), jnp.float32), sharding)
+        for n in LENET_LEAF_SIZES
+    ]
+    jax.block_until_ready(xs)
+    n_tensors = len(xs)
+
+    def compile_misses() -> int:
+        series = (
+            telemetry.snapshot()["metrics"]
+            .get("tm_collective_compiles_total", {})
+            .get("series", {})
+        )
+        return int(sum(series.values()))
+
+    def unfused_pass():
+        t0 = time.perf_counter()
+        hs = [mpi.async_.allreduce_tensor(x, comm=comm) for x in xs]
+        dt = time.perf_counter() - t0
+        for h in hs:
+            h.wait()
+        return dt
+
+    def fused_pass():
+        fb = get_fusion_buffer(comm)
+        t0 = time.perf_counter()
+        hs = [fb.submit("allreduce", x) for x in xs]
+        fb.flush_all(reason="explicit")
+        dt = time.perf_counter() - t0
+        for h in hs:
+            h.wait()
+        return dt
+
+    # cold: first pass pays lower+compile for every distinct shape
+    eager.free_collective_resources(comm)
+    cold_unfused_s = unfused_pass()
+    eager.free_collective_resources(comm)
+    cold_fused_s = fused_pass()
+
+    # warm: steady-state submit cost, median over the laps
+    warm_unfused_s = float(np.median([unfused_pass() for _ in range(iters)]))
+    warm_fused_s = float(np.median([fused_pass() for _ in range(iters)]))
+
+    # AOT: precompile the declared specs, then a full pass must not
+    # compile anything (the telemetry miss counter is the assertion)
+    eager.free_collective_resources(comm)
+    specs = [("allreduce", (p, n), jnp.float32) for n in LENET_LEAF_SIZES]
+    specs.append(
+        {"op": "allreduce", "layout": LENET_LEAF_SIZES, "dtype": jnp.float32}
+    )
+    eager.precompile(specs, comm=comm)
+    misses_before = compile_misses()
+    unfused_pass()
+    fused_pass()
+    compiles_after = compile_misses() - misses_before
+
+    fused_us = warm_fused_s / n_tensors * 1e6
+    unfused_us = warm_unfused_s / n_tensors * 1e6
+    line = {
+        "metric": "eager dispatch per-tensor latency (LeNet gradient set)",
+        "value": round(fused_us, 2),
+        "unit": "us/tensor",
+        "platform": "cpu",
+        "world_size": p,
+        "tensors": n_tensors,
+        "fused_us_per_tensor": round(fused_us, 2),
+        "unfused_us_per_tensor": round(unfused_us, 2),
+        "fused_vs_unfused": round(fused_us / max(unfused_us, 1e-9), 4),
+        "cold_fused_ms": round(cold_fused_s * 1e3, 2),
+        "cold_unfused_ms": round(cold_unfused_s * 1e3, 2),
+        "warm_vs_cold_fused": round(
+            warm_fused_s / max(cold_fused_s, 1e-12), 4
+        ),
+        "compiles_after_precompile": compiles_after,
+        "fusion_buffer_bytes": constants.get("fusion_buffer_bytes"),
+    }
+    print(json.dumps(line), flush=True)
+    mpi.stop()
+    if check:
+        ok = fused_us <= unfused_us and compiles_after == 0
+        if not ok:
+            print(
+                f"# perf-smoke FAILED: fused {fused_us:.1f}us vs unfused "
+                f"{unfused_us:.1f}us per tensor, "
+                f"{compiles_after} post-precompile compiles",
+                file=sys.stderr,
+                flush=True,
+            )
+        return 0 if ok else 1
+    return 0
+
+
 def main(argv=None):
     import argparse
 
@@ -748,7 +930,23 @@ def main(argv=None):
         "trace alongside) per measured model, next to the bench result: "
         "PATH becomes PATH-stem.<model>.json. Stdout stays JSON-only.",
     )
+    ap.add_argument(
+        "--microbench",
+        action="store_true",
+        help="eager-dispatch latency microbench (LeNet gradient set, "
+        "fused vs unfused, cold vs warm cache) — runs on CPU in-process, "
+        "no TPU tunnel needed; prints one JSON line",
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="with --microbench: exit 1 unless fused dispatch <= unfused "
+        "and precompile() eliminated warm-path compiles (CI perf-smoke)",
+    )
     args = ap.parse_args(argv)
+
+    if args.microbench:
+        return _microbench(check=args.check)
 
     if args.metrics_out and args.worker:
         # enable BEFORE the worker imports torchmpi_tpu: the telemetry

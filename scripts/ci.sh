@@ -14,6 +14,18 @@ tier="${1:-all}"
 run_fast() {
     echo "=== fast tier (unit + interpret p<=3 + single-process) ==="
     python -m pytest tests/ -q -m "not slow"
+    run_perf_smoke
+}
+
+run_perf_smoke() {
+    # perf-smoke: the eager-dispatch microbench must run to completion on
+    # CPU and show fused dispatch <= unfused for the canonical LeNet
+    # bucket set (correctness-of-direction, not absolute timing), with
+    # zero collective compiles after precompile(). --check encodes both
+    # assertions in the exit code.
+    echo "=== perf-smoke (eager dispatch microbench, CPU) ==="
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python bench.py --microbench --check
 }
 
 run_slow_a() {
@@ -30,9 +42,10 @@ run_slow_b() {
 
 case "$tier" in
     fast) run_fast ;;
+    perf-smoke) run_perf_smoke ;;
     slow-a) run_slow_a ;;
     slow-b) run_slow_b ;;
     all) run_fast; run_slow_a; run_slow_b ;;
-    *) echo "usage: scripts/ci.sh [fast|slow-a|slow-b|all]" >&2; exit 2 ;;
+    *) echo "usage: scripts/ci.sh [fast|perf-smoke|slow-a|slow-b|all]" >&2; exit 2 ;;
 esac
 echo "Success"

@@ -164,6 +164,59 @@ def test_metrics_out_absent_keeps_worker_signature(bench, capsys, monkeypatch):
     assert _lines(capsys)[-1]["value"] == _stale_record()["value"]
 
 
+def _aged_record(days: float):
+    import time as _time
+
+    stamp = _time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() - days * 86400)
+    )
+    return dict(_stale_record(), captured_at=stamp)
+
+
+def test_stale_replay_is_age_annotated(bench, capsys):
+    """Replayed last-good lines carry stale_age_days — stale r3 data was
+    re-emitted verbatim in rounds 4/5 with no age signal (PR-4
+    satellite)."""
+    bench.LAST_GOOD_FILE.write_text(
+        json.dumps({"mnist": _aged_record(3.0)})
+    )
+    bench._PROBE_FAILURES = bench.MAX_PROBE_FAILURES
+    assert bench._launcher(["mnist"]) == 0
+    lines = _lines(capsys)
+    assert lines[0]["stale"] is True
+    assert 2.5 <= lines[0]["stale_age_days"] <= 3.5
+    assert lines[-1]["stale_age_days"] == lines[0]["stale_age_days"]
+
+
+def test_stale_replay_refused_past_max_age(bench, capsys):
+    """A capture older than MAX_STALE_DAYS is not replayed as evidence;
+    the error record still cites it (age-annotated, clearly labeled)."""
+    bench.LAST_GOOD_FILE.write_text(
+        json.dumps({"mnist": _aged_record(bench.MAX_STALE_DAYS + 10)})
+    )
+    bench._PROBE_FAILURES = bench.MAX_PROBE_FAILURES
+    assert bench._launcher(["mnist"]) == 0
+    lines = _lines(capsys)
+    assert not any(l.get("stale") for l in lines), "over-age replayed"
+    assert lines[-1]["value"] is None and "error" in lines[-1]
+    cited = lines[-1]["last_good_capture"]
+    assert cited["value"] == 397277.1
+    assert cited["stale_age_days"] > bench.MAX_STALE_DAYS
+
+
+def test_stale_age_unparseable_stamp_still_replays(bench, capsys):
+    """Old caches without a parseable captured_at keep replaying (age
+    unknown is not age infinite) — backward compatibility."""
+    rec = dict(_stale_record())
+    del rec["captured_at"]
+    bench.LAST_GOOD_FILE.write_text(json.dumps({"mnist": rec}))
+    bench._PROBE_FAILURES = bench.MAX_PROBE_FAILURES
+    assert bench._launcher(["mnist"]) == 0
+    lines = _lines(capsys)
+    assert lines[0]["stale"] is True
+    assert "stale_age_days" not in lines[0]
+
+
 def test_stdout_is_json_only_under_backoff_noise(bench, capsys, monkeypatch):
     """Probe/backoff/attempt-failure noise must land on STDERR only: the
     driver parses the LAST stdout line as JSON, so a single stray

@@ -18,7 +18,8 @@ import jax
 from ..runtime.communicator import Communicator
 from ..runtime.handles import SyncHandle
 from . import eager, primitives
-from .eager import free_collective_resources
+from .eager import free_collective_resources, precompile
+from .fusion import FusionBuffer, get_fusion_buffer
 from .selector import collective_availability, selector
 
 
@@ -43,7 +44,10 @@ def _dispatch(op, x, comm, mode, backend=None, **kw):
         if backend is None:
             platform = comm._devices[0].platform
             backend = selector.select(
-                op, platform, multinode=comm.num_nodes() > 1, mode=mode
+                op, platform, multinode=comm.num_nodes() > 1,
+                # the fused plan dispatches synchronously; the selector
+                # table only distinguishes sync/async
+                mode="sync" if mode == "fused" else mode,
             )
             cache[(op, mode)] = backend
         if backend in ("ring", "pallas"):
@@ -62,6 +66,10 @@ def _dispatch(op, x, comm, mode, backend=None, **kw):
                 backend = "ring"
     if mode == "sync":
         return eager.run(op, x, comm, backend=backend, **kw)
+    if mode == "fused":
+        # x is a LIST of same-dtype [p, n_i] slabs; one compiled plan
+        # packs and reduces them (see eager.run_fused)
+        return eager.run_fused(op, x, comm, backend=backend, **kw)
     return eager.run_async(op, x, comm, backend=backend, **kw)
 
 
@@ -255,6 +263,9 @@ __all__ = [
     "barrier",
     "wait",
     "free_collective_resources",
+    "precompile",
+    "FusionBuffer",
+    "get_fusion_buffer",
     "xla",
     "ring",
     "pallas",

@@ -126,7 +126,25 @@ class _LRUCache(OrderedDict):
     2^8..2^23 x backends x dtypes tester sweep would otherwise accumulate
     hundreds of compiled executables with no way back — the reference frees
     its per-size IPC descriptors for the same reason
-    (``torchmpi/cache.lua:19-61``)."""
+    (``torchmpi/cache.lua:19-61``).
+
+    Entries may be **pinned** (:meth:`pin` — the AOT ``precompile`` path):
+    pinned entries are never LRU-evicted, so a tester sweep cannot silently
+    evict the executables a training loop declared up front. They still go
+    away with the whole cache (``free_collective_resources`` / ``stop()``,
+    whose contract is a wholesale teardown)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._pinned = set()
+        self._access_log = None  # set: records gets/inserts when armed
+
+    def log_accesses(self, log: set) -> None:
+        """Arm (or, with None, disarm) access logging: every hit and
+        insert lands in ``log``. Used by ``precompile`` to pin exactly
+        the entries its dispatches touched — including executables that
+        already existed (a plain before/after key diff misses those)."""
+        self._access_log = log
 
     def get(self, key, default=None):
         try:
@@ -134,14 +152,31 @@ class _LRUCache(OrderedDict):
         except KeyError:
             return default
         self.move_to_end(key)
+        if self._access_log is not None:
+            self._access_log.add(key)
         return value
+
+    def pin(self, key) -> bool:
+        """Exempt ``key`` from LRU eviction; True if it was present."""
+        if key in self:
+            self._pinned.add(key)
+            return True
+        return False
+
+    def pinned_count(self) -> int:
+        return len(self._pinned)
 
     def __setitem__(self, key, value):
         super().__setitem__(key, value)
         self.move_to_end(key)
+        if self._access_log is not None:
+            self._access_log.add(key)
         limit = constants.get("collective_cache_max_entries")
         while len(self) > limit:
-            self.popitem(last=False)
+            victim = next((k for k in self if k not in self._pinned), None)
+            if victim is None:
+                break  # everything pinned: the pins outrank the bound
+            del self[victim]
 
 
 def _resource_cache(comm: Communicator) -> dict:
@@ -153,14 +188,40 @@ def _resource_cache(comm: Communicator) -> dict:
     return cache
 
 
+def _dispatch_memo(comm: Communicator) -> dict:
+    """The warm-dispatch fast-path memo: (call signature) -> terminal
+    plan. A SEPARATE LRU from the executable cache so memo entries never
+    perturb the executable-count accounting (tests and the reference's
+    per-resource model count executables, not lookups) — but the same
+    bound and the same wholesale teardown."""
+    memo = getattr(comm, "_dispatch_memo", None)
+    if memo is None:
+        memo = _LRUCache()
+        comm._dispatch_memo = memo  # type: ignore[attr-defined]
+    return memo
+
+
 def free_collective_resources(comm: Communicator) -> None:
     """Drop every cached compiled executable / sharding / selector decision
-    attached to ``comm`` — the analog of the reference's
+    / fusion buffer attached to ``comm`` — the analog of the reference's
     ``freeCollectiveResources`` (``torchmpi/cache.lua:19-61``, invoked by
     the tester between sizes, ``torchmpi/tester.lua:131-133``). Safe at any
-    time: the next collective simply recompiles. Called by ``stop()`` for
-    every live stack level."""
-    for attr in ("_collective_resources", "_selector_cache"):
+    time: the next collective simply recompiles, and pending fused
+    submissions are flushed first so no handle is orphaned. Pinned AOT
+    entries go too — this is the wholesale teardown, not LRU pressure.
+    Called by ``stop()`` for every live stack level."""
+    fb = getattr(comm, "_fusion_buffer", None)
+    if fb is not None:
+        try:
+            fb.flush_all(reason="explicit")
+        except Exception:
+            pass
+    for attr in (
+        "_collective_resources",
+        "_dispatch_memo",
+        "_selector_cache",
+        "_fusion_buffer",
+    ):
         if getattr(comm, attr, None) is not None:
             try:
                 delattr(comm, attr)
@@ -508,6 +569,27 @@ def run(
                 f"[r, s] = rank r's payload for rank s); got shape "
                 f"{tuple(x.shape)} for p={comm.size}"
             )
+    # warm-dispatch fast path: a (signature -> terminal plan) memo that
+    # skips re-abstractification — routing, wire resolution, plan
+    # building, and the executable-cache key construction — for call
+    # signatures seen before. Entries embed the constants generation, so
+    # ANY constants change (cutoffs, wire knob, donation) invalidates
+    # them in O(1); only the flat terminal path is memoized (hierarchical
+    # compositions re-route per call).
+    memo = _dispatch_memo(comm)
+    fkey = (
+        "_fast", op, backend, root, src, dst, route_small, wire_dtype,
+        tuple(x.shape), str(jnp.result_type(x)),
+    )
+    ent = memo.get(fkey)
+    if ent is not None and ent[0] == constants.generation():
+        _, fn, effective, wire, nelem = ent
+        if effective in ("ring", "pallas") and op in _WIRE_OPS:
+            _record_wire(op, nelem, jnp.result_type(x), wire)
+        sharding = _rank_sharding(comm, x.ndim)
+        if getattr(x, "sharding", None) != sharding:
+            x = jax.device_put(x, sharding)
+        return _dispatch(fn, x, op, effective, wire, nelem, True)
     platform = comm._devices[0].platform
     effective = backend
     if backend in ("ring", "pallas") and route_small:
@@ -614,11 +696,146 @@ def run(
         static,
         lambda: _kernels(op, effective, root, extra, tuning, wire),
     )
+    # memoize the terminal plan for this signature (see the fast path
+    # above); generation-stamped so constants changes invalidate it
+    memo[fkey] = (
+        constants.generation(), fn, effective, wire, _nelem_per_rank(x)
+    )
     # Place the input on the communicator's devices (no-op if already there).
     sharding = _rank_sharding(comm, x.ndim)
     if getattr(x, "sharding", None) != sharding:
         x = jax.device_put(x, sharding)
     return _dispatch(fn, x, op, effective, wire, _nelem_per_rank(x), hit)
+
+
+def run_fused(
+    op: str,
+    flats,
+    comm: Communicator,
+    backend: str = "xla",
+    route_small: bool = True,
+    wire_dtype: Optional[str] = None,
+):
+    """Coalesced multi-input dispatch: ``flats`` (same-dtype rank-stacked
+    ``[p, n_i]`` slabs) are packed AND reduced by ONE compiled executable
+    — concat + collective fused into a single plan, so a flush of k
+    pending tensors costs one XLA dispatch, not k (and not even
+    pack + collective = 2). The GC3 move (arXiv:2201.11840): the plan is
+    compiled once per (op, layout, dtype, routing) and replayed.
+
+    Routing (latency/bandwidth cutoff, wire format) is decided on the
+    TOTAL payload — coalescing is exactly what pushes small tensors past
+    the bandwidth-path and quantization cutoffs. Hierarchical
+    communicators delegate to the (cached) hierarchical composition after
+    a single-dispatch concat — 2 dispatches, still O(1) in k. Inputs are
+    caller arrays and are never donated. Returns the fused ``[p, total]``
+    result; callers slice their segments back out."""
+    if op != "allreduce":
+        raise CollectiveArgumentError(
+            f"run_fused supports allreduce, got {op!r}"
+        )
+    flats = [
+        f if isinstance(f, jax.Array) else jnp.asarray(f) for f in flats
+    ]
+    if not flats:
+        raise CollectiveArgumentError("run_fused needs at least one tensor")
+    for f in flats:
+        _check_rank_stacked(f, comm)
+    dtype = flats[0].dtype
+    if any(f.dtype != dtype for f in flats):
+        dtype = jnp.result_type(*flats)
+        flats = [f.astype(dtype) for f in flats]
+    ns = tuple(int(f.shape[1]) for f in flats)
+    total = int(sum(ns))
+    cache = _resource_cache(comm)
+    memo = _dispatch_memo(comm)
+    # warm-dispatch memo (see run()): skips routing/wire/plan-key work
+    # for layouts seen before; generation-stamped against constants drift
+    fkey = ("_fastfused", op, backend, route_small, wire_dtype, ns, dtype)
+    ent = memo.get(fkey)
+    if ent is not None and ent[0] == constants.generation():
+        _, fn, effective, wire = ent
+        if effective in ("ring", "pallas"):
+            _record_wire(op, total, dtype, wire)
+        return _dispatch(
+            lambda args: fn(*args), flats, op, effective, wire, total, True
+        )
+    platform = comm._devices[0].platform
+    effective = backend
+    if backend in ("ring", "pallas") and route_small:
+        effective = op_route(op, total, platform, backend)
+    if effective == "pallas":
+        from ..ops import ring_kernels
+
+        if not ring_kernels.supports_dtype(dtype):
+            effective = "ring"
+    wire = "full"
+    if effective in ("ring", "pallas"):
+        wire = resolve_wire_dtype(op, total, dtype, wire_dtype)
+    hier = (
+        effective in ("ring", "pallas")
+        and route_small
+        and constants.get("use_hierarchical_collectives")
+        and comm.has_inter_collective
+        and comm.has_intra_collective
+    )
+    if hier:
+        # concat in one dispatch, then the hierarchical composition (its
+        # own cached executable): 2 dispatches for k tensors
+        ckey = ("_fusecat", ns, str(jnp.dtype(dtype)))
+        cat = cache.get(ckey)
+        if cat is None:
+            cat = jax.jit(lambda *bs: jnp.concatenate(bs, axis=1))
+            cache[ckey] = cat
+        return run(
+            op, cat(*[f.astype(dtype) for f in flats]), comm,
+            backend=backend, route_small=route_small, wire_dtype=wire_dtype,
+        )
+    if effective in ("ring", "pallas"):
+        _record_wire(op, total, dtype, wire)
+    extra: Tuple = ()
+    if (
+        effective == "pallas"
+        and constants.get("ring_implementation") == "pallas_bidir"
+        and wire == "full"
+    ):
+        extra = ("bidir",)
+    tuning: Tuple = ()
+    if effective in ("ring", "pallas"):
+        tuning = ring_tuning(platform)
+    wire_key = (
+        (wire, constants.get("wire_quant_block_size"))
+        if wire != "full"
+        else ("full",)
+    )
+    key = (
+        "_fused", op, effective, ns, str(jnp.dtype(dtype)), extra, tuning,
+        wire_key,
+    )
+    fn = cache.get(key)
+    hit = fn is not None
+    if fn is None:
+        inner = _kernels(op, effective, 0, extra, tuning, wire)
+
+        def kernel(*blocks):  # each [1, n_i] per-rank slab
+            return inner(jnp.concatenate(blocks, axis=-1))
+
+        mesh = _flat_mesh(comm)
+        spec = _rank_spec(2)
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=(spec,) * len(ns), out_specs=spec,
+            check_vma=False,
+        )
+        # in_shardings fold the device placement of every slab into this
+        # one dispatch (the flat path's explicit per-array device_put,
+        # amortized k-fold)
+        sharding = _rank_sharding(comm, 2)
+        fn = jax.jit(shmapped, in_shardings=(sharding,) * len(ns))
+        cache[key] = fn
+    memo[fkey] = (constants.generation(), fn, effective, wire)
+    return _dispatch(
+        lambda args: fn(*args), flats, op, effective, wire, total, hit
+    )
 
 
 def run_allgatherv(blocks, comm: Communicator, backend: str = "xla"):
@@ -727,6 +944,106 @@ def run_async(op: str, x, comm: Communicator, **kw) -> SyncHandle:
     h = SyncHandle(arrays=out)
     handles.register(h, kind="collective")
     return h
+
+
+def precompile(specs, comm: Optional[Communicator] = None,
+               pin: bool = True) -> int:
+    """AOT warm-up: populate (and **pin**) the executable cache from
+    declared collective specs so the first training step never compiles a
+    collective — the GC3 move (arXiv:2201.11840) of compiling collective
+    *plans* ahead of time and replaying them.
+
+    ``specs`` is an iterable of tuples ``(op, shape, dtype)`` optionally
+    extended with ``backend`` and ``wire_dtype`` (or dicts with those
+    keys plus ``root``). ``shape`` is the rank-stacked shape; a shape
+    whose leading axis differs from ``comm.size`` is treated as the
+    per-rank block shape and the rank axis is prepended. A dict spec may
+    instead carry ``layout``: a tuple of per-rank widths declaring a
+    coalesced multi-tensor group — warmed through :func:`run_fused`, the
+    executable a ``FusionBuffer`` flush of that layout replays.
+
+    Each spec is dispatched once on a zeros payload through the exact
+    production route (selector, wire resolution, hierarchical
+    composition), so both the jitted executable AND the per-signature
+    fast-path memo are warm afterwards; every cache entry the warm-up
+    touches — newly compiled OR already present — is pinned against LRU
+    eviction (``free_collective_resources`` still frees them — wholesale
+    teardown outranks pins). Returns the number of specs warmed.
+    Typically invoked via ``start(precompile_collectives=...)`` or
+    ``AllReduceSGDEngine.precompile()``."""
+    if comm is None:
+        from .. import runtime_state
+
+        comm = runtime_state.current_communicator()
+    cache = _resource_cache(comm)
+    touched: set = set()
+    if pin:
+        # log every cache hit AND insert the warm-up dispatches make, so
+        # pinning covers executables that already existed (a key diff
+        # against a 'before' snapshot would silently skip those)
+        cache.log_accesses(touched)
+    pending = []
+    try:
+        warmed = _precompile_dispatch(specs, comm, pending)
+    finally:
+        if pin:
+            cache.log_accesses(None)
+    # drain so compile time is paid HERE, not inside step 1's first wait
+    jax.block_until_ready(pending)
+    if pin:
+        for key in touched:
+            cache.pin(key)
+    return warmed
+
+
+def _precompile_dispatch(specs, comm, pending) -> int:
+    """The spec-by-spec warm-up loop of :func:`precompile` (split out so
+    the caller's try/finally owns logging disarm + pinning)."""
+    from . import _dispatch as _ns_dispatch
+
+    warmed = 0
+    for spec in specs:
+        if isinstance(spec, dict) and "layout" in spec:
+            flats = [
+                jnp.zeros((comm.size, int(n)), spec["dtype"])
+                for n in spec["layout"]
+            ]
+            kw = {}
+            if spec.get("wire_dtype") is not None:
+                kw["wire_dtype"] = spec["wire_dtype"]
+            pending.append(
+                _ns_dispatch(
+                    spec.get("op", "allreduce"), flats, comm, "fused",
+                    spec.get("backend"), **kw,
+                )
+            )
+            warmed += 1
+            continue
+        if isinstance(spec, dict):
+            op = spec["op"]
+            shape = tuple(spec["shape"])
+            dtype = spec["dtype"]
+            backend = spec.get("backend")
+            wire = spec.get("wire_dtype")
+            root = spec.get("root", 0)
+        else:
+            op, shape, dtype = spec[0], tuple(spec[1]), spec[2]
+            backend = spec[3] if len(spec) > 3 else None
+            wire = spec[4] if len(spec) > 4 else None
+            root = 0
+        if shape and shape[0] != comm.size:
+            shape = (comm.size,) + shape
+        kw = {}
+        if wire is not None and op in _WIRE_OPS:
+            kw["wire_dtype"] = wire
+        if op in ("broadcast", "reduce"):
+            kw["root"] = root
+        out = _ns_dispatch(
+            op, jnp.zeros(shape, dtype), comm, "sync", backend, **kw
+        )
+        pending.append(out)
+        warmed += 1
+    return warmed
 
 
 def run_hierarchical_allreduce(

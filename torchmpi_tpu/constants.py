@@ -120,11 +120,27 @@ class _Constants:
     # the fused XLA path anyway) and the scale overhead erodes the win.
     wire_quant_min_elements: int = 1 << 16
 
+    # --- coalescing dispatch (latency path; GC3-style fused plans) ---
+    # Capacity of the flat fusion buffer: pending same-(op, dtype, comm,
+    # wire) async collectives pack into one contiguous buffer and flush
+    # as a SINGLE collective when the per-rank payload reaches this many
+    # bytes (or on wait()/sync_all()). 0 disables coalescing entirely —
+    # every submit dispatches immediately, the pre-fusion behavior.
+    fusion_buffer_bytes: int = 4 << 20
+    # Minimum pending tensors for a flush to dispatch FUSED: below this,
+    # packing overhead (the gather executable) exceeds the saved
+    # dispatches, so the flush falls back to one collective per tensor.
+    fusion_min_tensors: int = 2
+
 
 _frozen = False
 _lock = threading.Lock()
 _values = _Constants()
 _listeners: List[Callable[[str, Any], None]] = []
+# bumped on every successful set(): dispatch fast paths embed the value in
+# their memo keys so a constants change invalidates them without a
+# subscription per call site
+_generation = 0
 
 _FIELD_NAMES = {f.name for f in fields(_Constants)}
 
@@ -176,9 +192,17 @@ def set(name: str, value: Any) -> None:  # noqa: A001 - parity with C setters
                 f"got {type(value).__name__}"
             )
         setattr(_values, name, value)
+        global _generation
+        _generation += 1
         listeners = list(_listeners)
     for fn in listeners:
         fn(name, value)
+
+
+def generation() -> int:
+    """Monotone counter incremented by every :func:`set`. Cache a value
+    alongside this to notice any later constants change in O(1)."""
+    return _generation
 
 
 _freeze_listeners: List[Callable[[], None]] = []
@@ -214,10 +238,11 @@ def snapshot() -> Dict[str, Any]:
 
 def _reset_for_tests() -> None:
     """Unfreeze and restore defaults. Test-only."""
-    global _frozen, _values
+    global _frozen, _values, _generation
     with _lock:
         _frozen = False
         _values = _Constants()
+        _generation += 1
         listeners = list(_listeners)
         replay = [(f.name, getattr(_values, f.name)) for f in fields(_Constants)]
     # unfreeze the native mirror too, else replay below would raise

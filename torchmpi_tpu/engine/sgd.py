@@ -285,6 +285,12 @@ class AllReduceSGDEngine:
                 "inserted by GSPMD, which has no wire-format hook)"
             )
         self.wire_dtype = wire_dtype
+        # coalescing decision captured once (the step function is compiled
+        # against it): fusion_buffer_bytes > 0 -> the sync path ships ONE
+        # flat-buffer psum per dtype group instead of one psum per leaf
+        from .. import constants as _constants
+
+        self._coalesce = _constants.get("fusion_buffer_bytes") > 0
         # captured once: the compiled step's output tree depends on it
         self._telemetry = _telemetry.enabled()
         self.flops_per_sample = flops_per_sample
@@ -394,6 +400,7 @@ class AllReduceSGDEngine:
         self._epoch_fns: Dict[tuple, Callable] = {}
         self._eval_fns: Dict[Any, Callable] = {}
         self._eval_data: Dict[tuple, tuple] = {}
+        self._aot_steps: Dict[tuple, Any] = {}  # precompile() executables
 
     # ------------------------------------------------------------------
     def _accum_value_and_grad(self, params, model_state, batch, split_fn):
@@ -466,6 +473,10 @@ class AllReduceSGDEngine:
                 grads, self.buckets, _AXIS,
                 average=self.average_gradients,
                 wire_dtype=self.wire_dtype,
+            )
+        elif self._coalesce:
+            grads = mpinn.in_graph_synchronize_gradients_flat(
+                grads, _AXIS, average=self.average_gradients
             )
         else:
             grads = mpinn.in_graph_synchronize_gradients(
@@ -591,6 +602,128 @@ class AllReduceSGDEngine:
         )
 
     # ------------------------------------------------------------------
+    # AOT warm-up (the latency path): declare the collectives and compile
+    # the step executable BEFORE training so step 1 pays dispatch only.
+    # ------------------------------------------------------------------
+    def collective_specs(self):
+        """Declared eager-collective specs derived from the params
+        template — the EXACT executables the eager gradient-sync paths
+        for this model would compile. Bucketed engines emit one
+        ``(op, (p, total), dtype)`` spec per bucket (the packed buffer
+        ``GradientBuckets.allreduce_async`` dispatches through ``run``);
+        unbucketed ones emit one ``{"layout": per-leaf widths}`` dict per
+        dtype group (the coalesced plan ``nn.synchronize_gradients``
+        flushes through ``run_fused`` — a ``(p, total)`` spec would warm
+        a cache key nothing ever dispatches). Feed to
+        ``collectives.precompile`` (or
+        ``start(precompile_collectives=...)``) so the eager latency path
+        never compiles at step time. Empty for fsdp/zero1 (GSPMD owns
+        those collectives)."""
+        if self.param_sharding != "replicated":
+            return []
+        p = self.comm.size
+        wire = self.wire_dtype if self.wire_dtype != "full" else None
+        specs = []
+        if self.buckets is not None:
+            for b in range(self.buckets.num_buckets):
+                total = sum(
+                    self.buckets.sizes[i] for i in self.buckets.buckets[b]
+                )
+                specs.append(
+                    (
+                        "allreduce", (p, total),
+                        self.buckets.bucket_dtype(b), None, wire,
+                    )
+                )
+        else:
+            # per dtype group, per-leaf widths in tree order — the fused
+            # group synchronize_gradients submits leaf-by-leaf
+            by_dtype: Dict = {}
+            for leaf in jax.tree_util.tree_leaves(self.params):
+                by_dtype.setdefault(jnp.result_type(leaf), []).append(
+                    int(np.prod(np.shape(leaf)))
+                )
+            for dt, widths in by_dtype.items():
+                specs.append(
+                    {
+                        "op": "allreduce",
+                        "layout": tuple(widths),
+                        "dtype": dt,
+                        "wire_dtype": wire,
+                    }
+                )
+        return specs
+
+    def _aot_key(self, batch) -> tuple:
+        return tuple(
+            (tuple(a.shape), str(jnp.result_type(a)))
+            for a in jax.tree_util.tree_leaves(batch)
+        )
+
+    def precompile(self, batch) -> None:
+        """AOT-compile the jitted training step for ``batch``'s shape (and
+        warm + pin the eager collective cache from
+        :meth:`collective_specs`), so the first real step compiles
+        nothing. ``batch`` may be a concrete sample batch or a pytree of
+        ``jax.ShapeDtypeStruct``-shaped arrays; only shapes/dtypes are
+        read. The compiled executable is used automatically by
+        :meth:`step`/:meth:`train` for matching batch shapes."""
+        from ..collectives.eager import precompile as _eager_precompile
+
+        specs = self.collective_specs()
+        if specs:
+            _eager_precompile(specs, comm=self.comm)
+
+        def aval_of(a):
+            try:
+                return jax.ShapeDtypeStruct(
+                    a.shape, jnp.result_type(a), sharding=a.sharding
+                )
+            except (AttributeError, TypeError):
+                return jax.ShapeDtypeStruct(np.shape(a), jnp.result_type(a))
+
+        batch = self._prepare_batch(
+            jax.tree_util.tree_map(
+                lambda a: jnp.zeros(np.shape(a), jnp.result_type(a)), batch
+            )
+        )
+        tree_avals = jax.tree_util.tree_map
+        args = (
+            tree_avals(aval_of, self.params),
+            tree_avals(aval_of, self.opt_state),
+            (
+                tree_avals(aval_of, self.model_state)
+                if self.model_state is not None
+                else None
+            ),
+            tree_avals(aval_of, batch),
+        )
+        self._aot_steps[self._aot_key(batch)] = (
+            self._step_fn.lower(*args).compile()
+        )
+
+    def _call_step(self, batch):
+        """Dispatch one step through the AOT executable when one matches,
+        else the lazily-compiling jit (identical semantics, including
+        donation)."""
+        args = (self.params, self.opt_state, self.model_state, batch)
+        if self._aot_steps:
+            fn = self._aot_steps.get(self._aot_key(batch))
+            if fn is not None:
+                try:
+                    return fn(*args)
+                except (TypeError, ValueError):
+                    # aval/sharding drift (e.g. params replaced
+                    # wholesale) is rejected at DISPATCH time, before
+                    # donation consumes anything: drop the stale
+                    # executable, fall back to jit. Runtime failures
+                    # (XlaRuntimeError, OOM) propagate — retrying after
+                    # donation would run on deleted buffers and mask the
+                    # real error.
+                    self._aot_steps.pop(self._aot_key(batch), None)
+        return self._step_fn(*args)
+
+    # ------------------------------------------------------------------
     # public step API (drivers/benches must not reach into privates)
     # ------------------------------------------------------------------
     def step(self, batch):
@@ -604,14 +737,12 @@ class AllReduceSGDEngine:
         batch = self._prepare_batch(batch)
         if not self._telemetry:
             self.params, self.opt_state, self.model_state, loss = (
-                self._step_fn(
-                    self.params, self.opt_state, self.model_state, batch
-                )
+                self._call_step(batch)
             )
             return loss
         t0 = time.perf_counter()
-        self.params, self.opt_state, self.model_state, aux = self._step_fn(
-            self.params, self.opt_state, self.model_state, batch
+        self.params, self.opt_state, self.model_state, aux = self._call_step(
+            batch
         )
         loss, gnorm = self._split_aux(aux)
         jax.block_until_ready(loss)
@@ -908,10 +1039,7 @@ class AllReduceSGDEngine:
                     if self._telemetry:
                         t_step = time.perf_counter()
                     self.params, self.opt_state, self.model_state, aux = (
-                        self._step_fn(
-                            self.params, self.opt_state, self.model_state,
-                            batch,
-                        )
+                        self._call_step(batch)
                     )
                     loss, gnorm = self._split_aux(aux)
                     state["loss"] = loss

@@ -128,9 +128,43 @@ def synchronize_gradients(
     int8 ships block-quantized gradients with f32 accumulation (EQuARX-
     style), engaging only for f32 buffers above the tuned cutoff. Integer
     leaves always travel uncompressed (their dtype group resolves to
-    'full')."""
+    'full').
+
+    ``fused=True`` routes through the communicator's coalescing
+    :class:`~torchmpi_tpu.collectives.fusion.FusionBuffer` (when
+    ``fusion_buffer_bytes`` > 0): every leaf is submitted individually,
+    packed into one persistent donated flat buffer per dtype, and shipped
+    as a SINGLE allreduce per dtype group — same collective count as the
+    old host-side concat, but the pack is a cached executable reusing the
+    previous call's device memory, and the coalescing telemetry sees it."""
     comm = _comm(comm)
     p = comm.size
+
+    from .. import constants as _constants
+
+    if fused and _constants.get("fusion_buffer_bytes") > 0:
+        from ..collectives.fusion import get_fusion_buffer
+
+        fb = get_fusion_buffer(comm)
+        leaves, treedef = tree_util.tree_flatten(grads)
+        handles = [
+            fb.submit(
+                "allreduce",
+                l if l.ndim == 2 else jnp.reshape(l, (p, -1)),
+                wire_dtype=wire_dtype,
+            )
+            for l in leaves
+        ]
+        # one dispatch per dtype group, now — only OUR groups (other
+        # callers' pending submits keep their capacity window)
+        fb.flush_for(handles)
+        out = []
+        for l, h in zip(leaves, handles):
+            buf = h.wait()
+            if average:
+                buf = (buf / p).astype(jnp.result_type(l))
+            out.append(jnp.reshape(buf, l.shape))
+        return tree_util.tree_unflatten(treedef, out)
 
     def sync_one(buf):
         out = collectives.allreduce_tensor(
@@ -159,6 +193,7 @@ class GradientBuckets:
         leaves, self.treedef = tree_util.tree_flatten(params_template)
         self.shapes = [l.shape for l in leaves]
         self.sizes = [int(np.prod(l.shape)) for l in leaves]
+        self.dtypes = [jnp.result_type(l) for l in leaves]
         total = sum(self.sizes)
         num_buckets = max(1, min(num_buckets, len(leaves)))
         target = total / num_buckets
@@ -177,10 +212,48 @@ class GradientBuckets:
             self.buckets[-1].append(idx)
             acc += self.sizes[idx]
         self.num_buckets = len(self.buckets)
+        # persistent flat-buffer state for the coalesced eager path: one
+        # cached pack executable + recycled (donated) buffer per bucket
+        self._pack_fns: Dict[int, Callable] = {}
+        self._spares: Dict[int, Any] = {}
 
     def bucket_leaves(self, tree, b: int):
         leaves = tree_util.tree_leaves(tree)
         return [leaves[i] for i in self.buckets[b]]
+
+    def bucket_dtype(self, b: int):
+        """The bucket's wire dtype: the promotion of its leaves (matches
+        the concat the fused buffer ships)."""
+        return jnp.result_type(*[self.dtypes[i] for i in self.buckets[b]])
+
+    def _pack_bucket(self, b: int, flats, dtype):
+        """Pack bucket ``b``'s flattened [p, w_i] leaves into its
+        persistent flat [p, total] buffer via a cached jitted gather that
+        DONATES the previous step's buffer — steady-state training
+        re-packs into the same device memory with zero per-step concat
+        allocation (the ``BlockSequential`` flatten-once idiom,
+        ``BlockSequential.lua:29-89``). Caller leaves are only read,
+        never donated."""
+        p = flats[0].shape[0]
+        widths = tuple(int(f.shape[1]) for f in flats)
+        key = (b, widths, str(jnp.dtype(dtype)))
+        fn = self._pack_fns.get(key)
+        if fn is None:
+            offsets = tuple(int(o) for o in np.cumsum((0,) + widths[:-1]))
+
+            def pack(buf, *slabs):
+                for off, slab in zip(offsets, slabs):
+                    buf = jax.lax.dynamic_update_slice(
+                        buf, slab.astype(buf.dtype), (0, off)
+                    )
+                return buf
+
+            fn = jax.jit(pack, donate_argnums=(0,))
+            self._pack_fns[key] = fn
+        buf = self._spares.pop(key, None)
+        if buf is None or getattr(buf, "is_deleted", lambda: False)():
+            buf = jnp.zeros((p, sum(widths)), dtype)
+        return key, fn(buf, *flats)
 
     def allreduce_async(
         self,
@@ -194,14 +267,29 @@ class GradientBuckets:
         ``backend`` optionally pins the collective backend (e.g. ``'ring'``
         to engage the hierarchical intra×inter composition on 2-level
         communicators); default = selector choice. ``wire_dtype`` selects
-        the per-bucket wire encoding (:func:`synchronize_gradients`)."""
+        the per-bucket wire encoding (:func:`synchronize_gradients`).
+
+        With ``fusion_buffer_bytes`` > 0 (the default) each bucket packs
+        into its persistent donated flat buffer (:meth:`_pack_bucket`) —
+        no per-step concat allocation; 0 falls back to a fresh concat per
+        launch (the pre-fusion behavior)."""
+        from .. import constants as _constants
+        from ..collectives.fusion import count_coalesced
+
         comm = _comm(comm)
         p = comm.size
         leaves = tree_util.tree_leaves(grads)
+        persistent = _constants.get("fusion_buffer_bytes") > 0
+        recycle = persistent and not _constants.get("donate_eager_buffers")
         handles = []
         for b in range(self.num_buckets):
             flats = [jnp.reshape(leaves[i], (p, -1)) for i in self.buckets[b]]
-            buf = jnp.concatenate(flats, axis=1)
+            key = None
+            if persistent:
+                key, buf = self._pack_bucket(b, flats, self.bucket_dtype(b))
+                count_coalesced("allreduce", wire_dtype, len(flats))
+            else:
+                buf = jnp.concatenate(flats, axis=1)
             # one dispatch path for selector-routed AND pinned backends;
             # note a pinned backend is honored EXACTLY (no
             # ring_implementation remap — that applies only to
@@ -212,6 +300,11 @@ class GradientBuckets:
                     wire_dtype=wire_dtype,
                 )
             )
+            if recycle:
+                # the collective did not consume the packed buffer: next
+                # step's pack donates it (XLA orders the reuse after the
+                # in-flight read)
+                self._spares[key] = buf
         # Remember which communicator these collectives ran on so the
         # averaging divisor in wait_and_unflatten defaults correctly.
         self._launch_comm = comm
@@ -259,6 +352,35 @@ def in_graph_synchronize_gradients(grads, axis: str = "mpi", average: bool = Tru
         n = lax.psum(1, axis)
         summed = tree_util.tree_map(lambda g: g / n, summed)
     return summed
+
+
+def in_graph_synchronize_gradients_flat(
+    grads, axis: str = "mpi", average: bool = True,
+):
+    """Coalesced in-graph gradient sync: ONE flat-buffer psum per dtype
+    group instead of one psum per leaf. The per-leaf variant hands XLA
+    O(#leaves) collectives to schedule; on the latency-bound path each
+    carries its own launch cost, so the flat buffer is the in-graph twin
+    of the eager :class:`FusionBuffer` (arXiv:1810.11112's coalescing
+    lever). Grouping by dtype keeps integer leaves exact and
+    mixed-precision trees un-promoted. Numerics are identical to the
+    per-leaf psum: concatenation commutes with the elementwise sum."""
+    leaves, treedef = tree_util.tree_flatten(grads)
+    n = lax.psum(1, axis) if average else 1
+    by_dtype: Dict = {}
+    for i, l in enumerate(leaves):
+        by_dtype.setdefault(jnp.result_type(l), []).append(i)
+    out = list(leaves)
+    for dtype, idxs in by_dtype.items():
+        flats = [jnp.reshape(leaves[i], (-1,)) for i in idxs]
+        splits = np.cumsum([f.shape[0] for f in flats])[:-1]
+        buf = lax.psum(jnp.concatenate(flats), axis)
+        if average:
+            buf = (buf / n).astype(dtype)
+        parts = jnp.split(buf, splits)
+        for part, i in zip(parts, idxs):
+            out[i] = jnp.reshape(part, leaves[i].shape)
+    return tree_util.tree_unflatten(treedef, out)
 
 
 def in_graph_synchronize_gradients_bucketed(

@@ -478,6 +478,31 @@ fits. Only a single (batch, head) cell too large for the envelope
 raises; sequence length then needs more sp shards or the XLA backend."""
 
 
+def _l_hop_needed(s, p: int, nL: int):
+    """Whether the bidirectional kernel's L-chain hop carrying UNWRAPPED
+    source index ``s`` (= sender rank + step; >= p once the block crossed
+    rank 0) does any work under causal masking.
+
+    Under causal, a block from source rank ``src`` is merged only by
+    receivers that see it as a PAST rank — on the L chain (blocks moving
+    toward lower ranks) that happens only after the block wraps past
+    rank 0. Pre-wrap hops are pure transport toward the wrap point. So
+    the hop matters iff the block already wrapped (``s >= p``) or still
+    can within the chain's ``nL`` distances (``s < nL``); otherwise the
+    block is strictly-future for every receiver it can reach, all its
+    merges are beta=0, and the send is wire spent on provably-zero
+    contributions (ADVICE r5 ``ops/ring_attention_kernel.py:520``).
+
+    Pairing invariant (what keeps the semaphores drained): sender rank
+    ``r+1`` and receiver ``r`` evaluate the SAME unwrapped index for one
+    hop — send gate ``_l_hop_needed((r+1) + t)`` vs recv gate
+    ``_l_hop_needed(r + 1 + t)`` — and the capacity signal at ``(r, t)``
+    matches the upstream's wait before its ``t+1`` send (both index
+    ``r + t + 2``). ``tests/test_fusion.py`` checks the pairing
+    exhaustively over p/t/rank."""
+    return (s >= p) | (s < nL)
+
+
 def _ring_attn_bidir_kernel(
     p: int,
     axis: str,
@@ -553,22 +578,46 @@ def _ring_attn_bidir_kernel(
     nR = (p - 1 + 1) // 2
     nL = (p - 1) // 2
 
+    def l_needed(s):
+        return _l_hop_needed(s, p, nL)
+
     chains = (
-        # (buffers, sems, cap, dst neighbor, cap-signal target, #distances)
+        # (buffers, sems, cap, dst neighbor, cap-signal target,
+        #  #distances, is_l_chain)
         ((kbufR, vbufR), (sendR_k, recvR_k, sendR_v, recvR_v), capR,
-         right, left, nR),
+         right, left, nR, False),
         ((kbufL, vbufL), (sendL_k, recvL_k, sendL_v, recvL_v), capL,
-         left, right, nL),
+         left, right, nL, True),
     )
 
     for t in range(nR + 1):
         slot = t % 2
         nslot = 1 - slot
         all_copies = []
-        for (bufs, sems, cap, dst, cap_to, ndist) in chains:
+        for (bufs, sems, cap, dst, cap_to, ndist, is_l) in chains:
             if t < ndist:  # this chain still has a farther block to push
+                # causal L-chain hops that can never contribute are
+                # skipped — but only where the flow-control machinery
+                # runs (hardware / modern interpreter): the LEGACY
+                # interpreter cannot discharge DMAs under
+                # device-divergent pl.when (each remote copy lowers to
+                # an all_gather that deadlocks inside a divergent cond,
+                # see ring_kernels._legacy_interpret), so it keeps the
+                # unconditional schedule (its transport is simulated;
+                # the merge skip below still carries the numerics).
+                gated = causal and is_l and fc
+                # gates agree pairwise across neighbors: my send at t is
+                # my-1's recv at t (both l_needed(my + t) from the
+                # sender's frame); my cap signal at t enables my+1's
+                # send at t+1 (both l_needed(my + t + 2))
+                p_out = l_needed(my + t) if gated else None
                 if fc and t >= 1:
-                    pltpu.semaphore_wait(cap.at[nslot], 1)
+                    if p_out is None:
+                        pltpu.semaphore_wait(cap.at[nslot], 1)
+                    else:
+                        @pl.when(p_out)
+                        def _():
+                            pltpu.semaphore_wait(cap.at[nslot], 1)
                 sk, rk, sv, rv = sems
                 copies = tuple(
                     pltpu.make_async_remote_copy(
@@ -584,9 +633,15 @@ def _ring_attn_bidir_kernel(
                         (bufs[1], sv, rv),
                     )
                 )
-                for c in copies:
-                    c.start()
-                all_copies.append((copies, cap, cap_to, ndist))
+                if p_out is None:
+                    for c in copies:
+                        c.start()
+                else:
+                    @pl.when(p_out)
+                    def _():
+                        for c in copies:
+                            c.start()
+                all_copies.append((copies, gated, cap, cap_to, ndist))
         # merge this step's visiting block(s); t = 0 merges the local
         # block exactly once (both chains hold it)
         if t == 0:
@@ -621,19 +676,48 @@ def _ring_attn_bidir_kernel(
                         bh, n, my, lax.rem(my + t, p), causal, scale,
                         q_ref, kbufL, vbufL, slot, oacc, macc, lacc,
                     )
-        for copies, cap, cap_to, ndist in all_copies:
-            for c in copies:
-                c.wait()
+        for copies, gated, cap, cap_to, ndist in all_copies:
+            if not gated:
+                for c in copies:
+                    c.wait()
+            else:
+                # decoupled waits (the causal-gated L chain): my own send
+                # completed iff I sent (l_needed(my + t)); the incoming
+                # block from my+1 landed iff IT sent, which from my frame
+                # is l_needed(my + t + 1). The copy descriptor's recv
+                # semaphore is the SPMD-symmetric one the incoming copy
+                # signals, so wait_recv on it observes the inbound DMA.
+                @pl.when(l_needed(my + t))
+                def _():
+                    for c in copies:
+                        c.wait_send()
+
+                @pl.when(l_needed(my + t + 1))
+                def _():
+                    for c in copies:
+                        c.wait_recv()
             # slot consumed + our outgoing read landed: upstream may
             # overwrite it at its next send. Its sends stop at t = ndist-1,
             # so signals stop one step earlier (semaphores end drained).
             if fc and t < ndist - 1:
-                pltpu.semaphore_signal(
-                    cap.at[slot],
-                    inc=1,
-                    device_id={axis: cap_to},
-                    device_id_type=pltpu.DeviceIdType.MESH,
-                )
+                if not gated:
+                    pltpu.semaphore_signal(
+                        cap.at[slot],
+                        inc=1,
+                        device_id={axis: cap_to},
+                        device_id_type=pltpu.DeviceIdType.MESH,
+                    )
+                else:
+                    # pairs with my+1's cap wait before its t+1 send,
+                    # which carries source my + t + 2 — same gate
+                    @pl.when(l_needed(my + t + 2))
+                    def _():
+                        pltpu.semaphore_signal(
+                            cap.at[slot],
+                            inc=1,
+                            device_id={axis: cap_to},
+                            device_id_type=pltpu.DeviceIdType.MESH,
+                        )
 
     def finalize(i, _):
         li = jnp.maximum(lacc[i], 1e-30)
@@ -686,13 +770,17 @@ batch/head auto-chunking.
 
 Causal caveat: under ``causal=True`` the L chain mostly carries blocks
 from strictly-future ranks (source ``my + t`` with no wraparound), whose
-scores are fully masked. The kernel SKIPS the merge compute for those
-blocks (they are a numerical no-op either way), but their K/V bytes
-still travel the wire — so for causal attention the bidirectional
-variant halves the step count without halving useful wire traffic, and
-the unidirectional kernel can win on bandwidth-bound shapes. Measure
-(``utils.autotune``) rather than assume; the autotuner treats the
-direction choice as a knob for exactly this reason."""
+scores are fully masked. The kernel SKIPS both the merge compute for
+those blocks AND — on hardware / the modern interpreter — their K/V
+sends: an L-chain hop runs only when its block already wrapped past
+rank 0 or still can within the chain (:func:`_l_hop_needed`), with
+send / recv / capacity-semaphore gates matched pairwise across
+neighbors so the transport discipline stays deadlock-free. Wire bytes
+saved, not just FLOPs (ADVICE r5). The LEGACY pallas interpreter keeps
+the unconditional schedule (conditional DMAs cannot discharge there;
+its transport is simulated anyway). Even so, causal workloads get less
+than the full ~2x: the R chain carries ``ceil((p-1)/2)`` useful blocks
+regardless — measure (``utils.autotune``) rather than assume."""
 
 
 def _full_attention_with_lse(q, k, v, causal):

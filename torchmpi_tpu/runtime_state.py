@@ -43,6 +43,7 @@ def start(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     load_tuned_constants: bool = True,
+    precompile_collectives: Optional[Sequence] = None,
 ) -> None:
     """Initialise the runtime (``MPI.start``, ``torchmpi/init.lua:31-100``).
 
@@ -63,6 +64,12 @@ def start(
       ``MPI_Init`` analog for multi-host TPU pods; on Cloud TPU the
       arguments are auto-detected and may be omitted by passing
       ``coordinator_address=""``). Single-controller runs skip this.
+    - ``precompile_collectives`` — declared collective specs (see
+      ``collectives.eager.precompile``) compiled AND pinned in the
+      executable cache before ``start()`` returns, so step 1 of training
+      never pays a collective compile (the AOT warm-up of the latency
+      path). Runs AFTER the tuned constants load, against the
+      communicator the collectives will actually use.
     """
     global _stack, _started
     with _lock:
@@ -170,6 +177,13 @@ def start(
                 load_tuning(comm=_stack.current, apply=True)
             except Exception:
                 pass  # cache is best-effort; defaults are always safe
+
+        if precompile_collectives:
+            # AFTER tuning load: the warmed executables must be the ones
+            # the tuned routing constants will select at step time
+            from .collectives.eager import precompile as _precompile
+
+            _precompile(precompile_collectives, comm=_stack.current)
     except BaseException:
         # Roll back so a corrected retry of start() works instead of
         # hitting 'called twice' on a half-initialized runtime — including

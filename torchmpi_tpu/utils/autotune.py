@@ -62,7 +62,13 @@ _TUNABLE = (
     "max_buffer_size_{s}",
     "ring_implementation",
     "wire_dtype",
+    "fusion_buffer_bytes",
 )
+
+#: canonical LeNet gradient leaf element counts (conv1 w/b, conv2 w/b,
+#: fc1-3 w/b) — the latency-bound north-star's actual small-tensor set,
+#: shared by :func:`tune_fusion_threshold` and ``bench.py --microbench``
+LENET_LEAF_SIZES = (150, 6, 2400, 16, 48000, 120, 10080, 84, 840, 10)
 
 
 def _comm(comm: Optional[Communicator]) -> Communicator:
@@ -357,6 +363,66 @@ def tune_wire_dtype(
     return best[1], results
 
 
+def tune_fusion_threshold(
+    comm: Optional[Communicator] = None,
+    leaf_sizes: Optional[Tuple[int, ...]] = None,
+    candidates: Tuple[int, ...] = (0, 1 << 18, 1 << 20, 4 << 20, 16 << 20),
+    warmup: int = 2,
+    timed: int = 5,
+    apply: bool = True,
+) -> Tuple[int, List]:
+    """Measure the coalescing dispatch (``FusionBuffer``) end-to-end on a
+    canonical small-tensor set — default: the LeNet gradient leaves, the
+    latency-bound north-star's workload — under candidate
+    ``fusion_buffer_bytes`` values, including 0 (coalescing disabled),
+    and set the constant to the fastest. Coalescing must EARN its flush
+    boundary: a tiny capacity flushes mid-set (several fused dispatches),
+    a huge one defers everything to the drain — the measurement, not a
+    guess, picks where the knob sits on this host.
+
+    Requires unfrozen constants even with ``apply=False``: each candidate
+    is measured by temporarily setting ``fusion_buffer_bytes``."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    comm = _comm(comm)
+    _check_unfrozen(apply, measure_mutates=True)
+    from ..collectives.fusion import get_fusion_buffer
+
+    sizes = tuple(leaf_sizes or LENET_LEAF_SIZES)
+    p = comm.size
+    xs = [jnp.ones((p, n), jnp.float32) for n in sizes]
+    jax.block_until_ready(xs)
+    prev = constants.get("fusion_buffer_bytes")
+    results: List = []
+    best = (float("inf"), prev)
+    try:
+        for cand in candidates:
+            constants.set("fusion_buffer_bytes", int(cand))
+            fb = get_fusion_buffer(comm)
+            laps = []
+            for it in range(warmup + timed):
+                t0 = _time.perf_counter()
+                handles = [fb.submit("allreduce", x) for x in xs]
+                fb.flush_all(reason="explicit")
+                outs = [h.wait() for h in handles]
+                jax.block_until_ready(outs)
+                if it >= warmup:
+                    laps.append(_time.perf_counter() - t0)
+            mean_us = 1e6 * sum(laps) / max(1, len(laps))
+            results.append((int(cand), mean_us))
+            if mean_us < best[0]:
+                best = (mean_us, int(cand))
+    finally:
+        constants.set("fusion_buffer_bytes", prev)
+    if apply:
+        constants.set("fusion_buffer_bytes", int(best[1]))
+    _audit_decision("fusion_buffer_bytes", int(best[1]), apply, results)
+    return int(best[1]), results
+
+
 def tune_all(
     comm: Optional[Communicator] = None,
     quick: bool = True,
@@ -385,6 +451,9 @@ def tune_all(
         comm, nelem=big, apply=apply
     )[0]
     out["wire_dtype"] = tune_wire_dtype(comm, nelem=big, apply=apply)[0]
+    out["fusion_buffer_bytes"] = tune_fusion_threshold(
+        comm, timed=3 if quick else 5, apply=apply
+    )[0]
     if apply and persist:
         save_tuning(comm)
     return out
