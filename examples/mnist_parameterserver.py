@@ -18,7 +18,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--variant", default="downpour", choices=["downpour", "easgd", "dsgd"]
@@ -37,7 +37,17 @@ def main():
     ap.add_argument("--beta", type=float, default=0.9)
     ap.add_argument("--cpu-mesh", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args()
+    ap.add_argument(
+        "--wire-dtype",
+        default="full",
+        choices=["full", "bf16", "int8"],
+        help="parameter-server wire encoding for every client<->server "
+        "exchange (parameterserver_wire_dtype): shards stay f32 master "
+        "copies, only the exchanged values are quantized — the "
+        "convergence-equivalence evidence for the quantized PS path",
+    )
+    ap.add_argument("--train", type=int, default=8192)
+    args = ap.parse_args(argv)
 
     if args.cpu_mesh:
         import os
@@ -71,6 +81,10 @@ def main():
     from torchmpi_tpu.utils import DistributedIterator, synthetic_mnist
 
     mpi.start()
+    if args.wire_dtype != "full":
+        from torchmpi_tpu import constants
+
+        constants.set("parameterserver_wire_dtype", args.wire_dtype)
     comm = mpi.current_communicator()
     p = comm.size
     dp_level = None
@@ -79,7 +93,9 @@ def main():
         mpi.set_communicator(0)
     print(f"ranks={p} variant={args.variant} dp={bool(dp_level)}")
 
-    (xtr, ytr), (xte, yte) = synthetic_mnist(seed=args.seed)
+    (xtr, ytr), (xte, yte) = synthetic_mnist(
+        num_train=args.train, seed=args.seed
+    )
     model = LogisticRegression()
     loss_fn = make_loss_fn(model)
     params0 = init_params(model, (1, 28, 28), seed=args.seed)

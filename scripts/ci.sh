@@ -26,6 +26,12 @@ run_perf_smoke() {
     echo "=== perf-smoke (eager dispatch microbench, CPU) ==="
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
         python bench.py --microbench --check
+    # PS wire perf-smoke: int8 wire must move >= 2x the effective logical
+    # bytes/sec of fp32 on the LeNet parameter round trip over the paced
+    # (bandwidth-bound) link, with every decoded fetch inside its
+    # encoding's error bound. Pure host path — no jax backend.
+    echo "=== perf-smoke (parameter-server wire microbench, CPU) ==="
+    python bench.py --ps-microbench --check
 }
 
 run_slow_a() {

@@ -151,3 +151,23 @@ def test_elastic_training_example(tmp_path):
         if "final:" in l
     ]
     assert got and want and got[0] == want[0], (got, want)
+
+
+@pytest.mark.slow
+def test_mnist_downpour_int8_wire_matches_fp32():
+    """Acceptance: the MNIST downpour example with
+    parameterserver_wire_dtype=int8 matches the fp32 run's final accuracy
+    within 0.5% — quantized exchanges against f32 master shards do not
+    change what the schedule converges to."""
+    from examples.mnist_parameterserver import main
+
+    common = [
+        "--variant", "downpour", "--epochs", "3", "--train", "8192",
+        "--tau", "5", "--init-delay", "10",
+    ]
+    acc_full = main(common + ["--wire-dtype", "full"])
+    acc_int8 = main(common + ["--wire-dtype", "int8"])
+    assert acc_full > 0.8, f"fp32 baseline failed to converge: {acc_full}"
+    assert abs(acc_full - acc_int8) <= 0.005, (
+        f"int8 wire diverged: full={acc_full:.4f} int8={acc_int8:.4f}"
+    )

@@ -801,3 +801,649 @@ def test_transport_gather_replay_deduped_and_generations_banked():
         s.close()
     finally:
         lst.close()
+
+
+# ---------------------------------------------------------------------------
+# PS wire formats, chunk pipeline, delta fetches, prefetch (PR 5)
+# ---------------------------------------------------------------------------
+
+
+def _register_instance(n, dtype=np.float32):
+    from torchmpi_tpu.parameterserver.server import _server
+
+    return _server.register(np.zeros(n, dtype), 1), _server
+
+
+def test_ps_wire_codec_roundtrip_bounds():
+    """int8/bf16 PS codec: error bounded by the encoding's step size,
+    exact for constant blocks (one shared scale represents them all)."""
+    from torchmpi_tpu.parameterserver import wire as W
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(70001).astype(np.float32)
+    y = W.roundtrip(x, W.WIRE_FULL, 128)
+    np.testing.assert_array_equal(y, x)
+    y = W.roundtrip(x, W.WIRE_BF16, 128)
+    assert float(np.abs(y - x).max() / np.abs(x).max()) < 8e-3
+    y = W.roundtrip(x, W.WIRE_INT8, 128)
+    assert float(np.abs(y - x).max() / np.abs(x).max()) < 2e-2
+    const = np.full(1000, 3.25, np.float32)
+    np.testing.assert_array_equal(W.roundtrip(const, W.WIRE_INT8, 128), const)
+
+
+def test_ps_wire_chunk_container_accounting():
+    """plan_chunks covers every element exactly once (block-aligned for
+    int8) and container_nbytes matches the bytes encode actually emits."""
+    from torchmpi_tpu.parameterserver import wire as W
+
+    rng = np.random.RandomState(1)
+    for n in (1, 127, 128, 5000, 70001):
+        x = rng.randn(n).astype(np.float32)
+        for code in (W.WIRE_FULL, W.WIRE_BF16, W.WIRE_INT8):
+            chunks = W.plan_chunks(n, code, 128, 1 << 14)
+            assert chunks[0][0] == 0
+            assert sum(c for _, c in chunks) == n
+            for (o1, c1), (o2, _) in zip(chunks, chunks[1:]):
+                assert o1 + c1 == o2
+            parts, total, nch = W.encode_frame_payload(x, code, 128, 1 << 14)
+            assert nch == len(chunks)
+            got = sum(len(memoryview(p).cast("B")) for p in parts)
+            assert got == total
+            assert (total, nch) == W.container_nbytes(n, code, 128, 1 << 14)
+            dec = W.decode_parts(parts, code)
+            assert dec.shape == (n,)
+
+
+@pytest.mark.parametrize("wire_name", ["full", "bf16", "int8"])
+@pytest.mark.parametrize("chunk_bytes", [0, 1 << 14])
+def test_transport_wire_matrix_roundtrip(wire_name, chunk_bytes):
+    """UPDATE + TRIGGER through the real listener/channel/mailbox/apply
+    path for every (wire encoding x chunking) combination: decoded values
+    within the encoding's bound, exact for full."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T, wire as W
+
+    inst, _server = _register_instance(70001)
+    lst = T._Listener(lambda i: inst if i == inst.id else None)
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        constants.set("parameterserver_wire_dtype", wire_name)
+        constants.set("ps_chunk_bytes", chunk_bytes)
+        x = np.random.RandomState(2).randn(70001).astype(np.float32)
+        ch.request(T._KIND_UPDATE, inst.id, 0, 0, rule="copy", payload_arr=x)
+        out = ch.request(
+            T._KIND_TRIGGER, inst.id, 0, 0, wire=W.wire_code(wire_name)
+        )
+        err = float(np.abs(out - x).max() / np.abs(x).max())
+        tol = {"full": 0.0, "bf16": 8e-3, "int8": 2e-2}[wire_name]
+        assert err <= tol, (wire_name, chunk_bytes, err)
+    finally:
+        ch.close()
+        lst.close()
+        _server.unregister(inst)
+
+
+def test_transport_wire_matrix_concurrent_clients():
+    """Two pipelined channels adding int8-quantized updates concurrently:
+    the f32 master shard accumulates every (dequantized) contribution —
+    sums land within the summed quantization error, nothing is lost."""
+    import threading
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    inst, _server = _register_instance(4096)
+    lst = T._Listener(lambda i: inst if i == inst.id else None)
+    chans = [T._PeerChannel({0: ("localhost", lst.port)}, 0) for _ in range(2)]
+    try:
+        constants.set("parameterserver_wire_dtype", "int8")
+        constants.set("ps_chunk_bytes", 1 << 12)
+        rng = np.random.RandomState(3)
+        payloads = [rng.randn(4096).astype(np.float32) for _ in range(8)]
+        errs = []
+
+        def client(ci):
+            try:
+                for k in range(ci, len(payloads), 2):
+                    chans[ci].request(
+                        T._KIND_UPDATE, inst.id, 0, ci, rule="add",
+                        payload_arr=payloads[k],
+                    )
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=client, args=(ci,)) for ci in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errs, errs
+        expect = np.sum(payloads, axis=0)
+        got = inst.read_shard(0)
+        # per-payload int8 step ~ amax/127; 8 payloads' errors add
+        tol = sum(np.abs(p).max() / 127 for p in payloads)
+        assert float(np.abs(got - expect).max()) <= tol
+    finally:
+        for ch in chans:
+            ch.close()
+        lst.close()
+        _server.unregister(inst)
+
+
+def test_transport_multi_frame_quantized_roundtrip():
+    """UPDATE_MULTI with int8 wire: every item decodes on its own
+    quantization grid and applies to its rank."""
+    import socket
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T, wire as W
+
+    applied = {}
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            applied[rank] = np.asarray(msg.payload).copy()
+            msg.done.set()
+
+    lst = T._Listener(lambda i: FakeInst())
+    try:
+        constants.set("parameterserver_wire_dtype", "int8")
+        a = np.random.RandomState(4).randn(300).astype(np.float32)
+        b = 100 + np.random.RandomState(5).randn(500).astype(np.float32)
+        blobs = []
+        for arr in (a, b):
+            parts, _, _ = W.encode_frame_payload(arr, W.WIRE_INT8, 128, 0)
+            blobs.append(b"".join(bytes(p) for p in parts))
+        payload = (
+            T._MULTI_COUNT.pack(2)
+            + T._MULTI_ITEM.pack(0, len(blobs[0]))
+            + T._MULTI_ITEM.pack(3, len(blobs[1]))
+            + blobs[0]
+            + blobs[1]
+        )
+        s = socket.create_connection(("localhost", lst.port), timeout=10)
+        s.settimeout(10)
+        T._send_frame(
+            s, T._KIND_UPDATE_MULTI, inst=1, rank=T._MULTI_RANK, client=0,
+            seq=1, rule="copy", dtype="<f4", payload=payload,
+            wire=W.WIRE_INT8,
+        )
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        # item grids are independent: the b item's +100 offset must not
+        # inflate the a item's quantization step
+        assert float(np.abs(applied[0] - a).max()) <= np.abs(a).max() / 100
+        assert float(np.abs(applied[3] - b).max()) <= np.abs(b).max() / 100
+        s.close()
+    finally:
+        lst.close()
+
+
+class _CuttingProxy:
+    """Loopback proxy that severs its FIRST connection after forwarding
+    ``cut_after`` bytes upstream (mid-chunk-stream fault injection);
+    later connections pass everything through."""
+
+    def __init__(self, target_port: int, cut_after: int):
+        import socket
+        import threading
+
+        self._socket = socket
+        self.target_port = target_port
+        self.cut_after = cut_after
+        self.conn_count = 0
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        import threading
+
+        while True:
+            try:
+                c, _ = self._srv.accept()
+            except OSError:
+                return
+            self.conn_count += 1
+            limit = self.cut_after if self.conn_count == 1 else None
+            u = self._socket.create_connection(
+                ("127.0.0.1", self.target_port)
+            )
+            threading.Thread(
+                target=self._pump, args=(c, u, limit), daemon=True
+            ).start()
+            threading.Thread(
+                target=self._pump, args=(u, c, None), daemon=True
+            ).start()
+
+    def _pump(self, src, dst, limit):
+        sent = 0
+        try:
+            while True:
+                data = src.recv(16384)
+                if not data:
+                    break
+                if limit is not None and sent + len(data) >= limit:
+                    dst.sendall(data[: max(0, limit - sent)])
+                    break  # sever mid-frame
+                dst.sendall(data)
+                sent += len(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def test_transport_reconnect_mid_chunk_applies_exactly_once():
+    """Severing the connection midway through a chunked quantized UPDATE
+    stream must apply the update EXACTLY once: the torn frame applies
+    nothing (chunks decode into a staging buffer, the apply is atomic on
+    full receipt), the channel replay re-sends the retained frame, and
+    the non-idempotent 'add' lands a single time."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    inst, _server = _register_instance(1 << 16)
+    lst = T._Listener(lambda i: inst if i == inst.id else None)
+    # int8-encoded payload is ~67KB on the wire: cut mid-chunk-stream
+    proxy = _CuttingProxy(lst.port, cut_after=30_000)
+    ch = T._PeerChannel({0: ("127.0.0.1", proxy.port)}, 0)
+    try:
+        constants.set("parameterserver_wire_dtype", "int8")
+        constants.set("ps_chunk_bytes", 1 << 14)
+        x = np.random.RandomState(6).randn(1 << 16).astype(np.float32)
+        ch.request(T._KIND_UPDATE, inst.id, 0, 0, rule="add", payload_arr=x)
+        assert proxy.conn_count >= 2, "the cut never forced a reconnect"
+        got = inst.read_shard(0)
+        # applied exactly once: |got - x| within ONE quantization pass
+        # (a double apply would be ~|x| off)
+        assert float(np.abs(got - x).max()) <= np.abs(x).max() / 100
+    finally:
+        ch.close()
+        proxy.close()
+        lst.close()
+        _server.unregister(inst)
+
+
+def test_transport_delta_encoding_protocol():
+    """Delta fetch protocol through a real Transport against its own
+    listener: full -> same -> delta, with the delta chain tracking the
+    server state far tighter than a full int8 refetch."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _server
+
+    constants.set("parameterserver_delta_encoding", True)
+    constants.set("parameterserver_wire_dtype", "int8")
+    inst = _server.register(np.zeros(5000, np.float32), 1)
+    t = T.Transport(_server.get_instance)
+    try:
+        x = np.random.RandomState(7).randn(5000).astype(np.float32)
+        t.update(0, inst.id, 0, 0, "copy", x, fp=inst.fingerprint)
+        a1 = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)  # full
+        a2 = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)  # same
+        np.testing.assert_array_equal(a1, a2)
+        t.update(
+            0, inst.id, 0, 0, "add",
+            np.full(5000, 0.01, np.float32), fp=inst.fingerprint,
+        )
+        a3 = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)  # delta
+        server_state = inst.read_shard(0)
+        delta_err = float(np.abs(a3 - server_state).max())
+        full_refetch_step = float(np.abs(server_state).max()) / 127 / 2
+        assert delta_err < full_refetch_step / 5, (
+            delta_err, full_refetch_step
+        )
+    finally:
+        t.close()
+        _server.unregister(inst)
+
+
+def test_transport_delta_per_client_version_vectors():
+    """Each client keys its own snapshot: client B's first fetch is full
+    even after client A has a delta chain going, and an update between
+    A's fetches yields A a delta while B still 'same's its own state."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _server
+
+    constants.set("parameterserver_delta_encoding", True)
+    inst = _server.register(np.zeros(100, np.float32), 1)
+    t = T.Transport(_server.get_instance)
+    try:
+        t.update(0, inst.id, 0, 0, "copy",
+                 np.ones(100, np.float32), fp=inst.fingerprint)
+        a = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)  # A: full
+        b = t.trigger(0, inst.id, 0, 1, fp=inst.fingerprint)  # B: full
+        np.testing.assert_array_equal(a, b)
+        b2 = t.trigger(0, inst.id, 0, 1, fp=inst.fingerprint)  # B: same
+        np.testing.assert_array_equal(b2, b)
+        t.update(0, inst.id, 0, 0, "add",
+                 np.ones(100, np.float32), fp=inst.fingerprint)
+        a2 = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)  # A: delta
+        np.testing.assert_allclose(a2, 2.0, rtol=1e-6)
+    finally:
+        t.close()
+        _server.unregister(inst)
+
+
+def test_prefetch_double_buffer_semantics():
+    """prefetch() keeps at most `depth` fetches in flight; receive()
+    consumes them oldest-first, so data races ahead of consumption by at
+    most the double-buffer depth."""
+    import time
+
+    ps = ParameterServer(np.zeros(64, np.float32))
+    ps.send(np.full(64, 1.0, np.float32), rule="copy").wait()
+    ps.prefetch()
+    ps.prefetch()
+    ps.prefetch()  # depth 2: must not issue a third
+    time.sleep(0.2)  # prefetched fetches complete with the OLD value
+    ps.send(np.full(64, 2.0, np.float32), rule="copy").wait()
+    assert float(ps.receive().wait()[0]) == 1.0
+    assert float(ps.receive().wait()[0]) == 1.0
+    assert float(ps.receive().wait()[0]) == 2.0  # queue drained: fresh
+    ps.free()
+
+
+def test_prefetch_coherence_never_observes_torn_apply():
+    """A prefetched read must never see a torn apply: 'copy' updates of
+    uniform values race prefetch+receive loops, and every SHARD slice of
+    every fetch is uniform (cross-shard skew is the async-PS staleness
+    contract; intra-shard tearing would be a coherence bug)."""
+    import threading
+
+    ps = ParameterServer(np.full(999, 1.0, np.float32))
+    inst = ps._inst
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        v = 1.0
+        try:
+            while not stop.is_set():
+                v = 3.0 - v  # alternate 1.0 <-> 2.0
+                ps.send(np.full(999, v, np.float32), rule="copy").wait()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(30):
+            ps.prefetch()
+            out = np.asarray(ps.receive().wait())
+            for s, e in inst.ranges:
+                shard = out[s:e]
+                assert shard.min() == shard.max(), (
+                    "torn apply visible inside one shard"
+                )
+                assert shard[0] in (1.0, 2.0)
+    finally:
+        stop.set()
+        t.join(30)
+    assert not errs, errs
+    ps.free()
+
+
+def test_shard_range_rotation_properties():
+    """Rotated shard ranges keep full coverage, zero overlap and the
+    +/-1 size balance for every rotation."""
+    for n, p in [(100, 8), (7, 8), (1000, 7), (3, 2), (67, 8)]:
+        for rot in range(p):
+            ranges = [shard_range(n, p, r, rot) for r in range(p)]
+            assert ranges[0][0] == 0 and ranges[-1][1] == n
+            for (a, b), (c, d) in zip(ranges, ranges[1:]):
+                assert b == c
+            sizes = [e - s for s, e in ranges]
+            assert max(sizes) - min(sizes) <= 1
+            assert sum(sizes) == n
+
+
+def test_shard_rotation_balances_mixed_dtype_instances():
+    """A group of mixed-dtype instances (the byte-aware satellite): the
+    per-instance remainder rotation spreads extra ELEMENTS — and thus
+    extra BYTES, 8 per f64 element vs 4 per f32 — round-robin across
+    server ranks instead of piling them all on rank 0."""
+    from torchmpi_tpu.parameterserver.server import _server
+
+    p = 8
+    n = 67  # 67 % 8 = 3 extra elements per instance
+    insts = []
+    for k in range(8):
+        dt = np.float64 if k % 2 else np.float32
+        insts.append(_server.register(np.zeros(n, dt), p))
+    try:
+        loads = np.zeros(p)
+        base_loads = np.zeros(p)
+        for inst in insts:
+            item = inst.dtype.itemsize
+            for r, (s, e) in enumerate(inst.ranges):
+                loads[r] += (e - s) * item
+            # counterfactual: every instance placing extras on low ranks
+            for r in range(p):
+                s, e = shard_range(n, p, r, 0)
+                base_loads[r] += (e - s) * item
+        # rotation: imbalance bounded by ~one max-itemsize element
+        assert loads.max() - loads.min() <= 2 * 8
+        # the unrotated layout concentrates every instance's extras
+        assert base_loads.max() - base_loads.min() >= 8 * 4
+    finally:
+        for inst in insts:
+            _server.unregister(inst)
+
+
+def test_downpour_eager_prefetch_in_flight():
+    """ps_prefetch: after an integration with prefetch distance 0 the
+    NEXT fetch is already in flight (issued eagerly, consumed by the
+    next integration); disabling the knob restores strict
+    fetch-at-integration scheduling."""
+    from torchmpi_tpu import constants
+
+    p = mpi.size()
+    ones = {"w": jnp.ones((p, 8), jnp.float32)}
+
+    def run_steps(upd, n):
+        params = {"w": jnp.zeros((p, 8), jnp.float32)}
+        for step in range(n):
+            params = upd.update(step, params, ones)
+        return params
+
+    upd = DownpourUpdate(
+        local_update=lambda t: t, send_frequency=1, update_frequency=2,
+        init_delay=1, prefetch=0,
+    )
+    run_steps(upd, 4)  # first integration at step 3
+    assert upd.handles_prefetch, "eager prefetch not issued"
+    params = run_steps(upd, 6)  # runs through the next integration
+    assert np.all(np.isfinite(np.asarray(params["w"])))
+    upd.free()
+
+    constants.set("ps_prefetch", False)
+    upd2 = DownpourUpdate(
+        local_update=lambda t: t, send_frequency=1, update_frequency=2,
+        init_delay=1, prefetch=0,
+    )
+    run_steps(upd2, 4)
+    assert not upd2.handles_prefetch, "knob off must not prefetch eagerly"
+    upd2.free()
+
+
+def test_downpour_quantized_wire_converges_like_full():
+    """Quantized-vs-fp32 equivalence on a quadratic downpour problem:
+    int8 PS wire reaches the same optimum within quantization tolerance
+    (the fast-tier stand-in for the MNIST example check)."""
+    from torchmpi_tpu import constants
+
+    p = mpi.size()
+    rng = np.random.RandomState(11)
+    target = rng.randn(32).astype(np.float32)
+    lr = 0.2
+
+    def run(wire_name):
+        constants.set("parameterserver_wire_dtype", wire_name)
+        params = {"w": jnp.zeros((p, 32), jnp.float32)}
+        upd = DownpourUpdate(
+            local_update=lambda t: (-lr / p) * t,
+            send_frequency=1, update_frequency=2, init_delay=0, prefetch=0,
+        )
+        for step in range(40):
+            w = np.asarray(params["w"])
+            grads = {"w": jnp.asarray(w - target[None, :])}
+            params = upd.update(step, params, grads)
+            w2 = np.asarray(params["w"])
+            params = {
+                "w": jnp.asarray(w2 - lr * (w2 - target[None, :]))
+            }
+        out = np.asarray(params["w"])[0]
+        upd.free()
+        return out
+
+    w_full = run("full")
+    w_int8 = run("int8")
+    err_full = float(np.abs(w_full - target).max())
+    err_int8 = float(np.abs(w_int8 - target).max())
+    # both converge; int8 lands within quantization distance of full
+    assert err_full < 0.05
+    assert err_int8 < err_full + 0.05
+
+
+def test_tune_ps_chunk_bytes_measures_and_persists(tmp_path, monkeypatch):
+    """tune_ps_chunk_bytes measures the real loopback round trip per
+    candidate, applies the winner, and persists it with the other tuned
+    knobs so start() re-applies it."""
+    monkeypatch.setenv(
+        "TORCHMPI_TPU_TUNING_CACHE", str(tmp_path / "autotune.json")
+    )
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.utils import autotune
+
+    best, results = autotune.tune_ps_chunk_bytes(
+        nelem=1 << 14, candidates=(0, 1 << 12), warmup=0, timed=1,
+        apply=True,
+    )
+    assert [c for c, _ in results] == [0, 1 << 12]
+    assert best in (0, 1 << 12)
+    assert constants.get("ps_chunk_bytes") == best
+    path = autotune.save_tuning()
+    assert path.exists()
+    import json
+
+    entry = next(iter(json.loads(path.read_text()).values()))
+    assert entry["ps_chunk_bytes"] == best
+
+
+def test_transport_reconnect_replay_with_telemetry_enabled():
+    """Regression: the reconnect/replay path reads the telemetry handle
+    tuple (grown by the chunk/delta series) — with telemetry ON a broken
+    connection must still replay cleanly instead of dying on the metric
+    lookup."""
+    import time
+
+    from torchmpi_tpu import telemetry
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applies = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                time.sleep(0.05)
+                applies.append(rank)
+                msg.done.set()
+
+            import threading
+
+            threading.Thread(target=run, daemon=True).start()
+
+    telemetry.enable()
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        import threading
+
+        threads = [
+            threading.Thread(
+                target=ch.request,
+                args=(T._KIND_UPDATE, 1, i, 0),
+                kwargs=dict(
+                    rule="add", payload_arr=np.ones(2, np.float32)
+                ),
+            )
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        # wait until frames are actually in flight before severing (a
+        # fixed sleep races thread startup under full-suite load)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with ch.lock:
+                if len(ch.pending) >= 3:
+                    break
+            time.sleep(0.005)
+        ch._kick()  # sever mid-pipeline with telemetry enabled
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive(), "request hung after telemetry replay"
+        assert sorted(applies) == list(range(6))
+        snap = telemetry.snapshot()["metrics"]
+        assert snap.get("tm_ps_reconnects_total", {}).get("series")
+    finally:
+        telemetry.disable()
+        ch.close()
+        lst.close()
+
+
+def test_transport_delta_snapshots_keyed_by_origin_process():
+    """Two ORIGIN processes sharing a client id (both default client=0)
+    must not overwrite each other's server-side reconstruction snapshot:
+    frames carrying different origins key separate delta chains."""
+    import socket
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    inst, _server = _register_instance(64)
+    lst = T._Listener(lambda i: inst if i == inst.id else None)
+    try:
+        socks = []
+        versions = {}
+        for origin in (0, 1):
+            s = socket.create_connection(("localhost", lst.port), timeout=10)
+            s.settimeout(10)
+            socks.append(s)
+            T._send_frame(
+                s, T._KIND_TRIGGER, inst=inst.id, rank=0, client=0,
+                seq=1, rule=f"delta:-1:{origin}",
+            )
+            k, *_, rrule, _, _ = T._recv_frame(s)
+            assert k == T._KIND_SHARD and rrule.startswith("full:")
+            versions[origin] = int(rrule.split(":")[1])
+        # origin 1's full fetch must NOT have clobbered origin 0's
+        # snapshot: origin 0's next fetch at its version still 'same's
+        T._send_frame(
+            socks[0], T._KIND_TRIGGER, inst=inst.id, rank=0, client=0,
+            seq=2, rule=f"delta:{versions[0]}:0",
+        )
+        k, *_, rrule, _, _ = T._recv_frame(socks[0])
+        assert k == T._KIND_SHARD and rrule.startswith("same:"), rrule
+        for s in socks:
+            s.close()
+    finally:
+        lst.close()
+        _server.unregister(inst)

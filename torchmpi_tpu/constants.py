@@ -120,6 +120,37 @@ class _Constants:
     # the fused XLA path anyway) and the scale overhead erodes the win.
     wire_quant_min_elements: int = 1 << 16
 
+    # --- parameter-server data path (wire format + overlap) ---
+    # On-wire encoding for PS client<->server exchanges (updates, shard
+    # fetches): 'full' (fp32 verbatim), 'bf16', or 'int8' (block-
+    # quantized, per-block f32 scales on the wire_quant_block_size grid).
+    # Server shards stay f32 master copies — decode reconstructs f32
+    # before any update rule accumulates, so only the exchange is lossy
+    # (the 1-bit-SGD/QSGD framing). The in-process transport honors the
+    # same precision (encode->decode roundtrip), keeping single-process
+    # convergence evidence faithful to the distributed deployment.
+    parameterserver_wire_dtype: str = "full"
+    # Chunk size (BYTES) for streaming PS shard payloads: encode of chunk
+    # k+1 overlaps wire I/O of chunk k (sendmsg scatter-gather), decode
+    # of chunk k overlaps the recv of chunk k+1 (recv_into, preallocated
+    # buffers). 0 ships each payload as one monolithic frame.
+    # tune_ps_chunk_bytes measures and persists the best value.
+    ps_chunk_bytes: int = 1 << 18
+    # Client-side prefetch: Update schedules (downpour/EASGD) issue the
+    # next center fetch right after consuming the current one, so the
+    # receive() at the next integration finds its data already in flight
+    # (double-buffered per PS instance). Adds up to one send-interval of
+    # staleness to the fetched center when the schedule's own `prefetch`
+    # distance is 0 — the classic Downpour overlap-vs-freshness trade.
+    ps_prefetch: bool = True
+    # Delta-encoded fetches: receive() ships only the since-last-fetch
+    # difference against a per-(shard, client) version vector; unchanged
+    # shards answer with an empty 'same' frame, changed ones with a
+    # delta (which int8-quantizes on far smaller scales than the full
+    # tensor). Off by default: costs one shard-sized snapshot per active
+    # (shard, client) pair server-side.
+    parameterserver_delta_encoding: bool = False
+
     # --- coalescing dispatch (latency path; GC3-style fused plans) ---
     # Capacity of the flat fusion buffer: pending same-(op, dtype, comm,
     # wire) async collectives pack into one contiguous buffer and flush

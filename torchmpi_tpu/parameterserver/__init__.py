@@ -1,5 +1,12 @@
-"""Host-side sharded parameter server (reference N10 + L6/L7)."""
+"""Host-side sharded parameter server (reference N10 + L6/L7).
 
+The data path speaks the quantized + chunk-pipelined wire protocol of
+:mod:`.wire` (``parameterserver_wire_dtype`` / ``ps_chunk_bytes``
+constants), supports delta-encoded fetches
+(``parameterserver_delta_encoding``) and client-side double-buffered
+prefetch (:meth:`ParameterServer.prefetch`, ``ps_prefetch``)."""
+
+from . import wire
 from .rules import UPDATE_RULES
 from .server import ParameterServer, free_all, shard_range
 from .tensors import PSGroup, synchronize_gradients_with_parameterserver
@@ -15,4 +22,5 @@ __all__ = [
     "DownpourUpdate",
     "EASGDUpdate",
     "synchronize_gradients_with_parameterserver",
+    "wire",
 ]

@@ -82,13 +82,35 @@ def _submit_bounded(fn) -> Future:
     return f
 
 
-def shard_range(n: int, size: int, rank: int) -> Tuple[int, int]:
+def shard_range(
+    n: int, size: int, rank: int, rotation: int = 0
+) -> Tuple[int, int]:
     """Uniform shard [start, end) of an n-element tensor for ``rank`` of
-    ``size`` (``getRange``, ``parameterserver.cpp:282-294``); the first
-    ``n % size`` shards take one extra element."""
+    ``size`` (``getRange``, ``parameterserver.cpp:282-294``). The
+    ``n % size`` remainder elements land on the cyclic rank interval
+    ``[rotation, rotation + extra)`` instead of always on the first
+    ranks: with ``rotation = 0`` (the default, reference-exact) every
+    instance piles its extra elements — and therefore extra BYTES, twice
+    as many for f64 as for f32 — onto the low server ranks, so a group
+    of mixed-dtype instances systematically overloads server 0. Byte
+    balance within one instance is already implied by element balance
+    (uniform itemsize); the rotation fixes the CROSS-instance imbalance:
+    instances rotate their remainder placement (``_Instance`` derives
+    ``rotation`` from the collectively-agreed instance id), bounding any
+    rank's cumulative excess at one max-itemsize element per
+    ``size``-instance cycle rather than growing with every instance."""
     base, extra = divmod(n, size)
-    start = rank * base + min(rank, extra)
-    return start, start + base + (1 if rank < extra else 0)
+    if extra == 0 or size == 1:
+        return rank * base, (rank + 1) * base
+    rot = rotation % size
+    end = rot + extra
+    # extras carried by ranks < rank: the cyclic interval [rot, end)
+    before = max(0, min(rank, min(end, size)) - rot)
+    if end > size:
+        before += min(rank, end - size)
+    has_extra = ((rank - rot) % size) < extra
+    start = rank * base + before
+    return start, start + base + (1 if has_extra else 0)
 
 
 class _CancelToken:
@@ -130,6 +152,15 @@ class _Message:
     cancelled: Optional[_CancelToken] = None
     # apply failure message, readable after `done` is set
     error: Optional[str] = None
+    # delta-encoded fetch (socket transport): the client's cached shard
+    # version, or None for a plain full-shard trigger; `wire` is the
+    # requested reply encoding (wire.WIRE_*), used by the server thread
+    # to record the exact encoded reconstruction the client will hold;
+    # `origin` is the requesting PROCESS (distinct processes may share a
+    # client id and must key separate snapshots)
+    delta: Optional[int] = None
+    wire: int = 0
+    origin: int = 0
 
 
 class _Instance:
@@ -160,13 +191,30 @@ class _Instance:
         self.owners = owners if owners is not None else [my_proc] * size
         self.my_proc = my_proc
         flat = full.reshape(-1)
+        # byte-aware remainder placement: rotate per instance so a group
+        # of mixed-dtype instances spreads its extra elements (and their
+        # differently-sized bytes) round-robin over the server ranks
+        # instead of always loading rank 0. Derived from the instance id,
+        # which processes already must agree on (collective creation
+        # order) — the rotation inherits that agreement.
+        self.shard_rotation = instance_id % size
         self.ranges: List[Tuple[int, int]] = []
         sizes = []
         for r in range(size):
-            s, e = shard_range(flat.shape[0], size, r)
+            s, e = shard_range(flat.shape[0], size, r, self.shard_rotation)
             self.ranges.append((s, e))
             # remote shards get zero-size local storage
             sizes.append(e - s if self.owners[r] == my_proc else 0)
+        # delta-fetch bookkeeping (socket transport): per-shard update
+        # version + per-(rank, client, origin process) reconstruction
+        # snapshots — what that client holds after its last (possibly
+        # lossy-encoded) fetch, so the next delta is exact against the
+        # client state and quantization error never compounds across
+        # fetches. Touched only by the server thread (serve_once).
+        self.versions: List[int] = [0] * size
+        self._delta_snaps: Dict[
+            Tuple[int, int, int], Tuple[int, np.ndarray]
+        ] = {}
         self.native = None
         if constants.get("use_native_runtime"):
             try:
@@ -198,7 +246,7 @@ class _Instance:
         from .transport import instance_fingerprint
 
         self.fingerprint = instance_fingerprint(
-            self.shape, self.dtype, size, self.owners
+            self.shape, self.dtype, size, self.owners, self.shard_rotation
         )
 
     def is_local(self, r: int) -> bool:
@@ -280,6 +328,9 @@ class _Instance:
                         if msg.rule not in UPDATE_RULES:
                             raise KeyError(f"unknown update rule {msg.rule!r}")
                         self.apply_rule(r, msg.rule, msg.payload)
+                        # version vector for delta-encoded fetches: every
+                        # applied update advances the shard version
+                        self.versions[r] += 1
                     except Exception as e:
                         # Never kill the (single, shared) server thread and
                         # never strand the sender's completion event; the
@@ -293,10 +344,73 @@ class _Instance:
                             msg.done.set()
                 elif msg.kind == "trigger":
                     try:
-                        msg.reply.set_result(self.read_shard(r))
+                        if msg.delta is not None:
+                            msg.reply.set_result(self._delta_reply(r, msg))
+                        else:
+                            msg.reply.set_result(self.read_shard(r))
                     except Exception as e:  # fulfil with the error
                         msg.reply.set_exception(e)
         return worked
+
+    # bounded per-instance snapshot table: an evicted client self-heals
+    # with a full fetch on its next delta request
+    _DELTA_SNAP_CAP = 256
+
+    def _delta_reply(self, r: int, msg: _Message) -> dict:
+        """Delta-encoded fetch, answered on the server thread (atomic
+        against rule applies). The reply is PREBUILT wire payload parts:
+        encoding here lets the bookkeeping record the client's exact
+        post-decode reconstruction, so consecutive deltas chain without
+        compounding quantization error. Three outcomes:
+
+        - ``same``: client's version is current — empty payload (the
+          bandwidth win for prefetch loops between sparse updates);
+        - ``delta``: ship ``current - snapshot``; deltas quantize on
+          small per-block scales, so int8 error is far tighter than on a
+          full-shard fetch;
+        - ``full``: no/stale snapshot (first fetch, eviction, version
+          mismatch) — fresh full shard, self-healing.
+        """
+        from .. import constants as _c
+        from . import wire as W
+
+        cur = self.read_shard(r)
+        v = self.versions[r]
+        wcode = msg.wire if cur.dtype == np.float32 else W.WIRE_FULL
+        block = _c.get("wire_quant_block_size")
+        chunk_bytes = _c.get("ps_chunk_bytes")
+        key = (r, msg.client, msg.origin)
+        snap = self._delta_snaps.get(key)
+        if snap is not None and snap[0] == msg.delta and msg.delta >= 0:
+            if snap[0] == v:
+                return {
+                    "rule": f"same:{v}", "wire": W.WIRE_FULL, "nchunks": 0,
+                    "parts": [], "total_len": 0, "dtype": cur.dtype.str,
+                    "logical_nbytes": cur.nbytes,
+                }
+            payload, base = cur - snap[1], snap[1]
+            rule = f"delta:{msg.delta}:{v}"
+        else:
+            payload, base = cur, None
+            rule = f"full:{v}"
+        parts, total, nchunks = W.encode_frame_payload(
+            payload, wcode, block, chunk_bytes
+        )
+        recon = W.decode_parts(parts, wcode, np.float32) if (
+            wcode != W.WIRE_FULL
+        ) else np.asarray(payload, cur.dtype).copy()
+        if base is not None:
+            recon = base + recon
+        if len(self._delta_snaps) >= self._DELTA_SNAP_CAP and (
+            key not in self._delta_snaps
+        ):
+            self._delta_snaps.pop(next(iter(self._delta_snaps)))
+        self._delta_snaps[key] = (v, recon)
+        return {
+            "rule": rule, "wire": wcode, "nchunks": nchunks,
+            "parts": parts, "total_len": total, "dtype": cur.dtype.str,
+            "logical_nbytes": cur.nbytes,
+        }
 
 
 class _GlobalServer:
@@ -334,8 +448,18 @@ class _GlobalServer:
         with self._lock:
             inst = _Instance(next(self._ids), full, size, owners, my_proc)
             self._instances[inst.id] = inst
+            # ALWAYS clear terminate, not only when spawning: a register
+            # racing the previous unregister's wind-down could find the
+            # old thread still alive (so no new thread is spawned) while
+            # the terminate flag is still set — the old thread would then
+            # exit on its next pass and strand this instance's mailboxes
+            # forever (a send blocks on an event nobody will set).
+            # Clearing under the lock closes the window: either the old
+            # thread re-reads terminate as unset and keeps serving, or it
+            # already marked itself dead (self._thread = None, also under
+            # the lock) and the check below spawns a fresh one.
+            self._terminate.clear()
             if self._thread is None or not self._thread.is_alive():
-                self._terminate.clear()
                 self._thread = threading.Thread(
                     target=self._loop, name="tm-ps-server", daemon=True
                 )
@@ -475,6 +599,12 @@ class ParameterServer:
             self._inst = _server.register(full, comm.size, owners, my_proc)
         self.shape = full.shape
         self.dtype = full.dtype
+        # client-side prefetch: per-client queues of in-flight receive()
+        # handles, double-buffered (at most 2 outstanding per client) so
+        # the next fetch rides the wire during compute and receive()
+        # consumes data already in flight instead of starting cold
+        self._prefetch_lock = threading.Lock()
+        self._prefetch_q: Dict[int, deque] = {}
 
     # ------------------------------------------------------------------
     def send(
@@ -514,6 +644,9 @@ class ParameterServer:
         transport = self._transport
 
         def do_send():
+            from . import wire as _w
+
+            wcode = _w.resolve_ps_wire(flat.dtype)
             events = []
             # remote shards grouped per peer: one fan-out thread per peer
             # so requests to different processes overlap (the reference's
@@ -523,12 +656,24 @@ class ParameterServer:
             for r in range(inst.size):
                 s, e = inst.ranges[r]
                 if inst.is_local(r):
+                    payload = flat[s:e].copy()
+                    if wcode != _w.WIRE_FULL:
+                        # in-process exchanges honor the wire precision
+                        # too (encode->decode roundtrip): a local shard
+                        # sees EXACTLY the values a socket peer would, so
+                        # single-process runs are convergence-faithful to
+                        # the distributed deployment and the shards stay
+                        # f32 master copies accumulating a quantized wire
+                        payload = _w.roundtrip(
+                            payload, wcode,
+                            constants.get("wire_quant_block_size"),
+                        )
                     ev = threading.Event()
                     msg = _Message(
                         "update",
                         client=client,
                         rule=rule,
-                        payload=flat[s:e].copy(),
+                        payload=payload,
                         done=ev,
                     )
                     inst.post(r, msg)
@@ -536,22 +681,38 @@ class ParameterServer:
                 else:
                     by_proc.setdefault(inst.owners[r], []).append(r)
 
+            # a slice large enough to chunk-stream goes per-rank (the
+            # chunk pipeline overlaps encode with wire I/O); small slices
+            # coalesce into one multi frame per peer as before
+            chunk_bytes = constants.get("ps_chunk_bytes")
+            big = (
+                (4 * chunk_bytes) if chunk_bytes > 0 else float("inf")
+            )
+
             def send_to(proc, ranks, errs):
                 try:
                     # acked after the peer APPLIED the rule (clientSend's
                     # Ssend happens-before, parameterserver.cpp:339-347);
-                    # all of a peer's shard slices travel in ONE frame
-                    if len(ranks) > 1:
+                    # all of a peer's small shard slices travel in ONE
+                    # frame, oversized ones stream chunked per rank
+                    small = [
+                        r for r in ranks
+                        if flat[inst.ranges[r][0]:inst.ranges[r][1]].nbytes
+                        <= big
+                    ]
+                    large = [r for r in ranks if r not in small]
+                    if len(small) > 1:
                         transport.update_multi(
                             proc, inst.id,
                             [
                                 (r, flat[inst.ranges[r][0]:inst.ranges[r][1]])
-                                for r in ranks
+                                for r in small
                             ],
                             client, rule, fp=inst.fingerprint,
                         )
-                    else:
-                        r = ranks[0]
+                    elif small:
+                        large = small + large
+                    for r in large:
                         s, e = inst.ranges[r]
                         transport.update(
                             proc, inst.id, r, client, rule, flat[s:e],
@@ -592,14 +753,50 @@ class ParameterServer:
     def receive(self, client: int = 0) -> SyncHandle:
         """Fetch the full tensor: trigger every server, assemble shards
         (``clientReceive``, ``parameterserver.cpp:356-400``). ``wait()``
-        returns the assembled ndarray."""
+        returns the assembled ndarray.
+
+        A fetch already in flight for this ``client`` (see
+        :meth:`prefetch`) is consumed first: the returned handle IS the
+        prefetched one, so the wire time was overlapped with whatever the
+        caller computed since issuing it. Shard reads are apply-atomic
+        (the server thread serializes rule applies and reads per
+        instance), so a prefetched read never observes a torn apply —
+        cross-shard staleness skew is the async-PS contract, intra-shard
+        tearing is not."""
         if self._inst.freed:
             raise RuntimeError("parameter server already freed")
+        with self._prefetch_lock:
+            q = self._prefetch_q.get(client)
+            if q:
+                return q.popleft()
+        return self._issue_receive(client)
+
+    def prefetch(self, client: int = 0, depth: int = 2) -> SyncHandle:
+        """Start the next :meth:`receive` now and let it ride the wire
+        during compute — double-buffered per (instance, client): at most
+        ``depth`` fetches outstanding (extra calls return the oldest
+        queued handle instead of issuing more, so a polling caller can't
+        build an unbounded stale queue). The next ``receive(client)``
+        consumes the oldest in-flight fetch."""
+        if self._inst.freed:
+            raise RuntimeError("parameter server already freed")
+        with self._prefetch_lock:
+            q = self._prefetch_q.setdefault(client, deque())
+            if len(q) >= max(1, depth):
+                return q[0]
+            h = self._issue_receive(client)
+            q.append(h)
+            return h
+
+    def _issue_receive(self, client: int) -> SyncHandle:
         inst = self._inst
         shape, dtype = self.shape, self.dtype
         transport = self._transport
 
         def do_receive():
+            from . import wire as _w
+
+            wcode = _w.resolve_ps_wire(dtype)
             replies = {}
             out = np.empty((int(np.prod(shape)),), dtype)
             by_proc: Dict[int, List[int]] = {}
@@ -618,7 +815,8 @@ class ParameterServer:
                         # (parameterserver.cpp:356-400)
                         s, e = inst.ranges[r]
                         out[s:e] = transport.trigger(
-                            proc, inst.id, r, client, fp=inst.fingerprint
+                            proc, inst.id, r, client, fp=inst.fingerprint,
+                            logical_dtype=dtype,
                         )
                 except Exception as e:
                     errs.append(e)
@@ -636,7 +834,7 @@ class ParameterServer:
             for r, f in replies.items():
                 s, e = inst.ranges[r]
                 try:
-                    out[s:e] = f.result(timeout)
+                    shard = f.result(timeout)
                 except FuturesTimeoutError:
                     # concurrent.futures.TimeoutError is not the builtin
                     # TimeoutError before Python 3.11
@@ -645,6 +843,15 @@ class ParameterServer:
                         "(possible deadlock: server thread dead or "
                         "mismatched collective ordering)"
                     ) from None
+                if wcode != _w.WIRE_FULL:
+                    # in-process fetch honors the wire precision (see
+                    # do_send): the local client reads exactly what a
+                    # socket peer would decode
+                    shard = _w.roundtrip(
+                        shard, wcode,
+                        constants.get("wire_quant_block_size"),
+                    )
+                out[s:e] = shard
             for t in threads:
                 t.join()
             if errs:
@@ -677,7 +884,7 @@ class ParameterServer:
         if not self._inst.is_local(rank) and self._transport is not None:
             return self._transport.trigger(
                 self._inst.owners[rank], self._inst.id, rank, 0,
-                fp=self._inst.fingerprint,
+                fp=self._inst.fingerprint, logical_dtype=self._inst.dtype,
             )
         return self._inst.read_shard(rank)
 

@@ -202,9 +202,19 @@ class PSGroup:
         return ranks, stacks
 
     def receive_full(self, client: int = 0):
-        """Synchronously fetch the full center value of every leaf."""
-        leaves = [srv.receive(client=client).wait() for srv in self.servers]
+        """Synchronously fetch the full center value of every leaf —
+        all fetches issued first, then waited, so the per-leaf round
+        trips overlap on the pipelined transport instead of serializing
+        (one leaf's wire time hides the next leaf's)."""
+        handles = [srv.receive(client=client) for srv in self.servers]
+        leaves = [h.wait() for h in handles]
         return tree_util.tree_unflatten(self.treedef, leaves)
+
+    def prefetch_full(self, client: int = 0) -> List[SyncHandle]:
+        """Instance-level prefetch of every leaf (double-buffered per
+        server, see :meth:`ParameterServer.prefetch`): the next
+        :meth:`receive_full` consumes these in-flight fetches."""
+        return [srv.prefetch(client=client) for srv in self.servers]
 
     def free(self) -> None:
         for srv in self.servers:

@@ -40,11 +40,12 @@ import struct
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 from .. import constants, telemetry as _telemetry
+from . import wire as _wire
 
 _MAGIC = 0x7E5B
 _KIND_UPDATE = 1
@@ -120,12 +121,23 @@ def _metric_handles():
                 "listener-side replayed frames answered from the "
                 "dedup/poison/in-flight tables, by outcome",
             ),
+            m.histogram(
+                "tm_ps_chunk_pipeline_depth",
+                "chunks per chunked PS frame (the encode/wire/decode "
+                "pipeline depth of that transfer), by kind",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+            ),
+            m.counter(
+                "tm_ps_delta_fetches_total",
+                "delta-encoded fetch outcomes, by reply (full/delta/same)",
+            ),
         )
     return _MET
 
 
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
-#        fp u32, token u32, rule_len u16, dtype_len u16, payload_len u64
+#        fp u32, token u32, wire u8, nchunks u32, rule_len u16,
+#        dtype_len u16, payload_len u64
 #
 # - seq: per-channel monotone sequence on EVERY frame; echoed on the
 #   reply (the client demux correlates by it — the server replies out
@@ -137,7 +149,15 @@ def _metric_handles():
 #   to the wrong tensor.
 # - token: optional shared secret (TORCHMPI_TPU_PS_TOKEN) so a stray
 #   network peer can't read or mutate parameters.
-_HEADER = struct.Struct(">HBIIIQIIHHQ")
+# - wire: payload encoding (wire.WIRE_FULL/BF16/INT8). On an UPDATE it
+#   describes the payload; on a TRIGGER it REQUESTS the reply encoding;
+#   on a SHARD reply it describes the reply payload. ``dtype`` always
+#   names the LOGICAL dtype — the decoded value, never the wire bytes.
+# - nchunks: > 0 means the payload region is a chunk container
+#   (``wire.py``): nchunks x [chunk header | encoded span], streamed so
+#   encode/decode of chunk k+1 overlaps the wire I/O of chunk k. 0 means
+#   the payload is one raw blob (control frames, multi-frame containers).
+_HEADER = struct.Struct(">HBIIIQIIBIHHQ")
 
 
 # Auto-derived per-job frame secret (see _init_job_token): 0 only until
@@ -181,21 +201,88 @@ def _init_job_token() -> None:
     _job_token_value = zlib.crc32(bytes(np.asarray(tok))) & 0xFFFFFFFF
 
 
-def instance_fingerprint(shape, dtype, size: int, owners) -> int:
+def instance_fingerprint(shape, dtype, size: int, owners,
+                         rotation: int = 0) -> int:
     import zlib
 
     desc = f"{tuple(shape)}|{np.dtype(dtype).str}|{size}|{tuple(owners)}"
+    if rotation:
+        # shard ranges depend on the remainder rotation (byte-aware
+        # placement): a rotation disagreement means a ranges disagreement
+        # and must fail as loudly as any other layout desync
+        desc += f"|rot{rotation}"
     return zlib.crc32(desc.encode()) & 0xFFFFFFFF
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` from the socket with ``recv_into`` — no intermediate
+    chunk allocation, no bytes-concat copy."""
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed parameter-server connection")
-        buf.extend(chunk)
-    return bytes(buf)
+        got += r
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """One preallocated buffer, filled in place (the old implementation
+    recv'd fresh chunk objects and copied them into a growing bytearray;
+    this is the recv_into rewrite that kills the per-frame copy even on
+    the non-chunked control path). Returns a bytearray — bytes-compatible
+    for every consumer here (struct.unpack_from, np.frombuffer, decode)."""
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return buf
+
+
+_Buffers = Union[bytes, bytearray, List]
+
+
+def _send_buffers(sock: socket.socket, buffers: _Buffers) -> None:
+    """sendall for a scatter-gather buffer list (``sendmsg``, partial
+    sends handled) or a single blob."""
+    if isinstance(buffers, (bytes, bytearray, memoryview)):
+        sock.sendall(buffers)
+        return
+    if not hasattr(sock, "sendmsg"):
+        # platforms without scatter-gather sockets (win32): one concat
+        # per frame, the pre-chunking behavior
+        sock.sendall(b"".join(bytes(memoryview(b).cast("B"))
+                              for b in buffers))
+        return
+    views = [memoryview(b).cast("B") if not isinstance(b, memoryview) else b
+             for b in buffers]
+    while views:
+        # bounded iovec count per call (IOV_MAX); the loop drains the rest
+        sent = sock.sendmsg(views[:64])
+        while views and sent >= len(views[0]):
+            sent -= len(views[0])
+            views.pop(0)
+        if sent and views:
+            views[0] = views[0][sent:]
+
+
+def _frame_header(
+    kind: int,
+    inst: int = 0,
+    rank: int = 0,
+    client: int = 0,
+    seq: int = 0,
+    fp: int = 0,
+    wire: int = 0,
+    nchunks: int = 0,
+    rule: str = "",
+    dtype: str = "",
+    payload_len: int = 0,
+):
+    rule_b, dtype_b = rule.encode(), dtype.encode()
+    header = _HEADER.pack(
+        _MAGIC, kind, inst, rank, client, seq, fp, _auth_token(),
+        wire, nchunks, len(rule_b), len(dtype_b), payload_len,
+    )
+    return header, rule_b, dtype_b
 
 
 def _frame_bytes(
@@ -208,11 +295,12 @@ def _frame_bytes(
     rule: str = "",
     dtype: str = "",
     payload: bytes = b"",
+    wire: int = 0,
+    nchunks: int = 0,
 ) -> bytes:
-    rule_b, dtype_b = rule.encode(), dtype.encode()
-    header = _HEADER.pack(
-        _MAGIC, kind, inst, rank, client, seq, fp, _auth_token(),
-        len(rule_b), len(dtype_b), len(payload),
+    header, rule_b, dtype_b = _frame_header(
+        kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
+        len(payload),
     )
     return header + rule_b + dtype_b + payload
 
@@ -227,18 +315,30 @@ def _send_frame(
     fp: int = 0,
     rule: str = "",
     dtype: str = "",
-    payload: bytes = b"",
+    payload: _Buffers = b"",
+    wire: int = 0,
+    nchunks: int = 0,
 ) -> None:
-    sock.sendall(
-        _frame_bytes(kind, inst, rank, client, seq, fp, rule, dtype, payload)
-    )
+    if isinstance(payload, list):
+        total = sum(len(memoryview(b).cast("B")) for b in payload)
+        header, rule_b, dtype_b = _frame_header(
+            kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
+            total,
+        )
+        _send_buffers(sock, [header, rule_b, dtype_b] + payload)
+    else:
+        sock.sendall(
+            _frame_bytes(
+                kind, inst, rank, client, seq, fp, rule, dtype, payload,
+                wire, nchunks,
+            )
+        )
 
 
-def _recv_frame(sock: socket.socket):
+def _recv_head(sock: socket.socket):
     header = _recv_exact(sock, _HEADER.size)
-    magic, kind, inst, rank, client, seq, fp, token, rl, dl, pl = (
-        _HEADER.unpack(header)
-    )
+    (magic, kind, inst, rank, client, seq, fp, token, wire, nchunks,
+     rl, dl, pl) = _HEADER.unpack(header)
     if magic != _MAGIC:
         raise ConnectionError(
             f"bad parameter-server frame magic 0x{magic:x}"
@@ -247,7 +347,64 @@ def _recv_frame(sock: socket.socket):
         raise ConnectionError("parameter-server frame failed authentication")
     rule = _recv_exact(sock, rl).decode() if rl else ""
     dtype = _recv_exact(sock, dl).decode() if dl else ""
-    payload = _recv_exact(sock, pl) if pl else b""
+    return kind, inst, rank, client, seq, fp, rule, dtype, wire, nchunks, pl
+
+
+def _read_payload(
+    sock: socket.socket, pl: int, wire: int, nchunks: int, dtype_str: str
+):
+    """Read (and decode) a frame payload.
+
+    Unchunked (``nchunks == 0``): one recv_into-filled buffer, returned
+    raw (control frames; multi containers are decoded by
+    :func:`_parse_multi_payload`).
+
+    Chunked: stream the container — recv_into each chunk's encoded bytes
+    into a reusable scratch buffer and dequantize it into the
+    preallocated logical array immediately, so decode of chunk k overlaps
+    the wire I/O of chunk k+1 and the last byte's arrival leaves almost
+    no decode work. WIRE_FULL chunks recv_into the logical array
+    directly (zero staging copy). Returns a memoryview of the logical
+    bytes (np.frombuffer-compatible, like the raw path)."""
+    if nchunks == 0:
+        return _recv_exact(sock, pl)
+    dt = np.dtype(dtype_str or "<f4")
+    out: Optional[np.ndarray] = None
+    out_mv: Optional[memoryview] = None
+    hdr = bytearray(_wire.CHUNK_HDR_SIZE)
+    hdr_mv = memoryview(hdr)
+    scratch = bytearray()
+    for _ in range(nchunks):
+        _recv_exact_into(sock, hdr_mv)
+        off, total, cn, nb, block = _wire.read_chunk_header(hdr)
+        if out is None:
+            out = np.empty(total, dt)
+            out_mv = memoryview(out).cast("B")
+        if wire == _wire.WIRE_FULL:
+            _recv_exact_into(
+                sock, out_mv[off * dt.itemsize:off * dt.itemsize + nb]
+            )
+            continue
+        if len(scratch) < nb:
+            scratch = bytearray(nb)
+        view = memoryview(scratch)[:nb]
+        _recv_exact_into(sock, view)
+        out[off:off + cn] = _wire.decode_span(view, cn, wire, block, dt)
+    if out is None:
+        return b""
+    return memoryview(out).cast("B")
+
+
+def _recv_frame(sock: socket.socket):
+    """Read one frame; chunked / quantized payloads are reassembled and
+    decoded transparently — the returned payload is always LOGICAL bytes
+    of ``dtype`` (the 9-tuple shape every caller and test relies on)."""
+    kind, inst, rank, client, seq, fp, rule, dtype, wire, nchunks, pl = (
+        _recv_head(sock)
+    )
+    payload = (
+        _read_payload(sock, pl, wire, nchunks, dtype) if pl else b""
+    )
     return kind, inst, rank, client, seq, fp, rule, dtype, payload
 
 
@@ -268,8 +425,11 @@ def _enable_keepalive(sock: socket.socket) -> None:
                 pass
 
 
-def _parse_multi_payload(payload: bytes, dt: np.dtype):
-    """Decode a _KIND_UPDATE_MULTI payload into [(rank, values)]."""
+def _parse_multi_payload(payload, dt: np.dtype, wire: int = 0):
+    """Decode a _KIND_UPDATE_MULTI payload into [(rank, values)]. With a
+    non-full frame wire byte each item's bytes are a chunk container
+    (encoded per item so the per-rank slices quantize on independent
+    grids); decoded values are always the logical dtype."""
     (count,) = _MULTI_COUNT.unpack_from(payload, 0)
     off = _MULTI_COUNT.size
     metas = []
@@ -277,11 +437,19 @@ def _parse_multi_payload(payload: bytes, dt: np.dtype):
         r, nb = _MULTI_ITEM.unpack_from(payload, off)
         off += _MULTI_ITEM.size
         metas.append((r, nb))
+    mv = memoryview(payload)
     items = []
     for r, nb in metas:
-        items.append(
-            (r, np.frombuffer(payload, dt, count=nb // dt.itemsize, offset=off))
-        )
+        if wire == _wire.WIRE_FULL:
+            items.append(
+                (r, np.frombuffer(
+                    payload, dt, count=nb // dt.itemsize, offset=off
+                ))
+            )
+        else:
+            items.append(
+                (r, _wire.decode_container(mv[off:off + nb], 0, wire, dt))
+            )
         off += nb
     return items
 
@@ -499,8 +667,19 @@ class _Listener:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while not self._stop.is_set():
-                kind, inst_id, rank, client, seq, fp, rule, dtype, payload = (
-                    _recv_frame(conn)
+                (kind, inst_id, rank, client, seq, fp, rule, dtype,
+                 wire, nchunks, pl) = _recv_head(conn)
+                # chunked payloads stream + dequantize chunk-by-chunk into
+                # one preallocated logical buffer (decode of chunk k
+                # overlaps wire I/O of chunk k+1); the decoded payload is
+                # applied as ONE atomic message below — per-chunk apply
+                # would let a concurrent trigger read a torn shard and a
+                # connection torn mid-stream would partially apply a
+                # non-idempotent rule that the channel replay then doubles
+                payload = (
+                    _read_payload(conn, pl, wire, nchunks, dtype)
+                    if pl
+                    else b""
                 )
                 if kind == _KIND_BARRIER:
                     # subset barrier: record (tag, origin) and ack receipt;
@@ -615,9 +794,14 @@ class _Listener:
                     try:
                         dt = np.dtype(dtype)
                         if kind == _KIND_UPDATE_MULTI:
-                            items = _parse_multi_payload(payload, dt)
+                            items = _parse_multi_payload(payload, dt, wire)
+                            owned = wire != _wire.WIRE_FULL
                         else:
                             items = [(rank, np.frombuffer(payload, dt))]
+                            # a decoded container is a fresh buffer with no
+                            # other referents: safe to hand to the mailbox
+                            # without the defensive copy
+                            owned = nchunks > 0
                     except Exception as e:  # noqa: BLE001 - bad wire payload
                         if seq:
                             with self._applied_lock:
@@ -638,8 +822,8 @@ class _Listener:
                             token = _CancelToken()
                             msg = _Message(
                                 "update", client=client, rule=rule,
-                                payload=values.copy(), done=ev,
-                                cancelled=token,
+                                payload=values if owned else values.copy(),
+                                done=ev, cancelled=token,
                             )
                             inst.post(r, msg)
                             posted.append((ev, token, msg))
@@ -660,10 +844,31 @@ class _Listener:
                     )
                 elif kind == _KIND_TRIGGER:
                     f: Future = Future()
-                    inst.post(rank, _Message("trigger", client=client, reply=f))
+                    delta_base = None
+                    delta_origin = 0
+                    if rule.startswith("delta:"):
+                        # delta-encoded fetch: the client names the version
+                        # of its cached copy (and its origin process — two
+                        # processes may share a client id, e.g. the default
+                        # client=0, and must not overwrite each other's
+                        # reconstruction snapshots); the server thread
+                        # answers with 'same' / a delta against its
+                        # recorded reconstruction / a fresh full shard
+                        fields = rule.split(":")
+                        delta_base = int(fields[1])
+                        if len(fields) > 2:
+                            delta_origin = int(fields[2])
+                    inst.post(
+                        rank,
+                        _Message(
+                            "trigger", client=client, reply=f,
+                            delta=delta_base, wire=wire,
+                            origin=delta_origin,
+                        ),
+                    )
                     self._submit(
                         self._finish_trigger, reply, f, seq, inst_id, rank,
-                        timeout,
+                        timeout, wire,
                     )
                 else:
                     reply(_KIND_ERROR, seq, rule=f"bad kind {kind}")
@@ -772,15 +977,56 @@ class _Listener:
                 if done_ev is not None:
                     done_ev.set()
 
-    def _finish_trigger(self, reply, fut, seq, inst_id, rank, timeout) -> None:
+    def _finish_trigger(
+        self, reply, fut, seq, inst_id, rank, timeout, req_wire: int = 0
+    ) -> None:
         try:
             shard = fut.result(timeout)
         except Exception as e:  # noqa: BLE001 - reported to the client
             reply(_KIND_ERROR, seq, rule=str(e))
             return
+        from ..utils.tracing import wire_stats
+
+        if isinstance(shard, dict):
+            # delta-mode reply prebuilt on the server thread (the encode
+            # happened there so the per-client reconstruction bookkeeping
+            # records EXACTLY what goes on the wire)
+            wire_stats.record(
+                "ps_fetch", _wire.WIRE_NAMES.get(shard["wire"], "full"),
+                shard["logical_nbytes"], shard["total_len"],
+            )
+            reply(
+                _KIND_SHARD, seq, inst=inst_id, rank=rank,
+                rule=shard["rule"], dtype=shard["dtype"],
+                payload=shard["parts"], wire=shard["wire"],
+                nchunks=shard["nchunks"],
+            )
+            return
+        wire_eff = req_wire if shard.dtype == np.float32 else _wire.WIRE_FULL
+        chunk_bytes = constants.get("ps_chunk_bytes")
+        if wire_eff == _wire.WIRE_FULL and (
+            chunk_bytes <= 0 or shard.nbytes <= chunk_bytes
+        ):
+            wire_stats.record("ps_fetch", "full", shard.nbytes, shard.nbytes)
+            reply(
+                _KIND_SHARD, seq, inst=inst_id, rank=rank,
+                dtype=shard.dtype.str, payload=shard.tobytes(),
+            )
+            return
+        block = constants.get("wire_quant_block_size")
+        parts, total, nchunks = _wire.encode_frame_payload(
+            shard, wire_eff, block, chunk_bytes
+        )
+        wire_stats.record(
+            "ps_fetch", _wire.WIRE_NAMES.get(wire_eff, "full"),
+            shard.nbytes, total,
+        )
+        if _telemetry.enabled() and nchunks:
+            _metric_handles()[6].observe(nchunks, kind="trigger")
         reply(
             _KIND_SHARD, seq, inst=inst_id, rank=rank,
-            dtype=shard.dtype.str, payload=shard.tobytes(),
+            dtype=shard.dtype.str, payload=parts, wire=wire_eff,
+            nchunks=nchunks,
         )
 
     def close(self):
@@ -793,13 +1039,14 @@ class _Listener:
 
 
 class _Waiter:
-    """One in-flight request: the raw frame (retained so a reconnect can
-    replay it in original order) and the completion slot. ``t0``/``kind``
-    are telemetry fields (set only when telemetry is enabled)."""
+    """One in-flight request: the raw frame — a scatter-gather buffer
+    list, retained fully encoded so a reconnect can replay it in
+    original order — and the completion slot. ``t0``/``kind`` are
+    telemetry fields (set only when telemetry is enabled)."""
 
     __slots__ = ("event", "frame", "reply", "error", "t0", "kind")
 
-    def __init__(self, frame: bytes):
+    def __init__(self, frame: _Buffers):
         self.event = threading.Event()
         self.frame = frame
         self.reply = None
@@ -962,13 +1209,13 @@ class _PeerChannel:
                 return
             self._unacked_replays += 1
             if _telemetry.enabled():
-                _, _, reconnects, replayed, _, _ = _metric_handles()
-                reconnects.inc()
-                replayed.inc(len(self.pending))
+                met = _metric_handles()
+                met[2].inc()  # reconnects
+                met[3].inc(len(self.pending))  # replayed frames
             try:
                 sock = self._connected_locked()
                 for w in self.pending.values():
-                    sock.sendall(w.frame)
+                    _send_buffers(sock, w.frame)
             except (ConnectionError, OSError) as e2:
                 if self.sock is not None:
                     try:
@@ -1003,13 +1250,14 @@ class _PeerChannel:
         payload_arr: Optional[np.ndarray] = None,
         payload_raw: bytes = b"",
         dtype_str: str = "",
+        wire: Optional[int] = None,
     ):
         """Pipelined request/response."""
         return self.complete(
             self.submit(
                 kind, inst, rank, client, fp=fp, rule=rule,
                 payload_arr=payload_arr, payload_raw=payload_raw,
-                dtype_str=dtype_str,
+                dtype_str=dtype_str, wire=wire,
             )
         )
 
@@ -1024,48 +1272,112 @@ class _PeerChannel:
         payload_arr: Optional[np.ndarray] = None,
         payload_raw: bytes = b"",
         dtype_str: str = "",
+        wire: Optional[int] = None,
     ) -> _Waiter:
         """Put one frame on the wire and return its waiter WITHOUT waiting
         for the reply — fan-out callers (allgather_blob, barrier) submit to
         every peer first, then :meth:`complete` each, so P-1 exchanges cost
         ~1 round trip instead of P-1 serialized ones.
 
+        ``payload_arr`` frames go through the PS wire codec: the payload
+        is encoded per ``parameterserver_wire_dtype`` (int8 block-quant /
+        bf16 / full) and split into ``ps_chunk_bytes`` chunks, each
+        quantized-then-sent in turn so serialization of chunk k+1
+        overlaps the wire I/O of chunk k (``sendmsg`` scatter-gather, no
+        concat copy). ``wire`` overrides the encoding (TRIGGERs use it to
+        request a reply encoding; explicit WIRE_FULL pins a frame
+        verbatim).
+
         EVERY frame draws a seq from the per-peer counter UNDER the channel
         lock together with the send — assignment order == wire order, so
         the server's dedup can never confuse concurrent sends with
         retries, and replies (now out-of-order: the server applies
         concurrently) are correlated back by the echoed seq."""
+        wire_eff = int(wire) if wire is not None else 0
+        nchunks = 0
+        chunk_iter = None
+        total_len = len(payload_raw)
+        block = 0
         if payload_arr is not None:
-            payload_raw = payload_arr.tobytes()
-            dtype_str = payload_arr.dtype.str
+            arr = np.ascontiguousarray(payload_arr)
+            dtype_str = arr.dtype.str
+            if wire is None:
+                wire_eff = _wire.resolve_ps_wire(arr.dtype)
+            chunk_bytes = constants.get("ps_chunk_bytes")
+            if arr.size == 0:
+                wire_eff = _wire.WIRE_FULL  # empty shard: nothing to encode
+            if wire_eff == _wire.WIRE_FULL and (
+                chunk_bytes <= 0 or arr.nbytes <= chunk_bytes
+            ):
+                payload_raw = arr.tobytes()  # small fp32 frame: legacy path
+                total_len = len(payload_raw)
+            else:
+                block = constants.get("wire_quant_block_size")
+                n = int(arr.size)
+                total_len, nchunks = _wire.container_nbytes(
+                    n, wire_eff, block, chunk_bytes, arr.dtype.itemsize
+                )
+                chunk_iter = _wire.iter_encoded_chunks(
+                    arr, wire_eff, block, chunk_bytes
+                )
+            from ..utils.tracing import wire_stats
+
+            wire_stats.record(
+                "ps_update", _wire.WIRE_NAMES.get(wire_eff, "full"),
+                arr.nbytes, total_len,
+            )
         with self.lock:
             if self.closed:
                 raise ConnectionError("parameter-server transport closed")
             self.seq += 1
             seq = self.seq
-            w = _Waiter(
-                _frame_bytes(
-                    kind, inst, rank, client, seq, fp, rule, dtype_str,
-                    payload_raw,
-                )
+            header, rule_b, dtype_b = _frame_header(
+                kind, inst, rank, client, seq, fp, wire_eff, nchunks,
+                rule, dtype_str, total_len,
             )
+            w = _Waiter([header, rule_b, dtype_b])
             if _telemetry.enabled():
                 w.t0 = time.monotonic()
                 w.kind = kind
-                _metric_handles()[0].inc(
-                    kind=_KIND_NAMES.get(kind, str(kind))
-                )
+                met = _metric_handles()
+                met[0].inc(kind=_KIND_NAMES.get(kind, str(kind)))
+                if nchunks:
+                    met[6].observe(
+                        nchunks, kind=_KIND_NAMES.get(kind, str(kind))
+                    )
             sock = self._connected_locked()  # raises if unreachable
             self.pending[seq] = w
-            try:
-                sock.sendall(w.frame)
-            except OSError:
-                # leave w in pending and close: the reader's replay path
-                # resends it (in order) on the next connection
+            sock_ok = True
+
+            def _try_send(bufs) -> None:
+                nonlocal sock_ok
+                if not sock_ok:
+                    return
                 try:
-                    sock.close()
+                    _send_buffers(sock, bufs)
                 except OSError:
-                    pass
+                    # leave w in pending and close: the reader's replay
+                    # path resends the (fully encoded) frame in order on
+                    # the next connection. Encoding continues below so the
+                    # retained frame is complete.
+                    sock_ok = False
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+            if chunk_iter is None:
+                if payload_raw:
+                    w.frame.append(payload_raw)
+                _try_send(w.frame)
+            else:
+                # pipelined chunk stream: encode chunk k+1 while the
+                # kernel drains chunk k; the header rides with chunk 0
+                pending_bufs = list(w.frame)
+                for bufs in chunk_iter:
+                    w.frame.extend(bufs)
+                    _try_send(pending_bufs + bufs)
+                    pending_bufs = []
         return w
 
     def complete(self, w: _Waiter):
@@ -1161,6 +1473,15 @@ class Transport:
         host = os.environ.get("TORCHMPI_TPU_PS_HOST") or socket.gethostname()
         addresses = self._exchange_addresses(host, self.listener.port)
         self.pool = _PeerPool(addresses)
+        # delta-fetch client cache: (proc, inst, rank, client) ->
+        # (version, reconstruction). One in-flight delta round trip per
+        # key (the per-key lock): overlapping deltas against one snapshot
+        # would fork the client/server reconstruction agreement.
+        self._delta_cache: Dict[Tuple[int, int, int, int],
+                                Tuple[int, np.ndarray]] = {}
+        self._delta_locks: Dict[Tuple[int, int, int, int],
+                                threading.Lock] = {}
+        self._delta_guard = threading.Lock()
 
     @staticmethod
     def _exchange_addresses(host: str, port: int) -> Dict[int, Tuple[str, int]]:
@@ -1200,28 +1521,112 @@ class Transport:
         (``rank_slices`` = [(rank, 1-D array)], all one dtype): one round
         trip + one applied-ack per peer instead of one per rank — the
         frame-level analog of the reference's per-chunk Isend fan-out
-        (``parameterserver.cpp:309-353``)."""
+        (``parameterserver.cpp:309-353``). Each item is wire-encoded
+        independently (its own quantization grid); the frame travels
+        unchunked — ``server.py`` routes oversized slices through
+        per-rank chunked UPDATE frames instead."""
         arrs = [np.ascontiguousarray(a) for _, a in rank_slices]
+        wire_eff = _wire.resolve_ps_wire(arrs[0].dtype)
+        if wire_eff == _wire.WIRE_FULL:
+            blobs = [a.tobytes() for a in arrs]
+        else:
+            block = constants.get("wire_quant_block_size")
+            blobs = []
+            for a in arrs:
+                if a.size == 0:
+                    blobs.append(b"")
+                    continue
+                parts, _, _ = _wire.encode_frame_payload(
+                    a, wire_eff, block, 0
+                )
+                blobs.append(b"".join(bytes(p) for p in parts))
         payload = b"".join(
             [_MULTI_COUNT.pack(len(rank_slices))]
             + [
-                _MULTI_ITEM.pack(r, a.nbytes)
-                for (r, _), a in zip(rank_slices, arrs)
+                _MULTI_ITEM.pack(r, len(b))
+                for (r, _), b in zip(rank_slices, blobs)
             ]
-            + [a.tobytes() for a in arrs]
+            + blobs
+        )
+        from ..utils.tracing import wire_stats
+
+        wire_stats.record(
+            "ps_update_multi", _wire.WIRE_NAMES.get(wire_eff, "full"),
+            sum(a.nbytes for a in arrs), len(payload),
         )
         self.pool.request(
             proc, _KIND_UPDATE_MULTI, inst, _MULTI_RANK, client,
-            fp=fp, rule=rule,
+            fp=fp, rule=rule, wire=wire_eff,
             payload_raw=payload, dtype_str=arrs[0].dtype.str,
         )
 
+    # bounded client-side reconstruction cache: long-running jobs churn
+    # PS instances, and each key pins a shard-sized array — evicted keys
+    # self-heal with a full fetch (mirrors the server's snapshot cap)
+    _DELTA_CACHE_CAP = 256
+
+    def _delta_lock_for(self, key) -> threading.Lock:
+        with self._delta_guard:
+            lock = self._delta_locks.get(key)
+            if lock is None:
+                lock = self._delta_locks[key] = threading.Lock()
+            return lock
+
+    def _delta_cache_store(self, key, entry) -> None:
+        with self._delta_guard:
+            while (
+                len(self._delta_cache) >= self._DELTA_CACHE_CAP
+                and key not in self._delta_cache
+            ):
+                # evict the array only — the per-key lock stays (tiny,
+                # and replacing a lock another thread still holds would
+                # briefly allow two concurrent deltas on one key)
+                self._delta_cache.pop(next(iter(self._delta_cache)))
+            self._delta_cache[key] = entry
+
     def trigger(
-        self, proc: int, inst: int, rank: int, client: int, fp: int = 0
+        self, proc: int, inst: int, rank: int, client: int, fp: int = 0,
+        logical_dtype=np.float32,
     ) -> np.ndarray:
-        return self.pool.request(
-            proc, _KIND_TRIGGER, inst, rank, client, fp=fp
-        )
+        wire_req = _wire.resolve_ps_wire(logical_dtype)
+        if not constants.get("parameterserver_delta_encoding"):
+            return self.pool.request(
+                proc, _KIND_TRIGGER, inst, rank, client, fp=fp,
+                wire=wire_req,
+            )
+        # delta-encoded fetch: ship only the since-last-fetch difference
+        # against the per-client version vector. Unchanged shard -> empty
+        # 'same' reply (the big win for prefetch loops between sparse
+        # updates); changed -> a delta, which quantizes on small scales
+        # (tighter int8 error than a full-shard fetch); version mismatch
+        # or server-side eviction -> a fresh full shard, self-healing.
+        key = (proc, inst, rank, client)
+        with self._delta_lock_for(key):
+            cached = self._delta_cache.get(key)
+            base = cached[0] if cached is not None else -1
+            w = self.pool.submit(
+                proc, _KIND_TRIGGER, inst, rank, client, fp=fp,
+                rule=f"delta:{base}:{self.process_index}", wire=wire_req,
+            )
+            arr = self.pool.complete(proc, w)
+            rrule = w.reply[6]
+            if _telemetry.enabled():
+                outcome = rrule.split(":", 1)[0] or "legacy"
+                _metric_handles()[7].inc(reply=outcome)
+            if rrule.startswith("same:"):
+                version = int(rrule.split(":")[1])
+                self._delta_cache_store(key, (version, cached[1]))
+                return cached[1].copy()
+            if rrule.startswith("delta:"):
+                _, _, version = rrule.split(":")
+                new = cached[1] + arr
+                self._delta_cache_store(key, (int(version), new))
+                return new.copy()
+            if rrule.startswith("full:"):
+                version = int(rrule.split(":")[1])
+                self._delta_cache_store(key, (version, arr.copy()))
+                return arr
+            return arr  # peer predates delta mode: plain shard reply
 
     def barrier(self, procs, tag: str, timeout=None) -> None:
         """Barrier among the process subset ``procs`` (all must call with

@@ -101,9 +101,13 @@ class Update:
         if step == self.next_prefetch and self.ps is not None:
             _wait_all(self.handles_send)
             self.handles_send = []
-            self.handles_prefetch = self.ps.prefetch_tensors(
-                client_ranks=self._integrating_ranks()
-            )
+            if not self.handles_prefetch:
+                # nothing in flight; otherwise the eager post-integration
+                # prefetch (ps_prefetch) already issued this fetch and
+                # only the schedule counter advances
+                self.handles_prefetch = self.ps.prefetch_tensors(
+                    client_ranks=self._integrating_ranks()
+                )
             self.next_prefetch += self.update_frequency
 
     def _integrate(self, step: int, params):
@@ -121,6 +125,28 @@ class Update:
         integrated = False
         self._fetch(step)
         params, integrated = self._integrate(step, params)
+        if (
+            integrated
+            and self.prefetch == 0
+            and self.ps is not None
+            and not self.handles_prefetch
+        ):
+            from .. import constants
+
+            if constants.get("ps_prefetch"):
+                # eager client-side prefetch: with a zero prefetch
+                # distance the scheduled fetch lands at the integration
+                # step itself (no overlap at all) — issue the NEXT fetch
+                # right now instead, so it rides the wire during the
+                # coming update_frequency steps of compute and the next
+                # integration consumes data already in flight. Cost: the
+                # fetched center excludes sends after this tick (one
+                # interval of extra staleness — the Downpour trade;
+                # disable via constants ps_prefetch=False for exact
+                # fetch-at-integration semantics).
+                self.handles_prefetch = self.ps.prefetch_tensors(
+                    client_ranks=self._integrating_ranks()
+                )
         self._send(step, params, grads)
 
         # Mixed PS x DP: broadcast integrated params within DP groups

@@ -1,0 +1,341 @@
+"""Parameter-server wire codec: quantized + chunked payload encoding.
+
+The PS data path moves whole shard slices between client and server
+processes; PR 2 gave the ring collectives a block-quantized wire format
+(EQuARX-style) while the PS still shipped monolithic fp32 frames. This
+module is the PS analog: a host-side (numpy) codec shared by the socket
+transport's frame encode/decode and the in-process path's precision
+simulation, with three encodings —
+
+- ``full``: logical bytes verbatim;
+- ``bf16``: round-to-nearest-even truncation to bfloat16 (uint16 on the
+  wire, half the bytes), exact for values already representable;
+- ``int8``: symmetric per-block quantization (``amax/127`` scale, one
+  f32 scale per ``block`` elements — the same grid as
+  ``collectives/primitives.quantize_blocks``), ~3.9x fewer bytes.
+
+Server shards stay f32 **master copies**: decode always reconstructs f32
+before an update rule touches a shard, so accumulation happens at full
+precision and quantization error never compounds inside the server —
+only per client<->server exchange (the 1-bit-SGD / QSGD framing: the
+wire, not the state, is lossy).
+
+Chunk container
+---------------
+
+A payload bigger than ``ps_chunk_bytes`` travels as a sequence of
+self-describing chunks, each independently encoded::
+
+    [_CHUNK_HDR: off u64, total u64, nelem u32, enc_nbytes u32, block u32]
+    [enc_nbytes bytes]
+
+so the sender quantizes/serializes chunk k+1 while chunk k is on the
+wire (``sendmsg`` scatter-gather, no concat copy) and the receiver
+``recv_into``s each chunk and dequantizes it into the preallocated
+logical buffer while the next chunk is still in flight. Chunk sizes are
+deterministic from (nelem, wire, block), so the total wire length is
+known before the first byte is sent (the frame header needs it).
+
+The decoded payload is applied as ONE atomic message per frame: applying
+chunk-by-chunk would let a concurrent trigger read a torn shard (the
+mailbox's per-shard apply atomicity is a coherence contract the prefetch
+path relies on), and a connection torn mid-stream would leave a partial
+non-idempotent 'add' that a channel replay then double-applies. The
+pipeline overlap therefore covers encode -> wire -> decode; the final
+vectorized rule apply is one numpy op, cheap next to the dequantize it
+overlaps with.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+# wire codes carried in the frame header (u8)
+WIRE_FULL = 0
+WIRE_BF16 = 1
+WIRE_INT8 = 2
+
+WIRE_NAMES = {WIRE_FULL: "full", WIRE_BF16: "bf16", WIRE_INT8: "int8"}
+WIRE_CODES = {v: k for k, v in WIRE_NAMES.items()}
+
+# per-chunk header: logical element offset, total logical elements of the
+# frame payload (same in every chunk; lets the receiver preallocate on
+# first-chunk arrival), this chunk's logical element count, its encoded
+# byte length, and the quantization block size (embedded so a receiver
+# with a different ``wire_quant_block_size`` constant still decodes
+# correctly — the sender's grid is authoritative).
+_CHUNK_HDR = struct.Struct(">QQIII")
+CHUNK_HDR_SIZE = _CHUNK_HDR.size
+
+# smallest positive scale: a zero block must not divide by zero and its
+# dequantized zeros stay exactly zero (same epsilon as the collective
+# quantizer)
+_EPS = np.float32(1e-30)
+
+
+def wire_code(name: str) -> int:
+    try:
+        return WIRE_CODES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown parameterserver wire dtype {name!r} "
+            f"(have {sorted(WIRE_CODES)})"
+        ) from None
+
+
+def resolve_ps_wire(arr_dtype, explicit: str = None) -> int:
+    """Effective wire code for a payload of ``arr_dtype``: quantized
+    encodings engage only for float32 (f64 PS instances ship verbatim —
+    the reference instantiates Float/Double and the lossy formats target
+    the f32 gradient/parameter traffic)."""
+    from .. import constants
+
+    name = explicit or constants.get("parameterserver_wire_dtype")
+    if np.dtype(arr_dtype) != np.float32:
+        return WIRE_FULL
+    return wire_code(name)
+
+
+# ---------------------------------------------------------------------------
+# scalar span codecs (one contiguous f32 span -> encoded bytes and back)
+# ---------------------------------------------------------------------------
+
+
+def _bf16_encode(x: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 bits (uint16) with round-to-nearest-even."""
+    bits = np.ascontiguousarray(x, np.float32).view(np.uint32)
+    rounded = bits + 0x7FFF + ((bits >> 16) & 1)
+    return (rounded >> 16).astype(np.uint16)
+
+
+def _bf16_decode(u16: np.ndarray) -> np.ndarray:
+    return (u16.astype(np.uint32) << 16).view(np.float32)
+
+
+def _int8_encode(x: np.ndarray, block: int) -> Tuple[np.ndarray, np.ndarray]:
+    """f32 span -> (int8 values zero-padded to whole blocks, f32 scales)."""
+    flat = np.ascontiguousarray(x, np.float32).reshape(-1)
+    n = flat.shape[0]
+    pad = -n % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    b = flat.reshape(-1, block)
+    scale = np.maximum(np.abs(b).max(axis=1), _EPS) / np.float32(127.0)
+    q = np.clip(np.rint(b / scale[:, None]), -127, 127).astype(np.int8)
+    return q.reshape(-1), scale.astype(np.float32)
+
+
+def _int8_decode(buf, n: int, block: int) -> np.ndarray:
+    if n <= 0:
+        return np.empty(0, np.float32)
+    nblocks = -(-n // block)
+    q = np.frombuffer(buf, np.int8, count=nblocks * block)
+    scale = np.frombuffer(buf, np.float32, count=nblocks,
+                          offset=nblocks * block)
+    # big-endian wire scales on a little-endian host: frombuffer with the
+    # explicit byte order
+    out = (q.reshape(-1, block).astype(np.float32)
+           * scale.reshape(-1, 1)).reshape(-1)
+    return out[:n]
+
+
+def enc_nbytes(n: int, wire: int, block: int, itemsize: int = 4) -> int:
+    """Encoded byte length of an ``n``-element span (deterministic: the
+    frame header carries the total payload length before any chunk is
+    encoded)."""
+    if wire == WIRE_FULL:
+        return n * itemsize
+    if wire == WIRE_BF16:
+        return n * 2
+    nblocks = -(-n // block) if n > 0 else 0
+    return nblocks * block + nblocks * 4
+
+
+def encode_span(x: np.ndarray, wire: int, block: int) -> List:
+    """Encode one contiguous span; returns a list of buffers (kept apart
+    for scatter-gather sends — no concat copy)."""
+    if wire == WIRE_FULL:
+        return [memoryview(np.ascontiguousarray(x).reshape(-1)).cast("B")]
+    if wire == WIRE_BF16:
+        return [memoryview(_bf16_encode(x)).cast("B")]
+    q, scale = _int8_encode(x, block)
+    return [memoryview(q).cast("B"), memoryview(scale).cast("B")]
+
+
+def decode_span(buf, n: int, wire: int, block: int,
+                logical_dtype=np.float32) -> np.ndarray:
+    """Inverse of :func:`encode_span` (``buf``: bytes-like of the encoded
+    span). Returns a 1-D array of ``n`` logical elements."""
+    if wire == WIRE_FULL:
+        return np.frombuffer(buf, np.dtype(logical_dtype), count=n)
+    if wire == WIRE_BF16:
+        return _bf16_decode(np.frombuffer(buf, np.uint16, count=n))
+    return _int8_decode(buf, n, block)
+
+
+def roundtrip(x: np.ndarray, wire: int, block: int) -> np.ndarray:
+    """decode(encode(x)) — the value a receiver reconstructs. Used by the
+    in-process path to honor ``parameterserver_wire_dtype`` (so a
+    single-process run exhibits the same exchange precision as the
+    socket transport: convergence evidence transfers) and by the delta
+    bookkeeping to track the client's exact reconstruction."""
+    if wire == WIRE_FULL:
+        return np.asarray(x, np.float32)
+    enc = b"".join(bytes(m) for m in encode_span(x, wire, block))
+    flat = decode_span(enc, int(np.asarray(x).size), wire, block)
+    return flat.reshape(np.asarray(x).shape)
+
+
+# ---------------------------------------------------------------------------
+# chunk container
+# ---------------------------------------------------------------------------
+
+
+def plan_chunks(n: int, wire: int, block: int, chunk_bytes: int,
+                itemsize: int = 4) -> List[Tuple[int, int]]:
+    """Split an ``n``-element payload into [(offset, nelem)] chunks whose
+    encoded size approximates ``chunk_bytes`` (block-aligned for int8 so
+    every chunk quantizes on its own grid). ``chunk_bytes <= 0`` or a
+    payload that fits one chunk yields a single chunk."""
+    if n <= 0:
+        return [(0, 0)]
+    if chunk_bytes <= 0:
+        return [(0, n)]
+    per_elem = max(1, enc_nbytes(block, wire, block, itemsize) // block)
+    elems = max(1, chunk_bytes // per_elem)
+    if wire == WIRE_INT8:
+        elems = max(block, (elems // block) * block)
+    if elems >= n:
+        return [(0, n)]
+    return [(off, min(elems, n - off)) for off in range(0, n, elems)]
+
+
+def container_nbytes(n: int, wire: int, block: int, chunk_bytes: int,
+                     itemsize: int = 4) -> Tuple[int, int]:
+    """(total payload bytes incl. chunk headers, nchunks) for the frame
+    header — computed before any chunk is encoded."""
+    chunks = plan_chunks(n, wire, block, chunk_bytes, itemsize)
+    total = sum(
+        CHUNK_HDR_SIZE + enc_nbytes(cn, wire, block, itemsize)
+        for _, cn in chunks
+    )
+    return total, len(chunks)
+
+
+def iter_encoded_chunks(
+    arr: np.ndarray, wire: int, block: int, chunk_bytes: int
+) -> Iterator[List]:
+    """Lazily yield per-chunk buffer lists ([hdr, enc...]) so the caller
+    interleaves encode with socket writes: quantize/serialize of chunk
+    k+1 overlaps the wire I/O of chunk k."""
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    n = flat.shape[0]
+    itemsize = flat.dtype.itemsize
+    for off, cn in plan_chunks(n, wire, block, chunk_bytes, itemsize):
+        enc = encode_span(flat[off:off + cn], wire, block)
+        hdr = _CHUNK_HDR.pack(
+            off, n, cn, enc_nbytes(cn, wire, block, itemsize), block
+        )
+        yield [hdr] + enc
+
+
+def read_chunk_header(buf) -> Tuple[int, int, int, int, int]:
+    """(off, total, nelem, enc_nbytes, block) from a chunk header blob."""
+    return _CHUNK_HDR.unpack_from(buf, 0)
+
+
+def encode_frame_payload(
+    arr: np.ndarray, wire: int, block: int, chunk_bytes: int
+) -> Tuple[List, int, int]:
+    """Materialize a whole chunk container: (flat buffer list for
+    scatter-gather send, total byte length, nchunks). Used where the
+    encode happens away from the socket (trigger replies built on the
+    server thread so delta bookkeeping can record the exact encoded
+    reconstruction)."""
+    parts: List = []
+    nchunks = 0
+    total = 0
+    for bufs in iter_encoded_chunks(arr, wire, block, chunk_bytes):
+        nchunks += 1
+        for b in bufs:
+            total += len(memoryview(b).cast("B"))
+        parts.extend(bufs)
+    return parts, total, nchunks
+
+
+def decode_parts(parts: List, wire: int,
+                 logical_dtype=np.float32) -> np.ndarray:
+    """Decode a buffer list produced by :func:`encode_frame_payload`
+    back to the logical array — the receiver-side reconstruction, used
+    by the delta bookkeeping to track what the client now holds (so the
+    next delta is computed against the client's EXACT state and
+    quantization error never compounds across fetches)."""
+    out = None
+    i = 0
+    while i < len(parts):
+        off, total, cn, nb, block = read_chunk_header(parts[i])
+        i += 1
+        if out is None:
+            out = np.empty(total, np.dtype(logical_dtype))
+        if wire == WIRE_INT8:
+            q = np.frombuffer(parts[i], np.int8)
+            scale = np.frombuffer(parts[i + 1], np.float32)
+            i += 2
+            dec = (q.reshape(-1, block).astype(np.float32)
+                   * scale.reshape(-1, 1)).reshape(-1)[:cn]
+        else:
+            dec = decode_span(parts[i], cn, wire, block, logical_dtype)
+            i += 1
+        out[off:off + cn] = dec
+    return out if out is not None else np.empty(0, np.dtype(logical_dtype))
+
+
+def decode_container(payload, nchunks: int, wire: int,
+                     logical_dtype=np.float32) -> np.ndarray:
+    """Decode a fully-materialized chunk container (used for payloads
+    that arrived as one blob, e.g. multi-frame items); the streaming
+    receive path in ``transport._read_payload`` decodes chunk-by-chunk
+    instead. ``wire`` is the frame header's wire byte (authoritative for
+    every chunk; the per-chunk block size still comes from each chunk
+    header). ``nchunks`` is advisory — the container is self-describing
+    and is consumed to exhaustion."""
+    mv = memoryview(payload)
+    out = None
+    pos = 0
+    end = len(mv)
+    while pos < end:
+        off, total, cn, nb, block = read_chunk_header(mv[pos:])
+        pos += CHUNK_HDR_SIZE
+        if out is None:
+            out = np.empty(total, np.dtype(logical_dtype))
+        out[off:off + cn] = decode_span(
+            mv[pos:pos + nb], cn, wire, block, logical_dtype
+        )
+        pos += nb
+    return out if out is not None else np.empty(0, np.dtype(logical_dtype))
+
+
+__all__ = [
+    "WIRE_FULL",
+    "WIRE_BF16",
+    "WIRE_INT8",
+    "WIRE_NAMES",
+    "WIRE_CODES",
+    "CHUNK_HDR_SIZE",
+    "wire_code",
+    "resolve_ps_wire",
+    "enc_nbytes",
+    "encode_span",
+    "decode_span",
+    "roundtrip",
+    "plan_chunks",
+    "container_nbytes",
+    "iter_encoded_chunks",
+    "read_chunk_header",
+    "decode_container",
+    "encode_frame_payload",
+    "decode_parts",
+]

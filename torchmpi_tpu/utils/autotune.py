@@ -63,6 +63,7 @@ _TUNABLE = (
     "ring_implementation",
     "wire_dtype",
     "fusion_buffer_bytes",
+    "ps_chunk_bytes",
 )
 
 #: canonical LeNet gradient leaf element counts (conv1 w/b, conv2 w/b,
@@ -423,6 +424,70 @@ def tune_fusion_threshold(
     return int(best[1]), results
 
 
+def tune_ps_chunk_bytes(
+    comm: Optional[Communicator] = None,
+    nelem: int = 1 << 18,
+    candidates: Tuple[int, ...] = (0, 1 << 16, 1 << 18, 1 << 20),
+    warmup: int = 2,
+    timed: int = 5,
+    apply: bool = True,
+) -> Tuple[int, List]:
+    """Measure the PS transport's shard round trip (UPDATE + TRIGGER of an
+    ``nelem``-element f32 payload over a real loopback listener/channel —
+    the full frame/mailbox/apply path) under candidate ``ps_chunk_bytes``
+    values, including 0 (monolithic frames), and set the constant to the
+    fastest. The chunk pipeline must EARN its framing overhead: on a
+    loopback-fast fabric the monolithic frame can win, on a real DCN the
+    encode/wire/decode overlap does — measured here, persisted per
+    (platform, world size) like every other knob, re-applied by
+    ``start()``.
+
+    Requires unfrozen constants even with ``apply=False``: each candidate
+    is measured by temporarily setting ``ps_chunk_bytes``."""
+    import time as _time
+
+    comm = _comm(comm)
+    _check_unfrozen(apply, measure_mutates=True)
+    import numpy as np
+
+    from ..parameterserver import transport as T
+    from ..parameterserver.server import _server
+
+    inst = _server.register(np.zeros(nelem, np.float32), 1)
+    lst = T._Listener(lambda i: inst if i == inst.id else None)
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    prev = constants.get("ps_chunk_bytes")
+    x = np.random.default_rng(0).standard_normal(nelem).astype(np.float32)
+    results: List = []
+    best = (float("inf"), prev)
+    try:
+        for cand in candidates:
+            constants.set("ps_chunk_bytes", int(cand))
+            laps = []
+            for it in range(warmup + timed):
+                t0 = _time.perf_counter()
+                ch.request(
+                    T._KIND_UPDATE, inst.id, 0, 0, rule="copy",
+                    payload_arr=x,
+                )
+                ch.request(T._KIND_TRIGGER, inst.id, 0, 0)
+                if it >= warmup:
+                    laps.append(_time.perf_counter() - t0)
+            mean_us = 1e6 * sum(laps) / max(1, len(laps))
+            results.append((int(cand), mean_us))
+            if mean_us < best[0]:
+                best = (mean_us, int(cand))
+    finally:
+        constants.set("ps_chunk_bytes", prev)
+        ch.close()
+        lst.close()
+        _server.unregister(inst)
+    if apply:
+        constants.set("ps_chunk_bytes", int(best[1]))
+    _audit_decision("ps_chunk_bytes", int(best[1]), apply, results)
+    return int(best[1]), results
+
+
 def tune_all(
     comm: Optional[Communicator] = None,
     quick: bool = True,
@@ -453,6 +518,9 @@ def tune_all(
     out["wire_dtype"] = tune_wire_dtype(comm, nelem=big, apply=apply)[0]
     out["fusion_buffer_bytes"] = tune_fusion_threshold(
         comm, timed=3 if quick else 5, apply=apply
+    )[0]
+    out["ps_chunk_bytes"] = tune_ps_chunk_bytes(
+        comm, nelem=big, timed=3 if quick else 5, apply=apply
     )[0]
     if apply and persist:
         save_tuning(comm)
