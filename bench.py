@@ -852,6 +852,30 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     warm_unfused_s = float(np.median([unfused_pass() for _ in range(iters)]))
     warm_fused_s = float(np.median([fused_pass() for _ in range(iters)]))
 
+    # flight-recorder + watchdog overhead on the dispatch path: baseline
+    # laps with ALL telemetry off vs laps with ONLY the recorder forced on
+    # and the watchdog beating (metrics/spans stay off — this isolates the
+    # new subsystem, not the span machinery measured elsewhere). Laps are
+    # interleaved so clock drift hits both sides equally, and min-of-laps
+    # is compared (systematic per-dispatch cost survives the min; noise
+    # does not).
+    from torchmpi_tpu.telemetry import flightrecorder as flight
+    from torchmpi_tpu.telemetry.watchdog import start_watchdog, stop_watchdog
+
+    start_watchdog(timeout=600.0, interval=0.25, heartbeat_dir=None)
+    off_laps, on_laps = [], []
+    for _ in range(iters):
+        telemetry.disable()
+        flight.disable()
+        off_laps.append(unfused_pass() + fused_pass())
+        flight.enable()
+        on_laps.append(unfused_pass() + fused_pass())
+    stop_watchdog()
+    flight.disable()
+    telemetry.enable()
+    off_s, on_s = min(off_laps), min(on_laps)
+    recorder_overhead_pct = (on_s - off_s) / max(off_s, 1e-12) * 100.0
+
     # AOT: precompile the declared specs, then a full pass must not
     # compile anything (the telemetry miss counter is the assertion)
     eager.free_collective_resources(comm)
@@ -884,16 +908,22 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
         ),
         "compiles_after_precompile": compiles_after,
         "fusion_buffer_bytes": constants.get("fusion_buffer_bytes"),
+        "recorder_overhead_pct": round(recorder_overhead_pct, 3),
+        "recorder_off_ms": round(off_s * 1e3, 4),
+        "recorder_on_ms": round(on_s * 1e3, 4),
     }
     print(json.dumps(line), flush=True)
     mpi.stop()
     if check:
-        ok = fused_us <= unfused_us and compiles_after == 0
+        overhead_ok = recorder_overhead_pct < 2.0
+        ok = fused_us <= unfused_us and compiles_after == 0 and overhead_ok
         if not ok:
             print(
                 f"# perf-smoke FAILED: fused {fused_us:.1f}us vs unfused "
                 f"{unfused_us:.1f}us per tensor, "
-                f"{compiles_after} post-precompile compiles",
+                f"{compiles_after} post-precompile compiles, "
+                f"recorder+watchdog overhead {recorder_overhead_pct:.2f}% "
+                "(budget 2%)",
                 file=sys.stderr,
                 flush=True,
             )

@@ -32,6 +32,11 @@ run_perf_smoke() {
     # encoding's error bound. Pure host path — no jax backend.
     echo "=== perf-smoke (parameter-server wire microbench, CPU) ==="
     python bench.py --ps-microbench --check
+    # flight-recorder/analyzer smoke: a short 2-proc job with telemetry on
+    # must yield a merged per-rank Perfetto trace and a clean
+    # `desync: none` analyzer report.
+    echo "=== telemetry smoke (2-proc flight recorder + analyzer) ==="
+    python scripts/telemetry_smoke.py
 }
 
 run_slow_a() {

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""CI smoke for the distributed flight recorder + cross-rank analyzer.
+
+Runs a short 2-process job through ``python -m torchmpi_tpu.launch
+--telemetry-dir`` (each rank issues an identical eager-collective
+sequence), then runs ``python -m torchmpi_tpu.telemetry.analyze`` on the
+dumps and asserts:
+
+- a single merged Perfetto-loadable trace with one track per rank exists;
+- the report parses and says ``desync: none`` (identical streams);
+- per-rank flight entries and clock-sync records made it into the dumps.
+
+The ranks deliberately do NOT form a jax.distributed world: the analyzer
+path under test is host-side, and single-core CI boxes (and jax builds
+without cross-process CPU collectives) must still exercise it. Exits
+non-zero on any failed assertion — wired into ``scripts/ci.sh fast``.
+"""
+
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+# this smoke tests the host-side flight/analyzer path: keep each rank a
+# single-process jax runtime (cross-process CPU collectives are not
+# available on every jax build the CI runs against)
+os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+import numpy as np
+import jax
+import torchmpi_tpu as mpi
+
+mpi.start()
+p = mpi.current_communicator().size
+for i in range(3):
+    mpi.allreduce_tensor(np.ones((p, 32), np.float32))
+mpi.broadcast_tensor(np.ones((p, 16), np.float32), root=0)
+mpi.stop()
+print("smoke rank ok", flush=True)
+"""
+
+
+def main() -> int:
+    tmp = Path(tempfile.mkdtemp(prefix="tm_tel_smoke_"))
+    worker = tmp / "worker.py"
+    worker.write_text(WORKER.format(repo=str(REPO)))
+    tel = tmp / "tel"
+
+    launch = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.launch",
+         "--nproc", "2", "--cpu-devices", "2",
+         "--telemetry-dir", str(tel), str(worker)],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=300,
+    )
+    if launch.returncode != 0:
+        print(launch.stdout[-3000:])
+        print("telemetry smoke FAILED: launch rc != 0", file=sys.stderr)
+        return 1
+
+    analyze = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.telemetry.analyze", str(tel),
+         "--strict"],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    print(analyze.stdout, end="")
+    ok = analyze.returncode == 0 and "desync: none" in analyze.stdout
+
+    trace_path = tel / "merged.trace.json"
+    report_path = tel / "analysis.json"
+    if not (trace_path.exists() and report_path.exists()):
+        print("telemetry smoke FAILED: analyzer outputs missing",
+              file=sys.stderr)
+        return 1
+    trace = json.loads(trace_path.read_text())
+    tracks = {
+        ev["pid"] for ev in trace["traceEvents"]
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    report = json.loads(report_path.read_text())
+    checks = {
+        "analyzer clean (desync: none, rc 0)": ok,
+        "two rank tracks in merged trace": tracks == {0, 1},
+        "report lists both ranks": report["ranks"] == [0, 1],
+        "flight streams compared": bool(report["desync"]["comms"]),
+        "no hangs": not report["hangs"],
+    }
+    failed = [name for name, passed in checks.items() if not passed]
+    for name, passed in checks.items():
+        print(f"  [{'ok' if passed else 'FAIL'}] {name}")
+    if failed:
+        print(f"telemetry smoke FAILED: {failed}", file=sys.stderr)
+        return 1
+    print("telemetry smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -652,6 +652,253 @@ def test_launcher_elastic_restart_resumes_from_checkpoint(tmp_path):
     assert "elastic rank 1 resumed OK" in proc.stdout
 
 
+# ---------------------------------------------------------------------------
+# distributed flight recorder: cross-rank desync / straggler / hang diagnosis
+# (ISSUE 6). The workers drop the launcher's coordinator env on purpose:
+# the path under test is the per-rank flight stream -> dump -> offline
+# analyzer correlation, which must work even on jax builds without
+# cross-process CPU collectives (each rank's eager collectives run on its
+# own local devices; the comm *name+size* is what cross-rank diffing keys
+# on).
+# ---------------------------------------------------------------------------
+
+_DESYNC_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+    pid = int(os.environ["TORCHMPI_TPU_PROCESS_ID"])
+    import numpy as np
+    import torchmpi_tpu as mpi
+
+    mpi.start()
+    p = mpi.size()
+    # seqs 0..2: identical streams on every rank
+    for i in range(3):
+        mpi.allreduce_tensor(np.ones((p, 32), np.float32))
+    # seq 3: rank 1 issues a DIFFERENT collective -> the seeded desync
+    if pid == 1:
+        mpi.broadcast_tensor(np.ones((p, 32), np.float32), root=0)
+    else:
+        mpi.allreduce_tensor(np.ones((p, 32), np.float32))
+    mpi.stop()
+    print(f"desync rank {{pid}} ok")
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_analyzer_names_first_divergent_seq_on_seeded_desync(tmp_path):
+    """A 2-process run with a deliberately desynced collective sequence
+    must produce an analyzer report naming the first divergent seq and
+    op (the GC3 schedule-as-data payoff: desync is a diff)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_DESYNC_WORKER)
+    tel = tmp_path / "tel"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "2",
+            "--telemetry-dir", str(tel), str(worker),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    analyze = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.telemetry.analyze",
+            str(tel),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    assert analyze.returncode == 0, analyze.stdout[-2000:]
+    assert "first divergent seq=3" in analyze.stdout, analyze.stdout
+    import json
+
+    report = json.loads((tel / "analysis.json").read_text())
+    div = report["desync"]["first_divergence"]
+    assert div["seq"] == 3
+    assert sorted(div["ops"].values()) == ["allreduce", "broadcast"]
+    assert div["ops"]["1"] == "broadcast"
+    # the merged trace carries one track per rank
+    trace = json.loads((tel / "merged.trace.json").read_text())
+    tracks = {
+        ev["pid"] for ev in trace["traceEvents"]
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    assert tracks == {0, 1}
+
+
+_STRAGGLER_WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+    pid = int(os.environ["TORCHMPI_TPU_PROCESS_ID"])
+    import numpy as np
+    import torchmpi_tpu as mpi
+
+    mpi.start()
+    p = mpi.size()
+    for i in range(5):
+        if pid == 1:
+            time.sleep(0.15)   # the injected straggler
+        mpi.allreduce_tensor(np.ones((p, 64), np.float32))
+    mpi.stop()
+    print(f"straggler rank {{pid}} ok")
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_analyzer_ranks_injected_straggler_worst(tmp_path):
+    """A sleep injected on rank 1 before every collective must rank rank
+    1 worst in the analyzer's issue-time-spread straggler report."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_STRAGGLER_WORKER)
+    tel = tmp_path / "tel"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "2",
+            "--telemetry-dir", str(tel), str(worker),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    import json
+
+    analyze = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.telemetry.analyze",
+            str(tel),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    assert analyze.returncode == 0, analyze.stdout[-2000:]
+    assert "straggler: rank 1" in analyze.stdout, analyze.stdout
+    report = json.loads((tel / "analysis.json").read_text())
+    st = report["stragglers"]
+    assert st["worst"] == 1 and st["significant"]
+    # mean lag must reflect the injected sleep (>= ~half of 150ms even
+    # with scheduling noise), and rank 1 is last into every collective
+    assert st["ranking"][0]["rank"] == 1
+    assert st["ranking"][0]["mean_lag_ms"] > 75.0
+    assert st["ranking"][0]["last_count"] >= 4
+
+
+_HANG_WORKER = textwrap.dedent(
+    """
+    import json, os, socket, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+    import torchmpi_tpu  # arms telemetry dump + watchdog env wiring
+    pid = int(os.environ["TORCHMPI_TPU_PROCESS_ID"])
+    teldir = sys.argv[1]
+    port_file = os.path.join(teldir, "mute_port")
+    done_file = os.path.join(teldir, "hang_seen")
+
+    if pid == 1:
+        # the MUTE parameter server: accepts, reads, never replies — and
+        # never issues a matching RPC itself (the rank that "never
+        # entered")
+        srv = socket.socket()
+        srv.bind(("localhost", 0))
+        srv.listen(1)
+        with open(port_file + ".tmp", "w") as f:
+            f.write(str(srv.getsockname()[1]))
+        os.replace(port_file + ".tmp", port_file)
+
+        def serve():
+            try:
+                conn, _ = srv.accept()
+                while conn.recv(65536):
+                    pass
+            except OSError:
+                pass
+
+        threading.Thread(target=serve, daemon=True).start()
+        deadline = time.time() + 120
+        while not os.path.exists(done_file) and time.time() < deadline:
+            time.sleep(0.2)
+        srv.close()
+        print("hang rank 1 ok")
+        sys.exit(0)
+
+    # rank 0: a REAL transport channel into the mute server; the RPC's
+    # flight entry stays 'issued' and the env-armed watchdog must fire
+    from torchmpi_tpu.parameterserver import transport as tr
+
+    deadline = time.time() + 120
+    while not os.path.exists(port_file) and time.time() < deadline:
+        time.sleep(0.1)
+    port = int(open(port_file).read())
+    ch = tr._PeerChannel({{1: ("localhost", port)}}, proc=1)
+    ch.submit(tr._KIND_TRIGGER, inst=0, rank=0, client=0)
+    hang_file = os.path.join(teldir, "hang_rank_0.json")
+    while not os.path.exists(hang_file) and time.time() < deadline:
+        time.sleep(0.2)
+    assert os.path.exists(hang_file), "watchdog never fired"
+    with open(done_file, "w") as f:
+        f.write("1")
+    ch.close()
+    print("hang rank 0 ok")
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_watchdog_fires_and_dumps_on_induced_ps_hang(tmp_path):
+    """--watchdog-timeout arms every rank; an induced PS hang (a server
+    that accepts but never replies) must produce a hang report naming
+    the stuck RPC, and the analyzer must identify the rank that never
+    entered it."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_HANG_WORKER)
+    tel = tmp_path / "tel"
+    tel.mkdir()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "1",
+            "--telemetry-dir", str(tel), "--watchdog-timeout", "2",
+            str(worker), "--", str(tel),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    import json
+
+    hang = json.loads((tel / "hang_rank_0.json").read_text())
+    assert hang["reason"] == "in_flight_timeout"
+    stuck = hang["detail"]["stuck"]
+    assert any(
+        s["comm"] == "ps:1" and s["op"] == "trigger"
+        and s["status"] == "issued"
+        for s in stuck
+    ), stuck
+    assert hang["threads"]  # all-thread stacks in the report
+    analyze = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.telemetry.analyze",
+            str(tel),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    assert analyze.returncode == 0, analyze.stdout[-2000:]
+    assert "stuck in trigger" in analyze.stdout, analyze.stdout
+    report = json.loads((tel / "analysis.json").read_text())
+    diag = report["hangs"][0]["stuck_collectives"][0]
+    assert diag["stuck"]["op"] == "trigger"
+    assert 1 in diag["ranks_never_entered"]
+
+
 @pytest.mark.slow
 def test_launcher_max_restarts_budget_exhausted(tmp_path):
     """A rank that keeps dying exhausts the restart budget and the

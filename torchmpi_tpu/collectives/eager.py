@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import constants, telemetry as _telemetry
 from ..runtime.communicator import Communicator
 from ..runtime.handles import SyncHandle
+from ..telemetry import flightrecorder as _flight
 from . import primitives as prim
 
 _AXIS = "mpi"
@@ -81,20 +82,45 @@ def _metric_handles():
 
 
 def _dispatch(fn, x, op: str, backend: str, wire: str, nelem: int,
-              cache_hit: Optional[bool]):
+              cache_hit: Optional[bool], comm: Optional[Communicator] = None,
+              payload=None, routing: str = ""):
     """Run ``fn(x)`` (a compiled eager executable, or a composition like
     the staged allreduce), recording the dispatch (span + metrics) when
-    telemetry is enabled; one branch when disabled. ``cache_hit=None``
-    means no single executable cache applies (multi-phase compositions)."""
+    telemetry is enabled, plus a flight-recorder entry (per-comm seq, op,
+    payload, issue/complete stamps) when the recorder is on; one branch
+    each when disabled. ``cache_hit=None`` means no single executable
+    cache applies (multi-phase compositions). ``payload`` is the raw
+    (shape, dtype) pair — stringified only at snapshot time."""
+    entry = None
+    if _flight.enabled() and comm is not None:
+        entry = _flight.recorder.record(
+            _flight.comm_key(comm), op, payload=payload, wire=wire,
+            backend=backend, routing=routing,
+        )
     if not _telemetry.enabled():
-        return fn(x)
+        if entry is None:
+            return fn(x)
+        try:
+            out = fn(x)
+        except BaseException:
+            _flight.FlightRecorder.fail(entry)
+            raise
+        _flight.FlightRecorder.complete(entry)
+        return out
     calls, lat, compiles, hits = _metric_handles()
     attrs = {"backend": backend, "wire_dtype": wire, "nelem": nelem}
     if cache_hit is not None:
         attrs["cache"] = "hit" if cache_hit else "miss"
     t0 = time.perf_counter()
-    with _telemetry.span(f"collective.{op}", **attrs):
-        out = fn(x)
+    try:
+        with _telemetry.span(f"collective.{op}", **attrs):
+            out = fn(x)
+    except BaseException:
+        if entry is not None:
+            _flight.FlightRecorder.fail(entry)
+        raise
+    if entry is not None:
+        _flight.FlightRecorder.complete(entry)
     calls.inc(op=op, backend=backend, wire=wire)
     lat.observe(time.perf_counter() - t0, op=op, backend=backend)
     if cache_hit is not None:
@@ -589,7 +615,9 @@ def run(
         sharding = _rank_sharding(comm, x.ndim)
         if getattr(x, "sharding", None) != sharding:
             x = jax.device_put(x, sharding)
-        return _dispatch(fn, x, op, effective, wire, nelem, True)
+        return _dispatch(fn, x, op, effective, wire, nelem, True,
+                         comm=comm, payload=(x.shape, x.dtype),
+                         routing="flat")
     platform = comm._devices[0].platform
     effective = backend
     if backend in ("ring", "pallas") and route_small:
@@ -705,7 +733,8 @@ def run(
     sharding = _rank_sharding(comm, x.ndim)
     if getattr(x, "sharding", None) != sharding:
         x = jax.device_put(x, sharding)
-    return _dispatch(fn, x, op, effective, wire, _nelem_per_rank(x), hit)
+    return _dispatch(fn, x, op, effective, wire, _nelem_per_rank(x), hit,
+                     comm=comm, payload=(x.shape, x.dtype), routing="flat")
 
 
 def run_fused(
@@ -758,7 +787,8 @@ def run_fused(
         if effective in ("ring", "pallas"):
             _record_wire(op, total, dtype, wire)
         return _dispatch(
-            lambda args: fn(*args), flats, op, effective, wire, total, True
+            lambda args: fn(*args), flats, op, effective, wire, total, True,
+            comm=comm, payload=(ns, dtype), routing="fused",
         )
     platform = comm._devices[0].platform
     effective = backend
@@ -834,7 +864,8 @@ def run_fused(
         cache[key] = fn
     memo[fkey] = (constants.generation(), fn, effective, wire)
     return _dispatch(
-        lambda args: fn(*args), flats, op, effective, wire, total, hit
+        lambda args: fn(*args), flats, op, effective, wire, total, hit,
+        comm=comm, payload=(ns, dtype), routing="fused",
     )
 
 
@@ -920,7 +951,8 @@ def run_allgatherv(blocks, comm: Communicator, backend: str = "xla"):
     if getattr(padded, "sharding", None) != sharding:
         padded = jax.device_put(padded, sharding)
     return _dispatch(
-        fn, padded, "allgatherv", backend, "full", int(sum(sizes)), hit
+        fn, padded, "allgatherv", backend, "full", int(sum(sizes)), hit,
+        comm=comm, payload=(sizes, dtype), routing="flat",
     )
 
 
@@ -1082,6 +1114,7 @@ def run_hierarchical_allreduce(
             ),
             x, "staged_allreduce", staged_intra, wire,
             _nelem_per_rank(x), None,
+            comm=comm, payload=(x.shape, x.dtype), routing="staged",
         )
     donate = constants.get("donate_eager_buffers")
     tuning = (
@@ -1141,7 +1174,8 @@ def run_hierarchical_allreduce(
 
     fn, hit = _hier_compile(comm, key, x.ndim, donate, kernel)
     return _dispatch(
-        fn, x, "hier_allreduce", impl, wire, _nelem_per_rank(x), hit
+        fn, x, "hier_allreduce", impl, wire, _nelem_per_rank(x), hit,
+        comm=comm, payload=(x.shape, x.dtype), routing="hier",
     )
 
 
@@ -1456,7 +1490,8 @@ def run_hierarchical_collective(
 
     fn, hit = _hier_compile(comm, key, x.ndim, donate, kernel, post)
     return _dispatch(
-        fn, x, f"hier_{op}", ring_impl, "full", _nelem_per_rank(x), hit
+        fn, x, f"hier_{op}", ring_impl, "full", _nelem_per_rank(x), hit,
+        comm=comm, payload=(x.shape, x.dtype), routing="hier",
     )
 
 
@@ -1560,7 +1595,8 @@ def run_tree_hierarchical_allreduce(x, comm: Communicator,
         fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
         cache[key] = fn
     return _dispatch(
-        fn, x, "tree_hier_allreduce", "ring", wire, _nelem_per_rank(x), hit
+        fn, x, "tree_hier_allreduce", "ring", wire, _nelem_per_rank(x), hit,
+        comm=comm, payload=(x.shape, x.dtype), routing="tree",
     )
 
 

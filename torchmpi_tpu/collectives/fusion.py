@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from .. import constants, telemetry as _telemetry
 from ..runtime.communicator import Communicator
 from ..runtime.handles import SyncHandle, handles
+from ..telemetry import flightrecorder as _flight
 from . import eager
 
 # ops the fusion layer understands; everything else passes through
@@ -280,6 +281,18 @@ class FusionBuffer:
         if telemetry_on:
             _, flushes, lat = _metric_handles()
             flushes.inc(op=group.op, reason=reason)
+        flight_entry = None
+        if _flight.enabled():
+            # the flush event itself joins the comm's flight stream (the
+            # dispatch it triggers records separately via eager): a
+            # cross-rank layout mismatch here IS a desync even when the
+            # per-tensor dispatches happen to agree
+            flight_entry = _flight.recorder.record(
+                _flight.comm_key(self.comm), f"fusion.{group.op}",
+                payload=(tuple(n for n, _ in group.segments), group.dtype),
+                wire=group.wire or "auto", backend=group.backend or "auto",
+                routing=reason,
+            )
         if len(group.segments) < max(1, constants.get("fusion_min_tensors")):
             # packing below the threshold costs more than it saves:
             # dispatch each tensor individually (handles index into the
@@ -287,13 +300,21 @@ class FusionBuffer:
             self._count_tensor(
                 group.op, group.wire, "unfused", len(group.segments)
             )
-            group._results = [
-                self._dispatch_unfused(
-                    group.op, flat.reshape(shape), group.wire, group.backend
-                )
-                for flat, (_, shape) in zip(group.flats, group.segments)
-            ]
+            try:
+                group._results = [
+                    self._dispatch_unfused(
+                        group.op, flat.reshape(shape), group.wire,
+                        group.backend
+                    )
+                    for flat, (_, shape) in zip(group.flats, group.segments)
+                ]
+            except BaseException:
+                if flight_entry is not None:
+                    _flight.FlightRecorder.fail(flight_entry)
+                raise
             group.flats = []
+            if flight_entry is not None:
+                _flight.FlightRecorder.complete(flight_entry)
             return
         self._count_tensor(
             group.op, group.wire, "fused", len(group.segments)
@@ -302,6 +323,21 @@ class FusionBuffer:
         ns = tuple(n for n, _ in group.segments)
         from . import _dispatch as _ns_dispatch
 
+        try:
+            out = self._dispatch_fused(group, ns, _ns_dispatch)
+        except BaseException:
+            if flight_entry is not None:
+                _flight.FlightRecorder.fail(flight_entry)
+            raise
+        if flight_entry is not None:
+            _flight.FlightRecorder.complete(flight_entry)
+        if telemetry_on:
+            lat.observe(time.perf_counter() - t0, op=group.op, path="fused")
+        group._fused_buf = (
+            out.reshape(self.comm.size, -1) if out.ndim != 2 else out
+        )
+
+    def _dispatch_fused(self, group: _PendingGroup, ns, _ns_dispatch):
         if group.op == "reducescatter":
             # interleave so rank r's scattered block holds every tensor's
             # r-th chunk: [p, n_i] -> [p, p, n_i/p], concat chunk axes,
@@ -325,11 +361,7 @@ class FusionBuffer:
                 group.op, flats, self.comm, "fused", group.backend,
                 wire_dtype=group.wire,
             )
-        if telemetry_on:
-            lat.observe(time.perf_counter() - t0, op=group.op, path="fused")
-        group._fused_buf = (
-            out.reshape(self.comm.size, -1) if out.ndim != 2 else out
-        )
+        return out
 
 
 def get_fusion_buffer(comm: Optional[Communicator] = None) -> FusionBuffer:

@@ -151,6 +151,17 @@ class _Constants:
     # (shard, client) pair server-side.
     parameterserver_delta_encoding: bool = False
 
+    # --- distributed flight recorder / hang watchdog ---
+    # Seconds a collective dispatch or PS RPC may stay in flight (or a
+    # peer's heartbeat stay stale) before the watchdog dumps a structured
+    # hang report (flight recorder + spans + metrics + all-thread stacks)
+    # to the telemetry dir. 0 disables. start() arms the watchdog when
+    # set; `launch --watchdog-timeout N` arms it per rank via the
+    # TORCHMPI_TPU_WATCHDOG env var instead (pre-start() coverage).
+    watchdog_timeout_seconds: int = 0
+    # Watchdog poll + heartbeat-file period, in seconds.
+    watchdog_interval_seconds: int = 1
+
     # --- coalescing dispatch (latency path; GC3-style fused plans) ---
     # Capacity of the flat fusion buffer: pending same-(op, dtype, comm,
     # wire) async collectives pack into one contiguous buffer and flush

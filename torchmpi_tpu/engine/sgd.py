@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import nn as mpinn, telemetry as _telemetry
 from ..nn import GradientBuckets
 from ..runtime.communicator import Communicator
+from ..telemetry import flightrecorder as _flight
 
 _AXIS = "mpi"
 
@@ -600,6 +601,18 @@ class AllReduceSGDEngine:
             t0 * 1e6, dt * 1e6,
             {"examples": examples, "steps": steps},
         )
+        if _flight.enabled():
+            # step events join the comm's flight stream (wall-clock
+            # stamps): per-seq issue-time spread across ranks is the
+            # analyzer's engine-level straggler signal
+            wall_t1 = time.time()
+            _flight.recorder.record_complete(
+                _flight.comm_key(self.comm),
+                "engine.epoch" if epoch else "engine.step",
+                wall_t1 - dt, wall_t1,
+                payload=f"examples={examples},steps={steps}",
+                routing=self.mode,
+            )
 
     # ------------------------------------------------------------------
     # AOT warm-up (the latency path): declare the collectives and compile

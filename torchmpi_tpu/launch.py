@@ -62,7 +62,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--telemetry-dir", default=None,
                     help="enable telemetry in every rank and dump a "
                     "per-rank metrics snapshot + Perfetto trace JSON "
-                    "(telemetry_rank_<i>.json / .trace.json) there on exit")
+                    "(telemetry_rank_<i>.json / .trace.json) there on exit "
+                    "— including abnormal exit (SIGTERM/SIGINT/fault "
+                    "handlers); feed the dir to "
+                    "`python -m torchmpi_tpu.telemetry.analyze`")
+    ap.add_argument("--watchdog-timeout", type=float, default=0,
+                    help="arm the per-rank hang watchdog: a collective or "
+                    "PS RPC in flight (or a peer heartbeat stale) longer "
+                    "than this many seconds dumps a structured hang report "
+                    "(hang_rank_<i>.json, in --telemetry-dir when set)")
     ap.add_argument("--nnodes", type=int, default=1,
                     help="total hosts in the job")
     ap.add_argument("--node-rank", type=int, default=0,
@@ -103,6 +111,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # a restart needs a fresh coordinator port and a synchronized
         # world relaunch; across hosts that coordination does not exist
         ap.error("--max-restarts requires a single-node job (nnodes == 1)")
+    if args.watchdog_timeout < 0:
+        ap.error(
+            f"--watchdog-timeout must be >= 0, got {args.watchdog_timeout}"
+        )
 
     target = (
         [sys.executable, "-m", args.module]
@@ -154,6 +166,15 @@ def _run_world(args, target, extra, restart: int) -> int:
     telemetry_dir = Path(args.telemetry_dir) if args.telemetry_dir else None
     if telemetry_dir is not None:
         telemetry_dir.mkdir(parents=True, exist_ok=True)
+        # clear liveness/hang artifacts from a previous attempt or a
+        # reused dir: a SIGKILL'd rank never retracts its heartbeat, and
+        # a leftover hang report would read as THIS run's diagnosis
+        for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json"):
+            for stale in telemetry_dir.glob(pattern):
+                try:
+                    stale.unlink()
+                except OSError:
+                    pass
     for i in range(args.nproc):
         rank = base + i
         env = dict(
@@ -173,6 +194,10 @@ def _run_world(args, target, extra, restart: int) -> int:
             )
             env["TORCHMPI_TPU_TELEMETRY"] = "1"
             env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(telemetry_dir / tname)
+        if args.watchdog_timeout:
+            # armed at telemetry import in the rank (pre-start coverage);
+            # heartbeats + hang reports land beside the telemetry dumps
+            env["TORCHMPI_TPU_WATCHDOG"] = str(args.watchdog_timeout)
         if args.cpu_devices:
             env["XLA_FLAGS"] = (
                 env.get("XLA_FLAGS", "")

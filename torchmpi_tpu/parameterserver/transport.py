@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from .. import constants, telemetry as _telemetry
+from ..telemetry import flightrecorder as _flight
 from . import wire as _wire
 
 _MAGIC = 0x7E5B
@@ -1044,7 +1045,7 @@ class _Waiter:
     original order — and the completion slot. ``t0``/``kind`` are
     telemetry fields (set only when telemetry is enabled)."""
 
-    __slots__ = ("event", "frame", "reply", "error", "t0", "kind")
+    __slots__ = ("event", "frame", "reply", "error", "t0", "kind", "flight")
 
     def __init__(self, frame: _Buffers):
         self.event = threading.Event()
@@ -1053,6 +1054,9 @@ class _Waiter:
         self.error: Optional[Exception] = None
         self.t0: Optional[float] = None
         self.kind: int = 0
+        # flight-recorder entry for this RPC (set only when the recorder
+        # is on); completed/failed by complete()
+        self.flight: Optional[list] = None
 
 
 class _PeerChannel:
@@ -1346,6 +1350,21 @@ class _PeerChannel:
                         nchunks, kind=_KIND_NAMES.get(kind, str(kind))
                     )
             sock = self._connected_locked()  # raises if unreachable
+            if _flight.enabled():
+                # recorded only once the channel is live (a connect
+                # failure raises out of submit — no RPC ever existed, so
+                # no entry may be left 'issued' for the watchdog to flag);
+                # the entry reuses the wire seq (per-peer monotone), so a
+                # recorder line maps 1:1 to the frame on the wire; stuck
+                # at 'issued' past the watchdog timeout = the hang signal
+                w.flight = _flight.recorder.record(
+                    f"ps:{self.proc}", _KIND_NAMES.get(kind, str(kind)),
+                    payload=f"{total_len}B:{dtype_str or 'raw'}",
+                    wire=_wire.WIRE_NAMES.get(wire_eff, str(wire_eff)),
+                    backend="socket",
+                    routing=f"inst={inst},rank={rank},client={client}",
+                    seq=seq,
+                )
             self.pending[seq] = w
             sock_ok = True
 
@@ -1401,11 +1420,15 @@ class _PeerChannel:
                 kicked = True
                 self._kick()
                 continue
+            if w.flight is not None:
+                _flight.FlightRecorder.fail(w.flight)
             raise ConnectionError(
                 f"parameter-server peer {self.proc} sent nothing for "
                 f"{int(silent)}s (watchdog {timeout}s, after replay)"
             )
         if w.error is not None:
+            if w.flight is not None:
+                _flight.FlightRecorder.fail(w.flight)
             raise w.error
         if w.t0 is not None and _telemetry.enabled():
             _metric_handles()[1].observe(
@@ -1414,7 +1437,11 @@ class _PeerChannel:
             )
         rkind, _, _, _, _, _, rrule, rdtype, rpayload = w.reply
         if rkind == _KIND_ERROR:
+            if w.flight is not None:
+                _flight.FlightRecorder.fail(w.flight)
             raise RuntimeError(f"parameter-server peer error: {rrule}")
+        if w.flight is not None:
+            _flight.FlightRecorder.complete(w.flight)
         if rkind == _KIND_SHARD:
             return np.frombuffer(rpayload, np.dtype(rdtype)).copy()
         return None  # ACK

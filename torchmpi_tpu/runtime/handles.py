@@ -26,6 +26,8 @@ from typing import Any, Dict, List, Optional
 
 import jax
 
+from ..telemetry import flightrecorder as _flight
+
 
 class SyncHandle:
     """Tagged union: exactly one of arrays / future / native_id is set."""
@@ -55,18 +57,42 @@ class SyncHandle:
 
         Idempotent, like the reference's ``wait`` which frees the slot and
         turns subsequent waits into no-ops (``resources.cpp:1226-1242``).
+
+        This is the point where DEVICE-side completion is actually
+        awaited (XLA dispatch is async, so a collective's flight-recorder
+        entry completes at dispatch): when the flight recorder is on,
+        the blocking region records its own ``wait.*`` entry — a
+        desynced peer wedges THIS call, and the entry stuck at
+        ``issued`` is what the hang watchdog flags.
         """
         if self._done:
             return self._result
-        if self.arrays is not None:
-            self._result = jax.block_until_ready(self.arrays)
-        elif self.future is not None:
-            self._result = self.future.result()
-        else:
-            from . import native  # local import: extension is optional
+        entry = None
+        if _flight.enabled():
+            kind = (
+                "arrays" if self.arrays is not None
+                else "future" if self.future is not None
+                else "native"
+            )
+            entry = _flight.recorder.record(
+                "handles", f"wait.{kind}", backend=kind
+            )
+        try:
+            if self.arrays is not None:
+                self._result = jax.block_until_ready(self.arrays)
+            elif self.future is not None:
+                self._result = self.future.result()
+            else:
+                from . import native  # local import: extension is optional
 
-            native.wait_request(self.native_id)
-            self._result = None
+                native.wait_request(self.native_id)
+                self._result = None
+        except BaseException:
+            if entry is not None:
+                _flight.FlightRecorder.fail(entry)
+            raise
+        if entry is not None:
+            _flight.FlightRecorder.complete(entry)
         self._done = True
         if self._table_index is not None:
             handles._discard(self._table_index)

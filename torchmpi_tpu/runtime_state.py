@@ -149,6 +149,29 @@ def start(
         _started = True
 
     try:
+        # clock-sync record: one (wall, perf_counter, monotonic) triple
+        # captured at start() — the per-rank offset handshake the offline
+        # cross-rank analyzer (telemetry/analyze.py) aligns dumps with
+        from . import telemetry
+
+        import socket as _socket
+
+        telemetry.record_clock_sync(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            rank=int(os.environ.get("TORCHMPI_TPU_PROCESS_ID", -1))
+            if "TORCHMPI_TPU_PROCESS_ID" in os.environ
+            else jax.process_index(),
+            host=_socket.gethostname(),
+        )
+        if constants.get("watchdog_timeout_seconds") > 0:
+            from .telemetry.watchdog import start_watchdog
+
+            start_watchdog(
+                float(constants.get("watchdog_timeout_seconds")),
+                interval=float(constants.get("watchdog_interval_seconds")),
+            )
+
         if jax.process_count() > 1:
             # Bootstrap the cross-process PS transport HERE, where every
             # process participates (its address exchange is job-global);
@@ -235,6 +258,12 @@ def stop() -> None:
             except Exception:
                 pass
     pools.shutdown_all()
+    # stop the start()-scoped watchdog (all in-flight work drained above);
+    # an env-armed one (launch --watchdog-timeout) is process-lived and
+    # survives stop/start cycles
+    from .telemetry.watchdog import stop_watchdog
+
+    stop_watchdog(only_source="constants")
     with _lock:
         _stack = None
         _started = False

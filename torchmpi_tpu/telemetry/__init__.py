@@ -48,6 +48,8 @@ from .registry import (  # noqa: F401 - re-exported
     MetricsRegistry,
 )
 from .spans import NOOP_SPAN, Span, SpanRecorder
+from . import flightrecorder
+from .flightrecorder import FlightRecorder  # noqa: F401 - re-exported
 
 
 def _env_true(name: str, default: str = "") -> bool:
@@ -80,11 +82,13 @@ def enabled() -> bool:
 def enable() -> None:
     global _enabled
     _enabled = True
+    flightrecorder._sync_telemetry(True)
 
 
 def disable() -> None:
     global _enabled
     _enabled = False
+    flightrecorder._sync_telemetry(False)
 
 
 def span(name: str, **attrs):
@@ -98,6 +102,30 @@ def span(name: str, **attrs):
     if not _enabled:
         return NOOP_SPAN
     return Span(spans, name, attrs or None)
+
+
+# --- clock-sync record (written by runtime_state.start()) -------------------
+# One (wall_time, perf_counter, monotonic) triple captured at the same
+# instant. Span timestamps are perf_counter-based and rank-local; this
+# record is the per-rank offset handshake the offline analyzer uses to put
+# every rank's events on one wall-clock axis (telemetry/analyze.py).
+_clock_sync: Optional[dict] = None
+
+
+def record_clock_sync(**fields) -> None:
+    """Capture the wall/perf/monotonic clock triple (plus caller-provided
+    identity fields like rank/host); included in every snapshot."""
+    global _clock_sync
+    _clock_sync = {
+        "wall_time": time.time(),
+        "perf_counter": time.perf_counter(),
+        "monotonic": time.monotonic(),
+    }
+    _clock_sync.update(fields)
+
+
+def clock_sync() -> Optional[dict]:
+    return _clock_sync
 
 
 def audit(event: str, **fields) -> None:
@@ -116,18 +144,22 @@ def audit_log() -> List[dict]:
 def snapshot() -> dict:
     """One JSON-serializable view of everything: metrics (+ collector
     producers like ``wire_stats``), the audit journal, span-buffer
-    occupancy."""
+    occupancy (``dropped`` > 0 = truncated trace), the flight recorder,
+    and the clock-sync record the cross-rank analyzer aligns with."""
     return {
         "enabled": _enabled,
         "pid": os.getpid(),
         "time": time.time(),
+        "clock_sync": _clock_sync,
         "metrics": metrics.snapshot(),
         "audit": audit_log(),
         "spans": {
             "buffered": len(spans),
             "recorded": spans.total_recorded,
             "capacity": spans.capacity,
+            "dropped": spans.dropped,
         },
+        "flight_recorder": flightrecorder.recorder.snapshot(),
     }
 
 
@@ -171,10 +203,11 @@ def dump(path) -> List[Path]:
 
 
 def reset() -> None:
-    """Clear recorded series, spans, and audit entries (metric objects and
-    collectors stay registered)."""
+    """Clear recorded series, spans, flight-recorder entries, and audit
+    entries (metric objects and collectors stay registered)."""
     metrics.reset()
     spans.reset()
+    flightrecorder.recorder.reset()
     with _audit_lock:
         _audit.clear()
 
@@ -195,13 +228,69 @@ def _wire_stats_collector() -> dict:
 metrics.register_collector("wire_stats", _wire_stats_collector)
 
 
+# the flight recorder mirrors the master switch (one module-global read on
+# its hot path instead of a cross-module call)
+flightrecorder._sync_telemetry(_enabled)
+
+
 # ---------------------------------------------------------------------------
-# per-rank dump on exit (the launcher's --telemetry-dir sets the env var)
+# per-rank dump on exit (the launcher's --telemetry-dir sets the env var) —
+# including ABNORMAL exit: a SIGTERM'd (launcher teardown) or crashed rank
+# must still leave its flight-recorder/span dump behind, because the hung
+# or killed rank is exactly the one whose evidence matters.
 # ---------------------------------------------------------------------------
+
+
+def fault_path_for(path) -> Path:
+    """The faulthandler sidecar for a snapshot at ``path``:
+    ``foo.json`` -> ``foo.fault.txt``."""
+    path = Path(path)
+    return path.with_name(f"{path.stem}.fault.txt")
+
+
+def _install_abnormal_exit_handlers(path: str) -> None:
+    import faulthandler
+    import signal
+
+    # hard faults (SIGSEGV/SIGFPE/SIGABRT/SIGBUS): all-thread C-level
+    # stacks into a sidecar file — the JSON dump can't run from a
+    # corrupted interpreter, a raw fd write can
+    try:
+        fault_file = open(fault_path_for(path), "w")  # noqa: SIM115 - must
+        # outlive this function (faulthandler holds the fd)
+        faulthandler.enable(file=fault_file, all_threads=True)
+    except OSError:
+        pass
+
+    def _dump_and_reraise(signum, frame):
+        try:
+            dump(path)
+        except Exception:  # noqa: BLE001 - dying anyway; dump best-effort
+            pass
+        if signum == signal.SIGINT:
+            # preserve Ctrl-C semantics: the dump is banked, then the
+            # interrupt proceeds as KeyboardInterrupt so user cleanup /
+            # checkpoint-on-interrupt code still runs
+            signal.signal(signum, signal.default_int_handler)
+            raise KeyboardInterrupt
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)  # preserve the 128+signum exit code
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            existing = signal.getsignal(sig)
+            # never displace a user-installed handler; the interpreter
+            # defaults (SIG_DFL / KeyboardInterrupt) are what we upgrade
+            if existing in (signal.SIG_DFL, signal.default_int_handler):
+                signal.signal(sig, _dump_and_reraise)
+        except (ValueError, OSError):
+            pass  # non-main thread / unsupported platform: atexit remains
+
 
 _DUMP_PATH = os.environ.get("TORCHMPI_TPU_TELEMETRY_DUMP", "")
 if _DUMP_PATH:
     _enabled = True
+    flightrecorder._sync_telemetry(True)
 
     def _dump_at_exit(path: str = _DUMP_PATH) -> None:
         try:
@@ -210,3 +299,13 @@ if _DUMP_PATH:
             pass
 
     atexit.register(_dump_at_exit)
+    _install_abnormal_exit_handlers(_DUMP_PATH)
+
+
+# hang watchdog: the launcher's --watchdog-timeout exports
+# TORCHMPI_TPU_WATCHDOG=<seconds>; arm it as soon as telemetry loads so
+# even a hang during start() is caught (runtime_state.start() also arms
+# it when the watchdog_timeout_seconds constant is set).
+from . import watchdog  # noqa: E402 - needs the module fully initialized
+
+watchdog._maybe_start_from_env()

@@ -1,0 +1,562 @@
+"""Offline cross-rank analyzer: merge per-rank telemetry dumps into one
+timeline and diagnose desync / stragglers / hangs / PS fleet health.
+
+    python -m torchmpi_tpu.telemetry.analyze <telemetry-dir> \
+        [--out report.json] [--trace merged.trace.json] [--strict]
+
+Ingests everything a ``--telemetry-dir`` run leaves behind:
+
+- ``telemetry_rank_<r>[.restart<k>].json`` snapshots (+ their
+  ``.trace.json`` span exports) — highest restart per rank wins;
+- ``hang_rank_*.json`` watchdog hang reports;
+- ``heartbeat_rank_*.json`` heartbeats (progress of ranks that died
+  without dumping).
+
+And produces:
+
+1. **One merged Perfetto-loadable trace** — one track (pid) per rank.
+   Span timestamps are rank-local ``perf_counter`` values; the clock-sync
+   record ``start()`` captured (one (wall, perf) pair per rank) is the
+   offset handshake that puts them all on a single wall-clock axis.
+   Flight-recorder entries ride along as a ``flight`` thread per rank.
+2. **A machine-readable report** (JSON):
+   - *desync*: per-communicator (seq, op, payload) streams diffed across
+     ranks over their overlapping seq window — the first divergent
+     (seq, op, payload) is pinpointed, plus per-rank seq high-water
+     mismatches (a rank that stopped early). The GC3 schedule-as-data
+     payoff: desync is a diff, not a debugging session.
+   - *stragglers*: per-(comm, seq) issue-time spread across ranks — who
+     is consistently last, by how much (the Awan et al. cross-rank
+     timeline-correlation methodology, PAPERS.md).
+   - *ps*: per-server RPC latency quantiles (p50/p95/p99 from the
+     histogram buckets) and the listener queue-depth timeline the
+     watchdog sampled.
+   - *hangs*: for each watchdog report, the stuck entries and the ranks
+     that **never entered** the stuck collective (seq high-water below
+     the stuck seq, or — for peer-scoped PS streams — no matching-op
+     entry in the hang window).
+
+Stdlib-only: runs anywhere, no jax required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+_RANK_RE = re.compile(
+    r"^telemetry_rank_(\d+)(?:\.restart(\d+))?\.json$"
+)
+
+# PS streams are per-peer *directional* (rank 0's "ps:1" pairs with rank
+# 1's "ps:0"), so they are excluded from the cross-rank seq diff and the
+# straggler spread, which both assume one shared stream per comm key.
+# "handles" (SyncHandle.wait blocking regions) is likewise rank-local:
+# which waits run depends on timing (prefetch, backpressure drains), not
+# on the program's collective schedule.
+_PS_PREFIX = "ps:"
+_LOCAL_COMMS = ("handles",)
+
+# synthetic tid for the flight-recorder track merged under each rank's pid
+_FLIGHT_TID = 0xF11
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def load_run(telemetry_dir) -> dict:
+    """Read every rank dump / hang report / heartbeat in the directory."""
+    d = Path(telemetry_dir)
+    per_rank: Dict[int, dict] = {}
+    for path in sorted(d.iterdir()) if d.is_dir() else []:
+        m = _RANK_RE.match(path.name)
+        if not m:
+            continue
+        rank, restart = int(m.group(1)), int(m.group(2) or 0)
+        prev = per_rank.get(rank)
+        if prev is not None and prev["restart"] >= restart:
+            continue
+        try:
+            snap = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            per_rank[rank] = {
+                "restart": restart, "path": str(path),
+                "error": f"{type(e).__name__}: {e}",
+                "snapshot": {}, "trace_events": [],
+            }
+            continue
+        trace_path = path.with_name(f"{path.stem}.trace.json")
+        events: List[dict] = []
+        if trace_path.exists():
+            try:
+                events = json.loads(trace_path.read_text()).get(
+                    "traceEvents", []
+                )
+            except (OSError, ValueError):
+                pass
+        per_rank[rank] = {
+            "restart": restart,
+            "path": str(path),
+            "snapshot": snap,
+            "trace_events": events,
+        }
+    hangs = []
+    heartbeats = {}
+    if d.is_dir():
+        for path in sorted(d.glob("hang_rank_*.json")):
+            try:
+                hangs.append(json.loads(path.read_text()))
+            except (OSError, ValueError):
+                pass
+        for path in sorted(d.glob("heartbeat_rank_*.json")):
+            try:
+                heartbeats[path.stem.split("heartbeat_rank_")[-1]] = (
+                    json.loads(path.read_text())
+                )
+            except (OSError, ValueError):
+                pass
+    return {"dir": str(d), "ranks": per_rank, "hangs": hangs,
+            "heartbeats": heartbeats}
+
+
+def _flight_entries(data: dict) -> List[dict]:
+    return data["snapshot"].get("flight_recorder", {}).get("entries", [])
+
+
+def _wall_offset_us(data: dict) -> Optional[float]:
+    """µs to add to a rank's perf_counter-based span ts to land on the
+    wall clock; None when the rank never recorded a clock sync."""
+    cs = data["snapshot"].get("clock_sync")
+    if not cs:
+        return None
+    try:
+        return (float(cs["wall_time"]) - float(cs["perf_counter"])) * 1e6
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# merged trace
+# ---------------------------------------------------------------------------
+
+
+def merged_trace(ranks: Dict[int, dict]) -> dict:
+    """One Chrome-trace object with one pid (track) per rank, all events
+    aligned to a common wall-clock axis where clock sync allows."""
+    events: List[dict] = []
+    aligned: Dict[int, bool] = {}
+    all_ts: List[float] = []
+    per_rank_events: Dict[int, List[dict]] = {}
+    for rank, data in sorted(ranks.items()):
+        off = _wall_offset_us(data)
+        aligned[rank] = off is not None
+        shift = off or 0.0
+        evs = []
+        for ev in data["trace_events"]:
+            if ev.get("ph") == "M":
+                continue  # re-emitted below with the rank identity
+            ev = dict(ev)
+            ev["pid"] = rank
+            ev["ts"] = float(ev.get("ts", 0)) + shift
+            evs.append(ev)
+            all_ts.append(ev["ts"])
+        for e in _flight_entries(data):
+            t0 = float(e["t_issue"]) * 1e6
+            t1 = (
+                float(e["t_complete"]) * 1e6
+                if e.get("t_complete") else t0
+            )
+            evs.append({
+                "ph": "X",
+                "name": f"flight.{e['op']}",
+                "cat": "flight",
+                "ts": t0,
+                "dur": max(t1 - t0, 1.0),
+                "pid": rank,
+                "tid": _FLIGHT_TID,
+                "args": {k: e[k] for k in
+                         ("seq", "comm", "payload", "wire", "backend",
+                          "routing", "status")},
+            })
+            all_ts.append(t0)
+        per_rank_events[rank] = evs
+    base = min(all_ts) if all_ts else 0.0
+    for rank in sorted(per_rank_events):
+        suffix = "" if aligned[rank] else " (unaligned)"
+        events.append({
+            "ph": "M", "ts": 0, "name": "process_name", "pid": rank,
+            "tid": 0, "args": {"name": f"rank {rank}{suffix}"},
+        })
+        events.append({
+            "ph": "M", "ts": 0, "name": "thread_name", "pid": rank,
+            "tid": _FLIGHT_TID, "args": {"name": "flight recorder"},
+        })
+        for ev in per_rank_events[rank]:
+            ev["ts"] = round(ev["ts"] - base, 3)
+            events.append(ev)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "clockAligned": aligned,
+    }
+
+
+# ---------------------------------------------------------------------------
+# desync detection
+# ---------------------------------------------------------------------------
+
+
+def _collective_streams(ranks: Dict[int, dict]) -> Dict[str, Dict[int, dict]]:
+    """comm -> rank -> {seq: entry} for shared (non-PS) streams."""
+    streams: Dict[str, Dict[int, dict]] = {}
+    for rank, data in ranks.items():
+        for e in _flight_entries(data):
+            comm = e["comm"]
+            if comm.startswith(_PS_PREFIX) or comm in _LOCAL_COMMS:
+                continue
+            streams.setdefault(comm, {}).setdefault(rank, {})[e["seq"]] = e
+    return streams
+
+
+def detect_desync(ranks: Dict[int, dict]) -> dict:
+    """Diff per-comm (seq, op, payload) streams across ranks. The ring
+    may have dropped old entries, so each comm is compared over the seq
+    window every rank still holds; per-rank high-water mismatches are
+    reported separately (the 'rank stopped early' signal)."""
+    truncated = {
+        rank: data["snapshot"].get("flight_recorder", {}).get("dropped", 0)
+        for rank, data in ranks.items()
+    }
+    comms = {}
+    first_div = None
+    for comm, by_rank in sorted(_collective_streams(ranks).items()):
+        if len(by_rank) < 2:
+            continue  # nothing to diff against
+        lo = max(min(s) for s in by_rank.values())
+        hi = min(max(s) for s in by_rank.values())
+        high_water = {r: max(s) for r, s in by_rank.items()}
+        divergence = None
+        for seq in range(lo, hi + 1):
+            vals = {r: s.get(seq) for r, s in by_rank.items()}
+            missing = [r for r, v in vals.items() if v is None]
+            kinds = {
+                r: (v["op"], v["payload"])
+                for r, v in vals.items() if v is not None
+            }
+            if missing or len(set(kinds.values())) > 1:
+                divergence = {
+                    "comm": comm,
+                    "seq": seq,
+                    "ops": {str(r): v[0] for r, v in kinds.items()},
+                    "payloads": {str(r): v[1] for r, v in kinds.items()},
+                    "ranks_missing_seq": missing,
+                }
+                break
+        tail_mismatch = len(set(high_water.values())) > 1
+        comms[comm] = {
+            "ranks": sorted(by_rank),
+            "compared_window": [lo, hi],
+            "seq_high_water": {str(r): v for r, v in high_water.items()},
+            "tail_mismatch": tail_mismatch,
+            "divergence": divergence,
+        }
+        if divergence and first_div is None:
+            first_div = divergence
+    status = "desync" if first_div else "none"
+    return {
+        "status": status,
+        "first_divergence": first_div,
+        "comms": comms,
+        "ring_dropped": {str(r): v for r, v in truncated.items() if v},
+    }
+
+
+# ---------------------------------------------------------------------------
+# straggler ranking
+# ---------------------------------------------------------------------------
+
+
+def rank_stragglers(ranks: Dict[int, dict]) -> dict:
+    """Per-(comm, seq) issue-time spread across ranks: who enters each
+    collective last, and by how much. Requires the shared wall clock the
+    flight recorder stamps (time.time()); meaningful skew >> NTP error."""
+    lag_sum: Dict[int, float] = {}
+    last_count: Dict[int, int] = {}
+    samples = 0
+    max_spread = 0.0
+    for comm, by_rank in _collective_streams(ranks).items():
+        if len(by_rank) < 2:
+            continue
+        common = set.intersection(*(set(s) for s in by_rank.values()))
+        for seq in common:
+            entries = {r: s[seq] for r, s in by_rank.items()}
+            if len({e["op"] for e in entries.values()}) != 1:
+                continue  # desynced seq: not a timing comparison
+            times = {r: float(e["t_issue"]) for r, e in entries.items()}
+            t_min = min(times.values())
+            spread = max(times.values()) - t_min
+            max_spread = max(max_spread, spread)
+            last = max(times, key=times.get)
+            last_count[last] = last_count.get(last, 0) + 1
+            for r, t in times.items():
+                lag_sum[r] = lag_sum.get(r, 0.0) + (t - t_min)
+            samples += 1
+    ranking = sorted(
+        (
+            {
+                "rank": r,
+                "mean_lag_ms": round(lag_sum.get(r, 0.0) / samples * 1e3, 3),
+                "last_count": last_count.get(r, 0),
+            }
+            for r in sorted(ranks)
+        ),
+        key=lambda d: (-d["mean_lag_ms"], -d["last_count"]),
+    ) if samples else []
+    worst = ranking[0] if ranking else None
+    return {
+        "samples": samples,
+        "max_spread_ms": round(max_spread * 1e3, 3),
+        "ranking": ranking,
+        "worst": worst["rank"] if worst else None,
+        # scheduling jitter and NTP skew sit well under this; a real
+        # straggler (slow host, contended input pipeline) sits well over
+        "significant": bool(worst and worst["mean_lag_ms"] >= 25.0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# PS fleet health
+# ---------------------------------------------------------------------------
+
+
+def _series_labels(label_str: str) -> dict:
+    out = {}
+    for part in label_str.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k] = v
+    return out
+
+
+def ps_health(ranks: Dict[int, dict]) -> dict:
+    """Per-server RPC latency quantiles + queue depth over time."""
+    servers = {}
+    for rank, data in sorted(ranks.items()):
+        metrics = data["snapshot"].get("metrics", {})
+        lat = metrics.get("tm_ps_rpc_latency_seconds", {}).get("series", {})
+        rpc = {}
+        for label_str, h in lat.items():
+            kind = _series_labels(label_str).get("kind", label_str)
+            rpc[kind] = {
+                "count": h.get("count"),
+                "mean_s": (
+                    round(h["sum"] / h["count"], 6) if h.get("count") else None
+                ),
+                "quantiles_s": h.get("quantiles", {}),
+            }
+        listener = metrics.get("ps_listener")
+        timeline = metrics.get("ps_queue_timeline") or []
+        if rpc or listener or timeline:
+            servers[str(rank)] = {
+                "rpc_latency": rpc,
+                "listener": listener,
+                "queue_depth_timeline": timeline,
+                "queue_depth_max": max(
+                    (p.get("queue_depth") or 0 for p in timeline), default=None
+                ) if timeline else None,
+            }
+    return {"servers": servers}
+
+
+# ---------------------------------------------------------------------------
+# hang analysis
+# ---------------------------------------------------------------------------
+
+
+def analyze_hangs(run: dict) -> list:
+    """For each watchdog report: the stuck entries, and which ranks never
+    entered them (seq high-water below the stuck seq for shared streams;
+    no matching-op entry in the hang window for peer-scoped PS ones)."""
+    ranks = run["ranks"]
+    out = []
+    for hang in run["hangs"]:
+        stuck_entries = hang.get("detail", {}).get("stuck", [])
+        diagnosed = []
+        for stuck in stuck_entries:
+            comm, seq, op = stuck["comm"], stuck["seq"], stuck["op"]
+            never_entered = []
+            if comm in _LOCAL_COMMS:
+                pass  # rank-local blocking region: no cross-rank members
+            elif not comm.startswith(_PS_PREFIX):
+                for r, data in sorted(ranks.items()):
+                    hw = (
+                        data["snapshot"].get("flight_recorder", {})
+                        .get("seq_high_water", {})
+                    )
+                    if hw.get(comm, -1) < seq:
+                        never_entered.append(r)
+            else:
+                # PS streams are directional: "ps:<peer>" names the peer
+                # process the hang rank was waiting on — only THAT peer
+                # can have "never entered"; other ranks' unrelated RPC
+                # traffic proves nothing either way
+                m = re.match(rf"{_PS_PREFIX}(\d+)$", comm)
+                peer = int(m.group(1)) if m else None
+                t0 = float(stuck["t_issue"]) - 1.0
+                if peer is not None and peer != hang.get("rank"):
+                    data = ranks.get(peer)
+                    if data is None or not any(
+                        e["op"] == op and float(e["t_issue"]) >= t0
+                        for e in _flight_entries(data)
+                    ):
+                        never_entered.append(peer)
+            # heartbeats cover ranks that died before dumping (shared
+            # streams only — a peer's own PS streams are directional and
+            # never carry this comm key)
+            if not comm.startswith(_PS_PREFIX) and comm not in _LOCAL_COMMS:
+                for tag, beat in run["heartbeats"].items():
+                    try:
+                        r = int(tag)
+                    except ValueError:
+                        continue
+                    if r in ranks or r == hang.get("rank"):
+                        continue
+                    if beat.get("seq_high_water", {}).get(comm, -1) < seq:
+                        never_entered.append(r)
+            diagnosed.append({
+                "stuck": {k: stuck.get(k) for k in
+                          ("comm", "seq", "op", "payload", "wire",
+                           "backend", "t_issue")},
+                "ranks_never_entered": sorted(set(never_entered)),
+            })
+        out.append({
+            "rank": hang.get("rank"),
+            "reason": hang.get("reason"),
+            "time": hang.get("time"),
+            "watchdog_timeout_seconds": hang.get("watchdog_timeout_seconds"),
+            "stuck_collectives": diagnosed,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def analyze(telemetry_dir, run: Optional[dict] = None) -> dict:
+    """The full report (without writing anything). ``run`` short-circuits
+    the directory read when the caller already holds a ``load_run``."""
+    if run is None:
+        run = load_run(telemetry_dir)
+    ranks = run["ranks"]
+    report = {
+        "dir": run["dir"],
+        "ranks": sorted(ranks),
+        "restarts": {str(r): d["restart"] for r, d in ranks.items()
+                     if d["restart"]},
+        "spans_dropped": {
+            str(r): d["snapshot"].get("spans", {}).get("dropped", 0)
+            for r, d in ranks.items()
+        },
+        "desync": detect_desync(ranks),
+        "stragglers": rank_stragglers(ranks),
+        "ps": ps_health(ranks),
+        "hangs": analyze_hangs(run),
+    }
+    return report
+
+
+def _summary_lines(report: dict) -> List[str]:
+    lines = [f"ranks: {', '.join(map(str, report['ranks'])) or '(none)'}"]
+    div = report["desync"]["first_divergence"]
+    if div is None:
+        lines.append("desync: none")
+    else:
+        ops = ", ".join(
+            f"rank {r}={op}" for r, op in sorted(div["ops"].items())
+        )
+        lines.append(
+            f"desync: comm={div['comm']} first divergent seq={div['seq']} "
+            f"({ops or 'missing on ' + str(div['ranks_missing_seq'])})"
+        )
+    st = report["stragglers"]
+    if st.get("significant"):
+        w = st["ranking"][0]
+        lines.append(
+            f"straggler: rank {w['rank']} (mean lag {w['mean_lag_ms']}ms, "
+            f"last into {w['last_count']}/{st['samples']} collectives)"
+        )
+    else:
+        lines.append("straggler: none")
+    if report["hangs"]:
+        for h in report["hangs"]:
+            for d in h["stuck_collectives"]:
+                s = d["stuck"]
+                lines.append(
+                    f"hang: rank {h['rank']} stuck in {s['op']} "
+                    f"(comm={s['comm']} seq={s['seq']}); never entered: "
+                    f"{d['ranks_never_entered'] or 'none'}"
+                )
+            if not h["stuck_collectives"]:
+                lines.append(
+                    f"hang: rank {h['rank']} ({h['reason']})"
+                )
+    else:
+        lines.append("hangs: none")
+    truncated = report["desync"].get("ring_dropped", {})
+    if truncated:
+        lines.append(f"flight-ring truncation: {truncated}")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m torchmpi_tpu.telemetry.analyze",
+        description="merge per-rank telemetry dumps; diagnose desync, "
+        "stragglers, hangs, PS health",
+    )
+    ap.add_argument("dir", help="the --telemetry-dir of the run")
+    ap.add_argument("--out", default=None,
+                    help="report JSON path (default <dir>/analysis.json)")
+    ap.add_argument("--trace", default=None,
+                    help="merged Perfetto trace path "
+                    "(default <dir>/merged.trace.json)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when a desync or hang was found")
+    args = ap.parse_args(argv)
+
+    d = Path(args.dir)
+    run = load_run(d)
+    if not run["ranks"]:
+        print(f"no telemetry_rank_*.json dumps under {d}", file=sys.stderr)
+        return 2
+    report = analyze(d, run=run)
+    trace = merged_trace(run["ranks"])
+
+    out = Path(args.out) if args.out else d / "analysis.json"
+    trace_path = Path(args.trace) if args.trace else d / "merged.trace.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    trace_path.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(report, indent=2, default=str))
+    trace_path.write_text(json.dumps(trace))
+
+    for line in _summary_lines(report):
+        print(line)
+    print(f"report: {out}")
+    print(f"merged trace: {trace_path}")
+    if args.strict and (
+        report["desync"]["status"] != "none" or report["hangs"]
+    ):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,11 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     1e-5, 1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 10.0, 100.0
 )
 
+# Quantiles estimated from bucket counts in every snapshot / exposition
+# (the cross-rank analyzer reads these; bucket counts alone don't rank
+# stragglers or express an SLO).
+QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
 
 def _label_key(labels: Dict[str, object]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -144,6 +149,38 @@ class Histogram(_Metric):
             state[1] += value
             state[2] += 1
 
+    def _quantile_estimates(self, counts, n) -> Dict[str, float]:
+        """p50/p95/p99 from the bucket counts: the classic Prometheus
+        ``histogram_quantile`` estimator — find the bucket holding the
+        target rank, interpolate linearly within its boundaries. Values in
+        the +Inf bucket clamp to the top finite boundary (the estimator
+        has no upper edge to interpolate against)."""
+        out: Dict[str, float] = {}
+        if n <= 0:
+            return out
+        for q in QUANTILES:
+            target = q * n
+            cum = 0
+            val = float(self.buckets[-1])
+            for i, c in enumerate(counts[:-1]):
+                if cum + c >= target:
+                    lo = float(self.buckets[i - 1]) if i else 0.0
+                    hi = float(self.buckets[i])
+                    val = lo + (hi - lo) * ((target - cum) / c) if c else hi
+                    break
+                cum += c
+            out[str(q)] = val
+        return out
+
+    def quantiles(self, **labels) -> Dict[str, float]:
+        """Estimated quantiles (:data:`QUANTILES`) for one labelset."""
+        with self._lock:
+            state = self._series.get(_label_key(labels))
+            if state is None:
+                return {}
+            counts, _, n = list(state[0]), state[1], state[2]
+        return self._quantile_estimates(counts, n)
+
     def count(self, **labels) -> int:
         with self._lock:
             state = self._series.get(_label_key(labels))
@@ -162,6 +199,7 @@ class Histogram(_Metric):
             },
             "sum": total,
             "count": n,
+            "quantiles": self._quantile_estimates(counts, n),
         }
 
     def _prom_lines(self):
@@ -179,6 +217,32 @@ class Histogram(_Metric):
             yield f"{self.name}_bucket{_prom_labels(key, inf)} {n}"
             yield f"{self.name}_sum{_prom_labels(key)} {total}"
             yield f"{self.name}_count{_prom_labels(key)} {n}"
+
+    def prometheus(self) -> str:
+        # estimated quantiles are exposed as a SEPARATE `<name>_quantile`
+        # gauge family: a histogram family may legally carry only
+        # _bucket/_sum/_count samples, and strict OpenMetrics parsers
+        # reject bare quantile-labelled lines inside it
+        out = [super().prometheus()]
+        with self._lock:
+            items = [
+                (k, (list(s[0]), s[2])) for k, s in self._series.items()
+            ]
+        qlines = []
+        for key, (counts, n) in items:
+            for q, v in self._quantile_estimates(counts, n).items():
+                quant = f'quantile="{q}"'
+                qlines.append(
+                    f"{self.name}_quantile{_prom_labels(key, quant)} {v}"
+                )
+        if qlines:
+            out.append(
+                f"# HELP {self.name}_quantile estimated quantiles of "
+                f"{self.name} (from bucket counts)"
+            )
+            out.append(f"# TYPE {self.name}_quantile gauge")
+            out.extend(qlines)
+        return "\n".join(out)
 
 
 class MetricsRegistry:
@@ -226,6 +290,12 @@ class MetricsRegistry:
         listener re-registers on every transport bootstrap)."""
         with self._lock:
             self._collectors[name] = fn
+
+    def unregister_collector(self, name: str) -> None:
+        """Detach a producer (a stopped watchdog must not keep feeding —
+        or be kept alive by — snapshots)."""
+        with self._lock:
+            self._collectors.pop(name, None)
 
     def snapshot(self) -> dict:
         with self._lock:

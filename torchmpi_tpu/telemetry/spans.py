@@ -51,6 +51,10 @@ class SpanRecorder:
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=int(capacity))
         self.total_recorded = 0
+        # spans evicted by ring wrap-around: > 0 means the exported trace
+        # is TRUNCATED (detectable instead of silent — snapshot()["spans"]
+        # ["dropped"] and the trace's "spanDropped" field both carry it)
+        self.dropped = 0
 
     @property
     def capacity(self) -> int:
@@ -64,6 +68,8 @@ class SpanRecorder:
                attrs: Optional[dict] = None) -> None:
         tid = threading.get_ident() & 0xFFFFFFFF
         with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
             self._buf.append((name, ts_us, dur_us, tid, attrs))
             self.total_recorded += 1
 
@@ -71,6 +77,7 @@ class SpanRecorder:
         with self._lock:
             self._buf.clear()
             self.total_recorded = 0
+            self.dropped = 0
 
     def trace_events(self) -> list:
         """Chrome ``trace_event`` list: one complete ('X') event per span
@@ -112,7 +119,10 @@ class SpanRecorder:
         with open(path, "w") as f:
             json.dump(
                 {"traceEvents": self.trace_events(),
-                 "displayTimeUnit": "ms"},
+                 "displayTimeUnit": "ms",
+                 # extra top-level keys are legal in the Chrome trace
+                 # object form; > 0 flags a truncated (ring-wrapped) trace
+                 "spanDropped": self.dropped},
                 f,
             )
 
