@@ -11,9 +11,23 @@ cd "$(dirname "$0")/.."
 
 tier="${1:-all}"
 
+run_lint() {
+    # tpu-lint: static collective-contract + lock-order analysis over the
+    # library and examples. The shipped baseline is EMPTY — any finding
+    # is either a new bug or needs an inline justified suppression.
+    echo "=== lint (tpu-lint static analysis) ==="
+    python -m torchmpi_tpu.analysis torchmpi_tpu examples --strict \
+        --baseline scripts/tpu_lint_baseline.json
+}
+
 run_fast() {
-    echo "=== fast tier (unit + interpret p<=3 + single-process) ==="
-    python -m pytest tests/ -q -m "not slow"
+    run_lint
+    # tier-1 runs ONCE under the instrumented-lock runtime monitor: every
+    # lock in the threaded modules records real acquisition orders and the
+    # conftest session gate fails on any inversion — the dynamic check
+    # validating tpu-lint's static lock graph.
+    echo "=== fast tier (unit + interpret p<=3 + single-process; lock monitor armed) ==="
+    TORCHMPI_TPU_LOCK_MONITOR=1 python -m pytest tests/ -q -m "not slow"
     run_perf_smoke
 }
 
@@ -52,11 +66,12 @@ run_slow_b() {
 }
 
 case "$tier" in
+    lint) run_lint ;;
     fast) run_fast ;;
     perf-smoke) run_perf_smoke ;;
     slow-a) run_slow_a ;;
     slow-b) run_slow_b ;;
     all) run_fast; run_slow_a; run_slow_b ;;
-    *) echo "usage: scripts/ci.sh [fast|perf-smoke|slow-a|slow-b|all]" >&2; exit 2 ;;
+    *) echo "usage: scripts/ci.sh [lint|fast|perf-smoke|slow-a|slow-b|all]" >&2; exit 2 ;;
 esac
 echo "Success"

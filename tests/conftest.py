@@ -45,3 +45,23 @@ def _fresh_runtime():
 
     runtime_state._reset_for_tests()
     constants._reset_for_tests()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Lock-order gate: under TORCHMPI_TPU_LOCK_MONITOR=1 (how CI runs
+    tier-1 once), any inversion the monitored locks recorded fails the
+    session — even one raised inside a worker thread and swallowed
+    there. The violation record names both orders and both sites."""
+    from torchmpi_tpu.analysis import lockmon
+
+    bad = lockmon.violations()
+    if bad:
+        import json
+
+        print(
+            "\nLOCK-ORDER INVERSIONS recorded by the runtime monitor:\n"
+            + json.dumps(bad, indent=2),
+            file=sys.stderr,
+        )
+        if exitstatus == 0:
+            session.exitstatus = 3

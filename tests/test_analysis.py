@@ -1,0 +1,741 @@
+"""tpu-lint: fixture pairs (true positive + clean twin) for every rule,
+CLI exit-code/baseline/suppression behavior, and the instrumented-lock
+runtime monitor (deliberate inversion must fail)."""
+
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from torchmpi_tpu.analysis import lockmon
+from torchmpi_tpu.analysis.cli import main as lint_main, run_analysis
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def lint_snippet(tmp_path, source, name="snippet.py", **kw):
+    p = tmp_path / name
+    p.write_text(source)
+    return run_analysis([p], **kw)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# TPL001 / TPL002 — rank-divergent collectives
+# ---------------------------------------------------------------------------
+
+
+def test_tpl001_rank_guarded_collective(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def step(x):
+    if mpi.rank() == 0:
+        mpi.allreduce_tensor(x)
+""")
+    assert rules_of(findings) == ["TPL001"]
+    assert "allreduce_tensor" in findings[0].message
+
+
+def test_tpl001_rank_variable_idiom(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def step(x):
+    rank = mpi.rank()
+    if rank == 0:
+        mpi.barrier()
+""")
+    assert rules_of(findings) == ["TPL001"]
+
+
+def test_tpl001_early_exit(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def step(x):
+    if mpi.rank() != 0:
+        return None
+    return mpi.allreduce_tensor(x)
+""")
+    assert rules_of(findings) == ["TPL001"]
+    assert "early exit" in findings[0].message
+
+
+def test_tpl001_rank_bounded_while(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def step(x):
+    i = 0
+    while i < mpi.rank():
+        x = mpi.allreduce_tensor(x)
+        i += 1
+""")
+    assert rules_of(findings) == ["TPL001"]
+
+
+def test_tpl001_clean_twin_same_sequence_and_guarded_io(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def step(x):
+    if mpi.rank() == 0:
+        print("rank 0 reporting")          # rank-local work is fine
+    y = mpi.allreduce_tensor(x)            # unconditional collective
+    if mpi.rank() == 0:
+        y2 = mpi.allreduce_tensor(y)       # identical sequence in both
+    else:
+        y2 = mpi.allreduce_tensor(y)       # arms: every rank issues it
+    return y2
+""")
+    assert findings == []
+
+
+def test_tpl002_mismatched_arms(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def step(x):
+    if mpi.rank() == 0:
+        return mpi.allreduce_tensor(x)
+    else:
+        return mpi.reducescatter_tensor(x)
+""")
+    assert rules_of(findings) == ["TPL002"]
+    assert "allreduce_tensor" in findings[0].message
+    assert "reducescatter_tensor" in findings[0].message
+
+
+def test_tpl002_clean_twin_nonrank_branch(tmp_path):
+    # a mode switch that is replicated config, not rank-dependent
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def step(x, mode):
+    if mode == "scatter":
+        return mpi.reducescatter_tensor(x)
+    else:
+        return mpi.allreduce_tensor(x)
+""")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TPL003 — leaked SyncHandles
+# ---------------------------------------------------------------------------
+
+
+def test_tpl003_discarded_and_unwaited(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def fire_and_forget(x):
+    mpi.async_.allreduce_tensor(x)        # discarded outright
+
+def assigned_never_waited(x):
+    h = mpi.async_.allreduce_tensor(x)
+    return x
+""")
+    assert rules_of(findings) == ["TPL003"]
+    assert len(findings) == 2
+
+
+def test_tpl003_clean_twins(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def waited(x):
+    h = mpi.async_.allreduce_tensor(x)
+    return h.wait()
+
+def module_wait(x):
+    h = mpi.async_.ring.allreduce_tensor(x)
+    return mpi.wait(h)
+
+def immediate(x):
+    return mpi.async_.allreduce_tensor(x).wait()
+
+def escapes(x, out):
+    h = mpi.async_.allreduce_tensor(x)
+    out.append(h)                          # someone else waits it
+
+def returned(x):
+    return mpi.async_.allreduce_tensor(x)  # caller's responsibility
+
+def drained(x):
+    h = mpi.async_.allreduce_tensor(x)
+    mpi.sync_all()                         # global drain absolves
+""")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TPL004 — donated buffer reuse
+# ---------------------------------------------------------------------------
+
+
+def test_tpl004_read_after_donation(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import jax
+
+def pack(buf, x):
+    fn = jax.jit(lambda b, v: b + v, donate_argnums=(0,))
+    out = fn(buf, x)
+    return out, buf.sum()                  # buf is dead after donation
+""")
+    assert rules_of(findings) == ["TPL004"]
+    assert "'buf'" in findings[0].message
+
+
+def test_tpl004_clean_twins(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import jax
+
+def rebound(buf, x):
+    fn = jax.jit(lambda b, v: b + v, donate_argnums=(0,))
+    buf = fn(buf, x)                       # immediate rebind: fresh value
+    return buf.sum()
+
+def undonated(buf, x):
+    fn = jax.jit(lambda b, v: b + v)
+    out = fn(buf, x)
+    return out, buf.sum()                  # no donation: reads are fine
+""")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TPL005 — collectives outside start()/stop()
+# ---------------------------------------------------------------------------
+
+
+def test_tpl005_before_start_and_after_stop(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def main(x):
+    mpi.allreduce_tensor(x)                # before start
+    mpi.start()
+    mpi.allreduce_tensor(x)                # fine
+    mpi.stop()
+    mpi.allreduce_tensor(x)                # after stop
+""")
+    assert rules_of(findings) == ["TPL005"]
+    assert len(findings) == 2
+    assert "before start()" in findings[0].message
+    assert "after stop()" in findings[1].message
+
+
+def test_tpl005_clean_twin(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import torchmpi_tpu as mpi
+
+def main(x):
+    mpi.start()
+    y = mpi.allreduce_tensor(x)
+    mpi.stop()
+    return y
+
+def library_helper(x):
+    return mpi.allreduce_tensor(x)         # no lifecycle in scope: fine
+""")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TPL101/TPL102/TPL103 — lock rules
+# ---------------------------------------------------------------------------
+
+_INVERTED = """
+import threading
+
+class AB:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def one(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def two(self):
+        with self.b:
+            with self.a:
+                pass
+"""
+
+
+def test_tpl101_cycle(tmp_path):
+    findings = lint_snippet(tmp_path, _INVERTED)
+    assert rules_of(findings) == ["TPL101"]
+    assert "AB.a" in findings[0].message and "AB.b" in findings[0].message
+
+
+def test_tpl101_cycle_via_call_graph(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import threading
+
+class AB:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def _inner(self):
+        with self.a:
+            pass
+
+    def one(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def two(self):
+        with self.b:
+            self._inner()                  # acquires a while holding b
+""")
+    assert rules_of(findings) == ["TPL101"]
+
+
+def test_tpl101_clean_twin_consistent_order(tmp_path):
+    findings = lint_snippet(tmp_path, _INVERTED.replace(
+        "with self.b:\n            with self.a:",
+        "with self.a:\n            with self.b:",
+    ))
+    assert findings == []
+
+
+def test_tpl102_blocking_under_lock(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import threading
+
+class P:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def stop(self):
+        with self._lock:
+            self._thread.join()
+""")
+    assert rules_of(findings) == ["TPL102"]
+
+
+def test_tpl102_clean_twins(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import threading
+
+class P:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._thread = None
+
+    def stop(self):
+        with self._lock:
+            t, self._thread = self._thread, None
+        t.join()                           # join OUTSIDE the lock
+
+    def wait_ready(self, pred):
+        with self._cv:
+            self._cv.wait_for(pred)        # waiting on the HELD cv is
+                                           # the condition protocol
+
+    def shutdown_nowait(self, pool):
+        with self._lock:
+            pool.shutdown(wait=False)      # non-blocking shutdown
+""")
+    assert findings == []
+
+
+def test_tpl102_explicit_release_is_tracked(tmp_path):
+    # the bounded-inflight pattern: drop the lock around the block
+    findings = lint_snippet(tmp_path, """
+import threading
+
+_lock = threading.Lock()
+
+def drain(oldest):
+    with _lock:
+        _lock.release()
+        oldest.result()                    # lock NOT held here
+        _lock.acquire()
+""")
+    assert findings == []
+
+
+def test_tpl103_self_deadlock(tmp_path):
+    findings = lint_snippet(tmp_path, """
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:
+                pass
+""")
+    assert rules_of(findings) == ["TPL103"]
+
+
+def test_locks_recognize_lockmon_factories(tmp_path):
+    findings = lint_snippet(tmp_path, _INVERTED.replace(
+        "threading.Lock()", 'lockmon.make_lock("x")'
+    ).replace("import threading", "from torchmpi_tpu.analysis import lockmon"))
+    assert rules_of(findings) == ["TPL101"]
+
+
+# ---------------------------------------------------------------------------
+# TPL201/202/203 — knob consistency
+# ---------------------------------------------------------------------------
+
+
+def _knob_tree(tmp_path, start_sig="def start(**kw):", readme="read_knob"):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "constants.py").write_text("""
+from dataclasses import dataclass
+
+@dataclass
+class _Constants:
+    read_knob: int = 1
+    dead_knob: int = 2
+""")
+    (pkg / "runtime_state.py").write_text(f"""
+{start_sig}
+    pass
+""")
+    (pkg / "user.py").write_text("""
+from . import constants
+
+def f():
+    return constants.get("read_knob")
+""")
+    (tmp_path / "README.md").write_text(f"documented: {readme}\n")
+    return pkg
+
+
+def test_knob_rules_fire(tmp_path):
+    pkg = _knob_tree(tmp_path, start_sig="def start(a=1):")
+    findings = run_analysis([pkg], root=tmp_path,
+                            doc_paths=[tmp_path / "README.md"])
+    by_rule = {f.rule: f for f in findings}
+    assert "TPL201" in by_rule and "dead_knob" in by_rule["TPL201"].message
+    assert "TPL202" in by_rule
+    assert "TPL203" in by_rule and "dead_knob" in by_rule["TPL203"].message
+    # read_knob is read and documented: only dead_knob is flagged
+    assert not any("'read_knob'" in f.message for f in findings)
+
+
+def test_knob_rules_clean_twin(tmp_path):
+    pkg = _knob_tree(tmp_path, readme="read_knob dead_knob")
+    (pkg / "user.py").write_text("""
+from . import constants
+
+def f():
+    return constants.get("read_knob"), constants.dead_knob
+""")
+    findings = run_analysis([pkg], root=tmp_path,
+                            doc_paths=[tmp_path / "README.md"])
+    assert findings == []
+
+
+def test_knob_composed_fstring_read_counts(tmp_path):
+    pkg = _knob_tree(tmp_path)
+    (pkg / "constants.py").write_text("""
+from dataclasses import dataclass
+
+@dataclass
+class _Constants:
+    read_knob: int = 1
+    small_size_cpu: int = 2
+    small_size_tpu: int = 3
+""")
+    (pkg / "user.py").write_text("""
+from . import constants
+
+def f(suffix):
+    return (constants.get("read_knob"),
+            constants.get(f"small_size_{suffix}"))
+""")
+    (tmp_path / "README.md").write_text("read_knob small_size\n")
+    findings = run_analysis([pkg], root=tmp_path,
+                            doc_paths=[tmp_path / "README.md"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions, baseline, CLI exit codes
+# ---------------------------------------------------------------------------
+
+_DIVERGENT = """
+import torchmpi_tpu as mpi
+
+def step(x):
+    if mpi.rank() == 0:
+        mpi.allreduce_tensor(x)
+"""
+
+
+def test_suppression_same_line(tmp_path):
+    findings = lint_snippet(tmp_path, _DIVERGENT.replace(
+        "        mpi.allreduce_tensor(x)",
+        "        mpi.allreduce_tensor(x)  # tpu-lint: disable=TPL001 — demo",
+    ))
+    assert findings == []
+
+
+def test_suppression_line_above_and_slug(tmp_path):
+    findings = lint_snippet(tmp_path, _DIVERGENT.replace(
+        "        mpi.allreduce_tensor(x)",
+        "        # tpu-lint: disable=rank-divergent-collective\n"
+        "        mpi.allreduce_tensor(x)",
+    ))
+    assert findings == []
+
+
+def test_suppression_file_wide(tmp_path):
+    findings = lint_snippet(
+        tmp_path, "# tpu-lint: disable-file=TPL001\n" + _DIVERGENT
+    )
+    assert findings == []
+
+
+def test_suppression_other_rule_does_not_mask(tmp_path):
+    findings = lint_snippet(tmp_path, _DIVERGENT.replace(
+        "        mpi.allreduce_tensor(x)",
+        "        mpi.allreduce_tensor(x)  # tpu-lint: disable=TPL003",
+    ))
+    assert rules_of(findings) == ["TPL001"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_DIVERGENT)
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+
+    assert lint_main([str(bad)]) == 0          # report-only by default
+    assert lint_main([str(bad), "--strict"]) == 1
+    assert lint_main([str(clean), "--strict"]) == 0
+    assert lint_main([str(tmp_path / "nope"), "--strict"]) == 2  # no files
+    assert lint_main([str(bad), "--rules", "not-a-rule"]) == 2
+    assert lint_main([str(bad), "--strict", "--rules", "TPL003"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_baseline_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_DIVERGENT)
+    baseline = tmp_path / "baseline.json"
+
+    assert lint_main([str(bad), "--strict"]) == 1
+    assert lint_main(
+        [str(bad), "--baseline", str(baseline), "--write-baseline"]
+    ) == 0
+    entries = json.loads(baseline.read_text())
+    assert entries and entries[0]["rule"] == "TPL001"
+    # baselined finding no longer fails strict…
+    assert lint_main(
+        [str(bad), "--strict", "--baseline", str(baseline)]
+    ) == 0
+    # …but a NEW finding in the same file does
+    bad.write_text(_DIVERGENT + "\ndef g(y):\n"
+                   "    if mpi.rank() == 1:\n"
+                   "        mpi.barrier()\n")
+    assert lint_main(
+        [str(bad), "--strict", "--baseline", str(baseline)]
+    ) == 1
+    capsys.readouterr()
+
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_DIVERGENT)
+    assert lint_main([str(bad), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"][0]["rule"] == "TPL001"
+    assert payload["findings"][0]["slug"] == "rank-divergent-collective"
+
+
+def test_shipped_tree_is_clean_with_empty_baseline():
+    """The acceptance invariant: the repo lints clean, baseline EMPTY."""
+    assert json.loads(
+        (REPO / "scripts" / "tpu_lint_baseline.json").read_text()
+    ) == []
+    findings = run_analysis(
+        [REPO / "torchmpi_tpu", REPO / "examples"], root=REPO
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock monitor
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def clean_monitor():
+    # snapshot/restore, NOT reset(): a plain reset would also erase any
+    # REAL violation recorded earlier in the session and blind the
+    # conftest session gate; this way only OUR deliberate inversions and
+    # order-table entries are removed.
+    saved = lockmon.snapshot_state()
+    lockmon.reset()
+    yield
+    lockmon.restore_state(saved)
+
+
+def test_lockmon_inversion_fails(clean_monitor):
+    a = lockmon.MonitoredLock("test.a")
+    b = lockmon.MonitoredLock("test.b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockmon.LockOrderInversion):
+        with b:
+            with a:
+                pass
+    bad = lockmon.violations()
+    assert len(bad) == 1
+    assert bad[0]["pair"] == ("test.b", "test.a")
+    # the failed acquire released the underlying lock: not wedged
+    assert not a.locked() and not b.locked()
+
+
+def test_lockmon_consistent_order_ok(clean_monitor):
+    a = lockmon.MonitoredLock("test.a")
+    b = lockmon.MonitoredLock("test.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockmon.violations() == []
+    assert ("test.a", "test.b") in lockmon.order_table()
+
+
+def test_lockmon_same_name_instances_exempt(clean_monitor):
+    # one definition, many instances (the per-rank mailbox locks):
+    # interleaving is legal and never flagged
+    a1 = lockmon.MonitoredLock("inst.locks[]")
+    a2 = lockmon.MonitoredLock("inst.locks[]")
+    with a1:
+        with a2:
+            pass
+    with a2:
+        with a1:
+            pass
+    assert lockmon.violations() == []
+
+
+def test_lockmon_cross_thread_inversion(clean_monitor):
+    """The deliberate two-lock inversion, taken by two threads (the shape
+    a real deadlock has)."""
+    a = lockmon.MonitoredLock("x.a")
+    b = lockmon.MonitoredLock("x.b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    caught = []
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass
+        except lockmon.LockOrderInversion as e:
+            caught.append(e)
+
+    th = threading.Thread(target=t2)
+    th.start()
+    th.join()
+    assert caught and lockmon.violations()
+
+
+def test_lockmon_condition_integration(clean_monitor):
+    cv = threading.Condition(lockmon.MonitoredLock("cv.lock"))
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait_for(lambda: bool(hits), timeout=5)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    with cv:
+        hits.append(1)
+        cv.notify_all()
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert lockmon.violations() == []
+
+
+def test_lockmon_disabled_returns_plain_lock():
+    prev = lockmon.enabled()
+    try:
+        lockmon.set_enabled(False)
+        assert isinstance(lockmon.make_lock("x"), type(threading.Lock()))
+        lockmon.set_enabled(True)
+        assert isinstance(lockmon.make_lock("x"), lockmon.MonitoredLock)
+    finally:
+        lockmon.set_enabled(prev)
+
+
+def test_threaded_modules_use_monitored_locks_when_armed():
+    """The wiring check: with the monitor armed, the PS server's locks
+    come back monitored (names matching the static analyzer's keys)."""
+    prev = lockmon.enabled()
+    try:
+        lockmon.set_enabled(True)
+        from torchmpi_tpu.analysis.lockmon import MonitoredLock
+
+        lk = lockmon.make_lock("server.py:_GlobalServer._lock")
+        assert isinstance(lk, MonitoredLock)
+        assert lk.name == "server.py:_GlobalServer._lock"
+    finally:
+        lockmon.set_enabled(prev)
+
+
+def test_monitored_ps_roundtrip(clean_monitor):
+    """End-to-end: a ParameterServer built with monitoring armed runs a
+    send/receive cycle with zero recorded inversions."""
+    prev = lockmon.enabled()
+    lockmon.set_enabled(True)
+    try:
+        import numpy as np
+
+        from torchmpi_tpu.parameterserver.server import (
+            _GlobalServer, _Instance,
+        )
+
+        server = _GlobalServer()
+        inst = server.register(np.zeros(8, np.float32), size=2)
+        assert any(
+            isinstance(lk, lockmon.MonitoredLock) for lk in inst.locks
+        )
+        import threading as _t
+
+        ev = _t.Event()
+        from torchmpi_tpu.parameterserver.server import _Message
+
+        inst.post(0, _Message("update", client=0, rule="add",
+                              payload=np.ones(4, np.float32), done=ev))
+        assert ev.wait(5)
+        server.unregister(inst)
+        server.shutdown()
+        assert lockmon.violations() == []
+    finally:
+        lockmon.set_enabled(prev)

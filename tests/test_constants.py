@@ -56,3 +56,25 @@ def test_listener_mirroring():
 def test_snapshot():
     snap = constants.snapshot()
     assert snap["num_buffers_per_collective_tpu"] == 3
+
+
+def test_start_constant_overrides():
+    """start(**kwargs) sets any knob by name (tpu-lint TPL202 contract)."""
+    import torchmpi_tpu as mpi
+
+    mpi.start(wire_dtype="bf16", fusion_min_tensors=7)
+    try:
+        assert constants.get("wire_dtype") == "bf16"
+        assert constants.get("fusion_min_tensors") == 7
+    finally:
+        mpi.stop()
+
+
+def test_start_unknown_override_rejected_before_state_change():
+    import torchmpi_tpu as mpi
+
+    with pytest.raises(KeyError):
+        mpi.start(not_a_knob=1)
+    assert not mpi.started()
+    mpi.start()  # a corrected retry works
+    mpi.stop()

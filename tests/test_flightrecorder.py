@@ -559,6 +559,41 @@ def test_analyzer_cli_strict_fails_on_desync(tmp_path, capsys):
     assert "first divergent seq=0" in out
 
 
+def _hang_report(tmp_path, comm="g[2]", seq=4):
+    hang = {
+        "reason": "in_flight_timeout", "rank": 0, "pid": 1000,
+        "time": 1010.0, "watchdog_timeout_seconds": 2.0,
+        "detail": {"stuck": [
+            _entry(comm, seq, "allreduce", status="issued", t=1000.0)
+        ]},
+        "threads": {},
+        "flight_recorder": {"entries": [], "seq_high_water": {comm: seq}},
+    }
+    (tmp_path / "hang_rank_0.json").write_text(json.dumps(hang))
+
+
+def test_analyzer_cli_strict_exit_codes_contract(tmp_path, capsys):
+    """The documented contract: 0 clean, 1 desync, 2 input error, 3 hang
+    without desync; desync wins when both are present."""
+    # 3: hang only (no divergent streams)
+    _fake_dump(tmp_path, 0, [_entry("g[2]", 0, "allreduce"),
+                             _entry("g[2]", 1, "allreduce",
+                                    status="issued", t=1000.0)])
+    _fake_dump(tmp_path, 1, [_entry("g[2]", 0, "allreduce")])
+    _hang_report(tmp_path, seq=1)
+    assert tz.main([str(tmp_path), "--strict"]) == 3
+    # non-strict never fails on findings
+    assert tz.main([str(tmp_path)]) == 0
+    # 1: desync wins over the hang
+    _fake_dump(tmp_path, 1, [_entry("g[2]", 0, "broadcast")])
+    assert tz.main([str(tmp_path), "--strict"]) == 1
+    # 2: input error (no rank dumps at all)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert tz.main([str(empty), "--strict"]) == 2
+    capsys.readouterr()
+
+
 def test_analyzer_empty_dir_errors(tmp_path):
     assert tz.main([str(tmp_path)]) == 2
 

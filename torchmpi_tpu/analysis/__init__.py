@@ -1,0 +1,56 @@
+"""tpu-lint: static collective-contract + concurrency analysis.
+
+The runtime observability stack (flight recorder, hang watchdog,
+cross-rank analyzer — PR 6) tells you *which* rank issued a mismatched
+collective or deadlocked the world, after the job already burned the
+chips. The same bug classes are statically detectable before a single
+chip is allocated: this package walks Python ASTs and checks the
+*collective contract* (every rank must issue the same collective
+sequence; async handles must be waited; donated buffers must not be
+read back; collectives live between ``start()`` and ``stop()``) plus
+the *concurrency contract* of the threaded host modules (a consistent
+lock acquisition order, no blocking calls under a lock). MPI-Checker
+(LLVM) is the classic static formulation of the desync check; GC3
+(PAPERS.md) makes the case for treating communication as analyzable
+program structure — a pass that understands collective call sites well
+enough to *check* them is the front half of one that can *compile*
+them (ROADMAP item 1).
+
+CLI::
+
+    python -m torchmpi_tpu.analysis <paths> [--strict] [--baseline F]
+
+Findings carry ``file:line``, a rule id, and a fix hint. Suppress a
+judged false positive with ``# tpu-lint: disable=<rule>`` on (or just
+above) the flagged line; ``--baseline`` names a checked-in JSON file of
+accepted findings (shipped empty — see ``scripts/tpu_lint_baseline.json``).
+
+The static lock graph is validated against reality by the opt-in
+instrumented-lock runtime monitor (:mod:`.lockmon`,
+``TORCHMPI_TPU_LOCK_MONITOR=1``): the threaded modules create their
+locks through :func:`lockmon.make_lock`, which — only when armed —
+records actual acquisition orders and fails on inversion. Sanitizer
+wiring for a language TSan can't reach.
+
+The analysis modules themselves are stdlib-only (``ast``-based, no jax
+imports, no accelerator state touched — linting never initializes a
+backend). Note that running via ``python -m torchmpi_tpu.analysis``
+still imports the parent package (Python imports it before the
+submodule), which does require jax to be importable.
+"""
+
+from .core import Finding, RULES, iter_python_files  # noqa: F401
+
+
+def run(paths, **kw):
+    """Analyze ``paths`` (files or directories); returns a list of
+    :class:`Finding`. Keyword args as :func:`.cli.run_analysis`."""
+    from .cli import run_analysis
+
+    return run_analysis(paths, **kw)
+
+
+def main(argv=None) -> int:
+    from .cli import main as _main
+
+    return _main(argv)

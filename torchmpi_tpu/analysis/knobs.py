@@ -1,0 +1,147 @@
+"""Knob-consistency lint (rules TPL201-TPL203).
+
+``constants.py`` is the single source of truth for every tunable knob.
+Three invariants keep it honest:
+
+- **TPL201 knob-unread** — a knob nobody reads is dead configuration:
+  either wire it up or delete it. Reads are ``constants.get("name")``,
+  attribute access ``constants.name``, and composed f-string reads like
+  ``constants.get(f"small_allreduce_size_{suffix}")`` (the
+  platform-suffix idiom), matched as a pattern.
+- **TPL202 knob-not-startable** — every knob must be settable at the
+  single user entry point, ``start(**kwargs)``; a knob that can only be
+  set by importing ``constants`` and calling ``set()`` before start is
+  a foot-gun (tuned-constant loading may clobber it).
+- **TPL203 knob-undocumented** — every knob must appear in README.md or
+  docs/PARITY.md (suffix pairs like ``_cpu``/``_tpu`` may be documented
+  by their base name).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, SourceFile, attr_chain
+
+
+def knob_fields(constants_sf: SourceFile) -> Dict[str, int]:
+    """name -> definition line of every _Constants dataclass field."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(constants_sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "_Constants":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    out[stmt.target.id] = stmt.lineno
+    return out
+
+
+def _read_patterns(sf: SourceFile) -> List[re.Pattern]:
+    """Regexes matching knob names this file reads.
+
+    Besides direct ``constants.get("name")`` / ``constants.name`` reads
+    and composed f-string reads, any bare string literal equal to a knob
+    name counts: the pools pass the knob name to a reader at
+    construction (``_Pool("tm-ps", "parameterserver_thread_pool_size")``)
+    and the autotuner templates names as ``"small_{op}_size_{s}"`` —
+    knob names are distinctive enough that a matching literal IS a
+    reference."""
+    pats: List[re.Pattern] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if "_" in node.value and node.value.isidentifier():
+                pats.append(re.compile(re.escape(node.value) + r"\Z"))
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain[-1] in ("get", "set") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    pats.append(re.compile(re.escape(arg.value) + r"\Z"))
+                elif isinstance(arg, ast.JoinedStr):
+                    parts = []
+                    for v in arg.values:
+                        if isinstance(v, ast.Constant):
+                            parts.append(re.escape(str(v.value)))
+                        else:
+                            parts.append(r"\w+")
+                    pats.append(re.compile("".join(parts) + r"\Z"))
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            base = attr_chain(node)
+            if base and len(base) >= 2 and "constants" in base[-2].lower():
+                pats.append(re.compile(re.escape(node.attr) + r"\Z"))
+    return pats
+
+
+def _start_accepts_kwargs(runtime_state_sf: SourceFile) -> Optional[int]:
+    """Line of ``def start`` if it lacks a ``**kwargs``; None when fine
+    (or when there is no start() to check)."""
+    for node in ast.walk(runtime_state_sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name == "start"
+        ):
+            if node.args.kwarg is None:
+                return node.lineno
+            return None
+    return None
+
+
+def check_knobs(
+    constants_sf: SourceFile,
+    package_files: Sequence[SourceFile],
+    doc_paths: Sequence[Path],
+    runtime_state_sf: Optional[SourceFile],
+) -> List[Finding]:
+    knobs = knob_fields(constants_sf)
+    if not knobs:
+        return []
+    findings: List[Finding] = []
+
+    pats: List[re.Pattern] = []
+    for sf in package_files:
+        if sf.path.resolve() == constants_sf.path.resolve():
+            continue
+        pats.extend(_read_patterns(sf))
+
+    docs = ""
+    for p in doc_paths:
+        try:
+            docs += Path(p).read_text()
+        except OSError:
+            pass
+
+    for name, line in sorted(knobs.items(), key=lambda kv: kv[1]):
+        if not any(p.fullmatch(name) for p in pats):
+            findings.append(Finding(
+                "TPL201", constants_sf.display, line,
+                f"knob '{name}' is never read outside constants.py",
+                hint="wire the knob into the code path it claims to "
+                "control, or delete it",
+            ))
+        base = re.sub(r"_(cpu|tpu)$", "", name)
+        if docs and name not in docs and base not in docs:
+            findings.append(Finding(
+                "TPL203", constants_sf.display, line,
+                f"knob '{name}' is not mentioned in README.md or "
+                "docs/PARITY.md",
+                hint="add it to the README knob table",
+            ))
+
+    if runtime_state_sf is not None:
+        bad_line = _start_accepts_kwargs(runtime_state_sf)
+        if bad_line is not None:
+            findings.append(Finding(
+                "TPL202", runtime_state_sf.display, bad_line,
+                f"start() accepts no **kwargs — none of the {len(knobs)} "
+                "constants knobs are settable at the entry point",
+                hint="add **constant_overrides to start() and forward "
+                "each to constants.set()",
+            ))
+    return findings

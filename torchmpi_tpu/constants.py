@@ -29,6 +29,7 @@ so C++ code observes the same configuration (see ``runtime/native.py``).
 from __future__ import annotations
 
 import threading
+from .analysis import lockmon as _lockmon
 from dataclasses import dataclass, field, fields
 from typing import Any, Callable, Dict, List
 
@@ -176,7 +177,7 @@ class _Constants:
 
 
 _frozen = False
-_lock = threading.Lock()
+_lock = _lockmon.make_lock("constants.py:_lock")
 _values = _Constants()
 _listeners: List[Callable[[str, Any], None]] = []
 # bumped on every successful set(): dispatch fast paths embed the value in

@@ -48,6 +48,7 @@ import numpy as np
 
 from .. import constants
 from ..runtime.communicator import Communicator
+from ..analysis import lockmon as _lockmon
 from ..runtime.handles import SyncHandle
 from ..runtime.pools import parameterserver_pool
 from .rules import UPDATE_RULES
@@ -56,7 +57,7 @@ _POLL_INTERVAL_S = 100e-6  # the reference server's 100us scan cadence
 
 # Bounded in-flight client ops (kNumAsyncParameterServersInFlight,
 # lib/constants.cpp:152-155): enqueue blocks on the oldest op when full.
-_inflight_lock = threading.Lock()
+_inflight_lock = _lockmon.make_lock("server.py:_inflight_lock")
 _inflight: deque = deque()
 
 
@@ -120,7 +121,7 @@ class _CancelToken:
     __slots__ = ("_lock", "_state")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock("server.py:_CancelToken._lock")
         self._state = "pending"
 
     def cancel(self) -> bool:
@@ -241,7 +242,10 @@ class _Instance:
                 for r, (s, e) in enumerate(self.ranges)
             ]
         self.mailboxes: List[deque] = [deque() for _ in range(size)]
-        self.locks = [threading.Lock() for _ in range(size)]
+        self.locks = [
+            _lockmon.make_lock("server.py:_Instance.locks[]")
+            for _ in range(size)
+        ]
         self.freed = False
         from .transport import instance_fingerprint
 
@@ -428,7 +432,7 @@ class _GlobalServer:
     def __init__(self):
         self._instances: Dict[int, _Instance] = {}
         self._doomed: List[_Instance] = []
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock("server.py:_GlobalServer._lock")
         self._thread: Optional[threading.Thread] = None
         self._terminate = threading.Event()
         self._ids = itertools.count()
@@ -603,7 +607,9 @@ class ParameterServer:
         # handles, double-buffered (at most 2 outstanding per client) so
         # the next fetch rides the wire during compute and receive()
         # consumes data already in flight instead of starting cold
-        self._prefetch_lock = threading.Lock()
+        self._prefetch_lock = _lockmon.make_lock(
+            "server.py:ParameterServer._prefetch_lock"
+        )
         self._prefetch_q: Dict[int, deque] = {}
 
     # ------------------------------------------------------------------

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from .. import constants, telemetry as _telemetry
+from ..analysis import lockmon as _lockmon
 from ..telemetry import flightrecorder as _flight
 from . import wire as _wire
 
@@ -485,7 +486,9 @@ class _Listener:
         # answered from this record instead (bounded FIFO; failures are
         # rare and fatal to the client anyway)
         self._failed: Dict[Tuple[Tuple[int, int, int], int], str] = {}
-        self._applied_lock = threading.Lock()
+        self._applied_lock = _lockmon.make_lock(
+            "transport.py:_Listener._applied_lock"
+        )
         # subset barrier bookkeeping: tag -> per-origin ARRIVAL COUNTERS
         # (not a set): a fast peer's next barrier frame with the same tag
         # can land before this process finishes waiting on the current
@@ -506,7 +509,9 @@ class _Listener:
         # plus the replay-dedup high-water mark per origin.
         self._gather_seen: Dict[str, Dict[int, "deque[bytes]"]] = {}
         self._gather_applied: Dict[int, int] = {}
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = _lockmon.make_condition(
+            "transport.py:_Listener._barrier_cv"
+        )
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         # ONE listener-wide pool for applied-waits and replies, sized
@@ -656,7 +661,9 @@ class _Listener:
         import threading as _threading
         from concurrent.futures import Future
 
-        send_lock = _threading.Lock()
+        send_lock = _lockmon.make_lock(
+            "transport.py:_Listener._serve_conn.send_lock"
+        )
 
         def reply(kind: int, seq: int, **kw) -> None:
             try:
@@ -1082,7 +1089,7 @@ class _PeerChannel:
     def __init__(self, addresses: Dict[int, Tuple[str, int]], proc: int):
         self.addresses = addresses
         self.proc = proc
-        self.lock = threading.Lock()
+        self.lock = _lockmon.make_lock("transport.py:_PeerChannel.lock")
         # seq -> waiter, in submission (== seq) order: replies are matched
         # by the echoed seq (the server replies OUT of order now that
         # applies run concurrently), while reconnect replay still walks
@@ -1508,7 +1515,9 @@ class Transport:
                                 Tuple[int, np.ndarray]] = {}
         self._delta_locks: Dict[Tuple[int, int, int, int],
                                 threading.Lock] = {}
-        self._delta_guard = threading.Lock()
+        self._delta_guard = _lockmon.make_lock(
+            "transport.py:Transport._delta_guard"
+        )
 
     @staticmethod
     def _exchange_addresses(host: str, port: int) -> Dict[int, Tuple[str, int]]:
@@ -1596,7 +1605,9 @@ class Transport:
         with self._delta_guard:
             lock = self._delta_locks.get(key)
             if lock is None:
-                lock = self._delta_locks[key] = threading.Lock()
+                lock = self._delta_locks[key] = _lockmon.make_lock(
+                    "transport.py:Transport._delta_locks[]"
+                )
             return lock
 
     def _delta_cache_store(self, key, entry) -> None:
@@ -1715,7 +1726,7 @@ class Transport:
 
 
 _transport: Optional[Transport] = None
-_transport_lock = threading.Lock()
+_transport_lock = _lockmon.make_lock("transport.py:_transport_lock")
 
 
 def ensure_transport() -> Transport:

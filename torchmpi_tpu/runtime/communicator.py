@@ -32,6 +32,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
+
+from ..analysis import lockmon as _lockmon
 import numpy as np
 from jax.sharding import Mesh
 
@@ -225,7 +227,9 @@ class CommunicatorStack:
     def __init__(self, root: Communicator):
         self._stack: List[Communicator] = [root]
         self._span = (0, 0)
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock(
+            "communicator.py:CommunicatorStack._lock"
+        )
 
     # --- push/set (torch_mpi.cpp:251-268) ---
     def push(self, comm: Communicator) -> int:

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional
 import jax
 
 from ..telemetry import flightrecorder as _flight
+from ..analysis import lockmon as _lockmon
 
 
 class SyncHandle:
@@ -123,7 +124,7 @@ class _HandleTable:
     the MPI request table, and the future queues at ``:399-461``)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock("handles.py:_HandleTable._lock")
         self._handles: Dict[int, SyncHandle] = {}
         self._kinds: Dict[int, str] = {}
         self._next = 0

@@ -17,6 +17,7 @@ import ctypes
 import os
 import subprocess
 import threading
+from ..analysis import lockmon as _lockmon
 from pathlib import Path
 from typing import Optional
 
@@ -26,7 +27,7 @@ _CSRC = Path(__file__).resolve().parent.parent / "csrc"
 _SO = _CSRC / "libtpumpi.so"
 
 _lib: Optional[ctypes.CDLL] = None
-_load_lock = threading.Lock()
+_load_lock = _lockmon.make_lock("native.py:_load_lock")
 _load_attempted = False
 
 

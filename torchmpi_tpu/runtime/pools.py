@@ -14,6 +14,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Optional
 
 from .. import constants
+from ..analysis import lockmon as _lockmon
 
 
 class _Pool:
@@ -21,7 +22,7 @@ class _Pool:
         self._name = name
         self._size_constant = size_constant
         self._executor: Optional[ThreadPoolExecutor] = None
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock("pools.py:_Pool._lock")
 
     def _get(self) -> ThreadPoolExecutor:
         with self._lock:
@@ -36,10 +37,14 @@ class _Pool:
         return self._get().submit(fn, *args, **kwargs)
 
     def shutdown(self) -> None:
+        # Detach under the lock, JOIN outside it: shutdown(wait=True)
+        # blocks until every worker drains, and a worker that calls
+        # submit() (-> _get -> self._lock) while we hold the lock would
+        # deadlock the teardown. Found by tpu-lint TPL102.
         with self._lock:
-            if self._executor is not None:
-                self._executor.shutdown(wait=True)
-                self._executor = None
+            executor, self._executor = self._executor, None
+        if executor is not None:
+            executor.shutdown(wait=True)
 
 
 collective_pool = _Pool("tm-collective", "collective_thread_pool_size")

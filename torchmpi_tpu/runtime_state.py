@@ -14,6 +14,7 @@ from typing import Callable, List, Optional, Sequence, Union
 import jax
 
 from . import constants
+from .analysis import lockmon as _lockmon
 from .runtime import pools
 from .runtime.communicator import (
     Communicator,
@@ -23,7 +24,7 @@ from .runtime.communicator import (
 )
 from .runtime.handles import sync_all
 
-_lock = threading.Lock()
+_lock = _lockmon.make_lock("runtime_state.py:_lock")
 _stack: Optional[CommunicatorStack] = None
 _started = False
 
@@ -44,6 +45,7 @@ def start(
     process_id: Optional[int] = None,
     load_tuned_constants: bool = True,
     precompile_collectives: Optional[Sequence] = None,
+    **constant_overrides,
 ) -> None:
     """Initialise the runtime (``MPI.start``, ``torchmpi/init.lua:31-100``).
 
@@ -70,11 +72,26 @@ def start(
       never pays a collective compile (the AOT warm-up of the latency
       path). Runs AFTER the tuned constants load, against the
       communicator the collectives will actually use.
+    - ``**constant_overrides`` — any :mod:`~torchmpi_tpu.constants` knob
+      by name (``start(wire_dtype="int8", fusion_buffer_bytes=0)``):
+      applied via ``constants.set`` before the runtime bootstraps, and
+      RE-applied after the persisted autotuner results load, so an
+      explicit override always beats a tuned value. Unknown names raise
+      ``KeyError`` before any state changes. Overrides outlive a failed
+      or stopped runtime (they are ordinary constants mutations).
     """
     global _stack, _started
+    for _name in constant_overrides:
+        if _name not in constants.snapshot():
+            raise KeyError(
+                f"start() got unknown constants override {_name!r} "
+                f"(see constants.snapshot() for valid knobs)"
+            )
     with _lock:
         if _started:
             raise RuntimeError("torchmpi_tpu.start() called twice")
+    for _name, _value in constant_overrides.items():
+        constants.set(_name, _value)
     if with_tpu is False or os.environ.get(
         "TORCHMPI_TPU_FORCE_CPU", ""
     ).lower() in ("1", "true", "yes", "on"):
@@ -200,6 +217,9 @@ def start(
                 load_tuning(comm=_stack.current, apply=True)
             except Exception:
                 pass  # cache is best-effort; defaults are always safe
+            # explicit user overrides beat persisted tuned values
+            for _name, _value in constant_overrides.items():
+                constants.set(_name, _value)
 
         if precompile_collectives:
             # AFTER tuning load: the warmed executables must be the ones

@@ -37,6 +37,7 @@ import json
 import os
 import threading
 import time
+from ..analysis import lockmon as _lockmon
 from collections import deque
 from pathlib import Path
 from typing import List, Optional
@@ -69,7 +70,7 @@ spans = SpanRecorder(
 # decision audit journal (autotuner choices etc.) — tiny and always on:
 # decisions are rare and must be reconstructable even when the metric hot
 # paths were disabled at the time
-_audit_lock = threading.Lock()
+_audit_lock = _lockmon.make_lock("telemetry:_audit_lock")
 _audit: deque = deque(maxlen=256)
 
 

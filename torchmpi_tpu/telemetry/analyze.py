@@ -4,6 +4,13 @@ timeline and diagnose desync / stragglers / hangs / PS fleet health.
     python -m torchmpi_tpu.telemetry.analyze <telemetry-dir> \
         [--out report.json] [--trace merged.trace.json] [--strict]
 
+Exit codes (``--strict`` is the CI gate; it composes with the static
+checker ``python -m torchmpi_tpu.analysis --strict``, which covers the
+same bug classes before a chip is ever allocated): ``0`` clean (or not
+strict), ``1`` desync detected, ``2`` usage/input error (no rank
+dumps), ``3`` hang diagnosed without a desync — a desync found
+alongside a hang exits 1, since the desync is the root cause.
+
 Ingests everything a ``--telemetry-dir`` run leaves behind:
 
 - ``telemetry_rank_<r>[.restart<k>].json`` snapshots (+ their
@@ -529,7 +536,8 @@ def main(argv=None) -> int:
                     help="merged Perfetto trace path "
                     "(default <dir>/merged.trace.json)")
     ap.add_argument("--strict", action="store_true",
-                    help="exit 1 when a desync or hang was found")
+                    help="fail on findings: exit 1 on desync, 3 on hang "
+                    "(desync wins when both); 0 clean, 2 input error")
     args = ap.parse_args(argv)
 
     d = Path(args.dir)
@@ -551,10 +559,20 @@ def main(argv=None) -> int:
         print(line)
     print(f"report: {out}")
     print(f"merged trace: {trace_path}")
-    if args.strict and (
-        report["desync"]["status"] != "none" or report["hangs"]
-    ):
-        return 1
+    # Exit-code contract (CI composes this with `tpu-lint --strict`,
+    # the static half of the same bug classes):
+    #   0 — analysis ran; without --strict always, with --strict clean
+    #   1 — --strict: cross-rank desync detected (also when a hang was
+    #       found alongside it: the desync is the root cause to chase)
+    #   2 — usage/input error (no telemetry_rank_*.json dumps)
+    #   3 — --strict: hang diagnosed (watchdog reports), no desync
+    if args.strict:
+        if report["desync"]["status"] != "none":
+            print("strict: failing on desync", file=sys.stderr)
+            return 1
+        if report["hangs"]:
+            print("strict: failing on hang diagnosis", file=sys.stderr)
+            return 3
     return 0
 
 

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..analysis import lockmon as _lockmon
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -83,7 +84,9 @@ class FlightRecorder:
     """Bounded ring of structured dispatch entries + per-comm seq state."""
 
     def __init__(self, capacity: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock(
+            "flightrecorder.py:FlightRecorder._lock"
+        )
         self._buf: deque = deque(maxlen=int(capacity))
         self._seqs: Dict[str, int] = {}
         self.total_recorded = 0

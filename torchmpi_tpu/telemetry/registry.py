@@ -24,6 +24,7 @@ clears the recorded series but never invalidates the objects.
 from __future__ import annotations
 
 import threading
+from ..analysis import lockmon as _lockmon
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 # Default histogram boundaries: latency-shaped, spanning 10µs .. 100s.
@@ -58,7 +59,7 @@ class _Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock("registry.py:_Metric._lock")
         self._series: Dict[Tuple[Tuple[str, str], ...], object] = {}
 
     def reset(self) -> None:
@@ -249,7 +250,7 @@ class MetricsRegistry:
     """Name -> metric table plus pluggable snapshot collectors."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock("registry.py:MetricsRegistry._lock")
         self._metrics: Dict[str, _Metric] = {}
         self._collectors: Dict[str, Callable[[], dict]] = {}
 

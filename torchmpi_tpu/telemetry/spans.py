@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..analysis import lockmon as _lockmon
 import time
 from collections import deque
 from typing import Optional
@@ -48,7 +49,7 @@ class SpanRecorder:
     """Bounded ring buffer of completed spans (oldest evicted first)."""
 
     def __init__(self, capacity: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock("spans.py:SpanRecorder._lock")
         self._buf: deque = deque(maxlen=int(capacity))
         self.total_recorded = 0
         # spans evicted by ring wrap-around: > 0 means the exported trace

@@ -40,6 +40,7 @@ from pathlib import Path
 from typing import Optional
 
 from . import flightrecorder as _flight
+from ..analysis import lockmon as _lockmon
 
 
 def _env_rank() -> Optional[int]:
@@ -273,7 +274,7 @@ class Watchdog:
         return path
 
 
-_lock = threading.Lock()
+_lock = _lockmon.make_lock("watchdog.py:_lock")
 _active: Optional[Watchdog] = None
 
 

@@ -23,6 +23,7 @@ import os
 import sys
 import threading
 import time
+from ..analysis import lockmon as _lockmon
 from pathlib import Path
 from typing import Dict, Optional, Tuple
 
@@ -148,7 +149,9 @@ class WireByteCounters:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockmon.make_lock(
+            "tracing.py:WireByteCounters._lock"
+        )
         self.reset()
 
     def reset(self) -> None:
