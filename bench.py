@@ -1181,6 +1181,428 @@ def _ps_microbench(check: bool = False, rounds: int = 8,
     return 0
 
 
+class _FleetClient:
+    """One downpour-shaped loopback client for ``--ps-fleet``: a raw
+    non-blocking socket + tiny reply parser, driven entirely by the
+    fleet's selector loop — no thread per client, so 10k of them cost
+    10k fds and ~nothing else. Cycle: 4 UPDATEs (push "gradients") then
+    1 TRIGGER (fetch the "center"), the Downpour traffic shape. BUSY
+    replies re-send the SAME frame after the server's retry-after hint
+    with exponential growth (same contract as ``_PeerChannel``)."""
+
+    __slots__ = (
+        "cid", "inst_id", "payload", "sock", "seq", "sendbuf",
+        "cycle_pos", "phase", "head", "head_fields", "body_need", "body",
+        "t_send", "busy_attempts", "acked_updates", "acked_fetches",
+        "stop_issuing", "idle", "last_frame", "errors", "lat",
+    )
+
+    _CYCLE = ("u", "u", "u", "u", "f")
+
+    def __init__(self, cid: int, inst_id: int, payload: bytes):
+        self.cid = cid
+        self.inst_id = inst_id
+        self.payload = payload
+        self.sock = None
+        self.seq = 0
+        self.sendbuf = b""
+        self.cycle_pos = 0
+        self.phase = "connect"
+        self.head = b""
+        self.head_fields = None
+        self.body_need = 0
+        self.body = b""
+        self.t_send = 0.0
+        self.busy_attempts = 0
+        self.acked_updates = 0
+        self.acked_fetches = 0
+        self.stop_issuing = False
+        self.idle = False
+        self.last_frame = b""
+        self.errors = []
+        self.lat = None  # set to the shared latency list during the window
+
+    def connect(self, sel, port) -> None:
+        import selectors
+        import socket
+
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setblocking(False)
+        self.sock.connect_ex(("127.0.0.1", port))
+        sel.register(self.sock, selectors.EVENT_WRITE, self)
+
+    def _issue(self, sel) -> None:
+        from torchmpi_tpu.parameterserver import transport as T
+
+        if self.stop_issuing:
+            self.idle = True
+            return
+        kind_c = self._CYCLE[self.cycle_pos % len(self._CYCLE)]
+        self.cycle_pos += 1
+        self.seq += 1
+        if kind_c == "u":
+            frame = T._frame_bytes(
+                T._KIND_UPDATE, inst=self.inst_id, rank=0, client=self.cid,
+                seq=self.seq, rule="add", dtype="<f4", payload=self.payload,
+            )
+        else:
+            frame = T._frame_bytes(
+                T._KIND_TRIGGER, inst=self.inst_id, rank=0, client=self.cid,
+                seq=self.seq,
+            )
+        self.busy_attempts = 0
+        self.last_frame = frame
+        self.t_send = time.perf_counter()
+        self._send(sel, frame)
+
+    def _send(self, sel, frame: bytes) -> None:
+        import selectors
+
+        self.phase = "head"
+        self.head = b""
+        self.sendbuf += frame
+        try:
+            n = self.sock.send(self.sendbuf)
+            self.sendbuf = self.sendbuf[n:]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError as e:
+            self.errors.append(f"send: {e}")
+            self.idle = True
+            return
+        sel.modify(
+            self.sock,
+            selectors.EVENT_READ
+            | (selectors.EVENT_WRITE if self.sendbuf else 0),
+            self,
+        )
+
+    def on_event(self, sel, mask, retries) -> None:
+        """Advance the client state machine on socket readiness."""
+        import selectors
+
+        from torchmpi_tpu.parameterserver import transport as T
+
+        import socket as _socket
+
+        if self.phase == "connect" and mask & selectors.EVENT_WRITE:
+            err = self.sock.getsockopt(_socket.SOL_SOCKET, _socket.SO_ERROR)
+            if err:
+                self.errors.append(f"connect: errno {err}")
+                self.idle = True
+                sel.unregister(self.sock)
+                return
+            sel.modify(self.sock, selectors.EVENT_READ, self)
+            self._issue(sel)
+            return
+        if mask & selectors.EVENT_WRITE and self.sendbuf:
+            try:
+                n = self.sock.send(self.sendbuf)
+                self.sendbuf = self.sendbuf[n:]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError as e:
+                self.errors.append(f"send: {e}")
+                self.idle = True
+                return
+            if not self.sendbuf:
+                sel.modify(self.sock, selectors.EVENT_READ, self)
+        if not mask & selectors.EVENT_READ:
+            return
+        while True:
+            if self.phase not in ("head", "body"):
+                return  # backoff / idle: nothing in flight to parse
+            if self.phase == "head":
+                need = T._HEADER.size - len(self.head)
+                data = self._recv(need)
+                if data is None:
+                    return
+                self.head += data
+                if len(self.head) < T._HEADER.size:
+                    return
+                (_m, kind, _i, _r, _c, rseq, _oseq, _fp, _tok, _w, _nc,
+                 rl, dl, pl) = T._HEADER.unpack(self.head)
+                self.body_need = rl + dl + pl
+                self.body = b""
+                self.phase = "body"
+                self.head_fields = (kind, rl, dl, pl)
+            if self.phase == "body":
+                if self.body_need > len(self.body):
+                    data = self._recv(self.body_need - len(self.body))
+                    if data is None:
+                        return
+                    self.body += data
+                    if len(self.body) < self.body_need:
+                        return
+                self._on_reply(sel, retries)
+                if self.phase != "head" or self.idle:
+                    return
+
+    def _recv(self, n: int):
+        try:
+            data = self.sock.recv(n)
+        except (BlockingIOError, InterruptedError):
+            return None
+        except OSError as e:
+            self.errors.append(f"recv: {e}")
+            self.idle = True
+            return None
+        if not data:
+            self.errors.append("server closed connection")
+            self.idle = True
+            return None
+        return data
+
+    def _on_reply(self, sel, retries) -> None:
+        import heapq
+
+        from torchmpi_tpu.parameterserver import transport as T
+
+        kind, rl, dl, pl = self.head_fields
+        if kind == T._KIND_BUSY:
+            # retry the SAME frame (it was never applied) after the
+            # server's hint, growing exponentially like _PeerChannel
+            self.busy_attempts += 1
+            try:
+                hint_ms = int(self.body[:rl].decode() or "20")
+            except ValueError:
+                hint_ms = 20
+            delay = min(
+                2.0, hint_ms / 1000.0 * (1 << min(self.busy_attempts - 1, 6))
+            )
+            heapq.heappush(
+                retries, (time.monotonic() + delay, self.cid, self)
+            )
+            self.phase = "backoff"
+            return
+        if kind == T._KIND_ERROR:
+            self.errors.append(self.body[:rl].decode(errors="replace"))
+            self.idle = True
+            return
+        if self.lat is not None:
+            self.lat.append(time.perf_counter() - self.t_send)
+        if kind == T._KIND_ACK:
+            self.acked_updates += 1
+        elif kind == T._KIND_SHARD:
+            self.acked_fetches += 1
+        self._issue(sel)
+
+    def retry(self, sel) -> None:
+        """Re-send the BUSY-rejected frame (scheduled by the retry heap)."""
+        if self.idle or self.sock is None:
+            return
+        self.t_send = time.perf_counter()
+        self._send(sel, self.last_frame)
+
+    def close(self, sel) -> None:
+        try:
+            sel.unregister(self.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _fleet_point(lst, inst, n_clients: int, window_s: float,
+                 payload: bytes, cid_base: int = 0):
+    """Drive ``n_clients`` concurrent downpour clients against the
+    listener for one scalability-curve point. Returns the point dict
+    plus the number of update-acks added to the shard's expected sum.
+    ``cid_base`` keeps client ids globally unique across points: the
+    listener's dedup high-water is keyed by (inst, rank, client), so a
+    reused client id with a reset per-connection seq would be answered
+    as a replay (ACK without apply) and corrupt the audit."""
+    import selectors
+    import threading
+
+    sel = selectors.DefaultSelector()
+    clients = [
+        _FleetClient(cid_base + i + 1, inst.id, payload)
+        for i in range(n_clients)
+    ]
+    retries: list = []
+    # staggered non-blocking connects: the selector completes them as the
+    # listener accepts (ps_listen_backlog absorbs each burst)
+    for i in range(0, n_clients, 512):
+        for c in clients[i:i + 512]:
+            c.connect(sel, lst.port)
+        _fleet_spin(sel, retries, 0.2)
+    # warm until every live client completed at least one RPC
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and any(
+        c.acked_updates + c.acked_fetches == 0 and not c.idle
+        for c in clients
+    ):
+        _fleet_spin(sel, retries, 0.1)
+    lat: list = []
+    base = sum(c.acked_updates + c.acked_fetches for c in clients)
+    for c in clients:
+        c.lat = lat
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < window_s:
+        _fleet_spin(sel, retries, 0.05)
+    window = time.monotonic() - t0
+    done = sum(c.acked_updates + c.acked_fetches for c in clients) - base
+    for c in clients:
+        c.lat = None
+        c.stop_issuing = True
+    # drain in-flight requests so the exactly-once audit sees a quiet
+    # server: a client goes idle when its outstanding reply arrives (or
+    # its BUSY retry completes) and _issue observes stop_issuing
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and not all(c.idle for c in clients):
+        _fleet_spin(sel, retries, 0.05)
+    errors = [e for c in clients for e in c.errors]
+    for c in clients:
+        c.close(sel)
+    sel.close()
+    lat.sort()
+    acked_updates = sum(c.acked_updates for c in clients)
+
+    def pct(p):
+        return round(lat[int(p * (len(lat) - 1))] * 1e3, 3) if lat else None
+
+    tm_threads = sum(
+        1 for t in threading.enumerate() if t.name.startswith("tm-ps")
+    )
+    return {
+        "clients": n_clients,
+        "rpc_per_s": round(done / window, 1),
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "rpcs_measured": done,
+        "acked_updates_total": acked_updates,
+        "busy_rejected_total": lst._busy_rejects,
+        "server_tm_threads": tm_threads,
+        "client_errors": errors[:5],
+    }, acked_updates
+
+
+def _fleet_spin(sel, retries, budget_s: float) -> None:
+    """One bounded pump of the fleet selector loop + due BUSY retries."""
+    import heapq
+
+    deadline = time.monotonic() + budget_s
+    while True:
+        now = time.monotonic()
+        if now >= deadline:
+            return
+        timeout = deadline - now
+        if retries:
+            timeout = min(timeout, max(0.0, retries[0][0] - now))
+        for key, mask in sel.select(timeout):
+            key.data.on_event(sel, mask, retries)
+        now = time.monotonic()
+        while retries and retries[0][0] <= now:
+            _, _, client = heapq.heappop(retries)
+            client.retry(sel)
+
+
+def _ps_fleet(check: bool = False, clients: str = "", window_s: float = 1.2):
+    """``--ps-fleet``: the PS fabric scalability curve. Drives N
+    concurrent downpour-shaped loopback clients (N from
+    TORCHMPI_TPU_PS_FLEET_CLIENTS or 32,256,1024) against ONE
+    event-multiplexed listener + the real mailbox/apply path, and prints
+    a JSON curve of throughput + tail latency vs N. Every point also
+    audits exactly-once apply: each update adds 1.0 to every element of
+    the shard, so after quiescing, every shard element must equal the
+    total number of acked updates — a lost update shows as a deficit, a
+    double-apply as an excess. ``check`` additionally gates (CI smoke):
+
+    - zero lost / double-applied updates at every point;
+    - throughput at 256 clients within 2x of the 32-client point (the
+      event loop serves a 8x fleet without collapsing);
+    - server thread count INDEPENDENT of client count (no
+      thread-per-connection regression).
+
+    Pure host path — no jax backend, survives a dead TPU tunnel."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _server
+
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except Exception:  # noqa: BLE001 - best-effort fd headroom
+        pass
+    spec = clients or os.environ.get(
+        "TORCHMPI_TPU_PS_FLEET_CLIENTS", "32,256,1024"
+    )
+    ns = [int(x) for x in spec.split(",") if x.strip()]
+    elems = 256
+    payload = np.ones(elems, np.float32).tobytes()
+    prev_backlog = constants.get("ps_listen_backlog")
+    constants.set("ps_listen_backlog", max(prev_backlog, 1024))
+    inst = _server.register(np.zeros(elems, np.float32), 1)
+    lst = T._Listener(lambda i: inst if i == inst.id else None)
+    points = []
+    expected = 0
+    audits_ok = True
+    cid_base = 0
+    try:
+        for n in ns:
+            point, acked = _fleet_point(
+                lst, inst, n, window_s, payload, cid_base
+            )
+            cid_base += n
+            expected += acked
+            # exactly-once audit against the cumulative expected sum
+            shard = inst.read_shard(0)
+            lost = int(round(expected - float(shard.min())))
+            double = int(round(float(shard.max()) - expected))
+            point["lost_updates"] = max(lost, 0)
+            point["double_applied"] = max(double, 0)
+            audits_ok &= lost == 0 and double == 0
+            points.append(point)
+    finally:
+        lst.close()
+        _server.unregister(inst)
+        constants.set("ps_listen_backlog", prev_backlog)
+    by_n = {p["clients"]: p for p in points}
+    line = {
+        "metric": "PS fleet scalability (concurrent downpour clients vs "
+        "one event-multiplexed server group)",
+        "unit": "RPC/s",
+        "platform": "cpu",
+        "payload_elems": elems,
+        "window_s": window_s,
+        "points": points,
+        "value": max((p["rpc_per_s"] for p in points), default=0),
+        "max_clients_sustained": max(
+            (p["clients"] for p in points
+             if p["rpcs_measured"] > 0 and not p["client_errors"]),
+            default=0,
+        ),
+    }
+    print(json.dumps(line), flush=True)
+    if not check:
+        return 0
+    ok = audits_ok and all(not p["client_errors"] for p in points)
+    if 32 in by_n and 256 in by_n:
+        ok &= by_n[256]["rpc_per_s"] >= by_n[32]["rpc_per_s"] / 2.0
+    # thread-per-connection regression guard: server-side tm-ps threads
+    # are bounded by loop + global server + apply pool (+ slack), a
+    # constant INDEPENDENT of client count — the old design needed one
+    # reader thread per client and would show ~N here
+    ok &= all(p["server_tm_threads"] <= 14 for p in points)
+    if not ok:
+        print(
+            f"# ps fleet smoke FAILED: audits_ok={audits_ok} points="
+            f"{json.dumps(points)}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return 0 if ok else 1
+
+
 def main(argv=None):
     import argparse
 
@@ -1226,15 +1648,37 @@ def main(argv=None):
         "jax backend needed; prints one JSON line",
     )
     ap.add_argument(
+        "--ps-fleet",
+        action="store_true",
+        help="parameter-server fleet scalability curve: N concurrent "
+        "downpour-shaped loopback clients (N from "
+        "TORCHMPI_TPU_PS_FLEET_CLIENTS, default 32,256,1024) against one "
+        "event-multiplexed server group; prints one JSON line with "
+        "throughput + p50/p99 latency per point and an exactly-once "
+        "apply audit — pure host path, no jax backend",
+    )
+    ap.add_argument(
+        "--fleet-clients",
+        default="",
+        help="with --ps-fleet: comma-separated client counts for the "
+        "curve (overrides TORCHMPI_TPU_PS_FLEET_CLIENTS)",
+    )
+    ap.add_argument(
         "--check",
         action="store_true",
         help="with --microbench: exit 1 unless fused dispatch <= unfused "
         "and precompile() eliminated warm-path compiles; with "
         "--ps-microbench: exit 1 unless int8 wire moves >= 2x the "
         "effective logical bytes/sec of fp32 and every decoded fetch is "
-        "within its encoding's error bound (CI perf-smoke)",
+        "within its encoding's error bound; with --ps-fleet: exit 1 on "
+        "any lost/double-applied update, 256-client throughput below "
+        "half the 32-client point, or server thread growth with client "
+        "count (CI perf-smoke)",
     )
     args = ap.parse_args(argv)
+
+    if args.ps_fleet:
+        return _ps_fleet(check=args.check, clients=args.fleet_clients)
 
     if args.ps_microbench:
         return _ps_microbench(check=args.check)

@@ -46,6 +46,13 @@ run_perf_smoke() {
     # encoding's error bound. Pure host path — no jax backend.
     echo "=== perf-smoke (parameter-server wire microbench, CPU) ==="
     python bench.py --ps-microbench --check
+    # PS fabric fleet smoke: the event-multiplexed listener must serve a
+    # bounded synthetic downpour fleet (32 -> 256 clients, throughput
+    # within 2x; the 1024-client point proves >= 1000 concurrent clients
+    # on O(pools) server threads) with ZERO lost or double-applied
+    # updates — the scalability-curve JSON is the CI-captured evidence.
+    echo "=== perf-smoke (parameter-server fleet scalability, CPU) ==="
+    python bench.py --ps-fleet --check
     # flight-recorder/analyzer smoke: a short 2-proc job with telemetry on
     # must yield a merged per-rank Perfetto trace and a clean
     # `desync: none` analyzer report.

@@ -78,3 +78,52 @@ def test_start_unknown_override_rejected_before_state_change():
     assert not mpi.started()
     mpi.start()  # a corrected retry works
     mpi.stop()
+
+def test_env_constant_overrides(monkeypatch):
+    """`launch --set-constant NAME=VALUE` reaches the rank through
+    TORCHMPI_TPU_CONSTANTS, with type coercion; explicit start()
+    overrides beat it; unknown names fail loudly before any state."""
+    import torchmpi_tpu as mpi
+
+    monkeypatch.setenv(
+        "TORCHMPI_TPU_CONSTANTS",
+        "ps_replication=2;ps_prefetch=false;wire_dtype=bf16",
+    )
+    mpi.start(wire_dtype="int8")  # explicit beats launcher
+    try:
+        assert constants.get("ps_replication") == 2
+        assert constants.get("ps_prefetch") is False
+        assert constants.get("wire_dtype") == "int8"
+    finally:
+        mpi.stop()
+
+
+def test_env_constant_unknown_name_rejected(monkeypatch):
+    import torchmpi_tpu as mpi
+
+    monkeypatch.setenv("TORCHMPI_TPU_CONSTANTS", "not_a_knob=1")
+    with pytest.raises(KeyError):
+        mpi.start()
+    assert not mpi.started()
+    monkeypatch.delenv("TORCHMPI_TPU_CONSTANTS")
+    mpi.start()
+    mpi.stop()
+
+
+def test_env_constant_bad_bool_rejected(monkeypatch):
+    """A typo'd bool value ('ture', '2') must fail loudly, not launch a
+    silently-misconfigured world as False."""
+    import torchmpi_tpu as mpi
+
+    monkeypatch.setenv("TORCHMPI_TPU_CONSTANTS", "ps_prefetch=ture")
+    with pytest.raises(ValueError):
+        mpi.start()
+    assert not mpi.started()
+    monkeypatch.setenv("TORCHMPI_TPU_CONSTANTS", "ps_prefetch=off")
+    mpi.start()
+    try:
+        from torchmpi_tpu import constants
+
+        assert constants.get("ps_prefetch") is False
+    finally:
+        mpi.stop()

@@ -1447,3 +1447,579 @@ def test_transport_delta_snapshots_keyed_by_origin_process():
     finally:
         lst.close()
         _server.unregister(inst)
+
+
+# ---------------------------------------------------------------------------
+# PS fabric: event-multiplexed listener, admission control, replication
+# ---------------------------------------------------------------------------
+
+
+def test_listener_multiplexed_dribble_frame():
+    """A client dribbling a frame byte-by-byte must not stall anyone
+    else: the event loop's per-connection state machine parks the
+    partial frame while OTHER clients' RPCs complete on the same single
+    loop thread (the head-of-line property thread-per-connection had
+    per thread, now with O(1) threads)."""
+    import socket
+    import threading
+    import time
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applied = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            applied.append(msg.client)
+            msg.done.set()
+
+    lst = T._Listener(lambda i: FakeInst())
+    try:
+        payload = np.ones(8, np.float32)
+        dribble = T._frame_bytes(
+            T._KIND_UPDATE, inst=1, rank=0, client=77, seq=1, rule="add",
+            dtype=payload.dtype.str, payload=payload.tobytes(),
+        )
+        slow = socket.create_connection(("localhost", lst.port), timeout=10)
+        slow.settimeout(10)
+        fast = socket.create_connection(("localhost", lst.port), timeout=10)
+        fast.settimeout(10)
+        fast_done = []
+
+        def dribbler():
+            for i in range(len(dribble)):
+                slow.sendall(dribble[i:i + 1])
+                time.sleep(0.002)
+
+        t = threading.Thread(target=dribbler, daemon=True)
+        t.start()
+        # while the dribble is in progress, the fast client completes
+        # many full round trips through the SAME loop thread
+        for seq in range(1, 11):
+            T._send_frame(
+                fast, T._KIND_UPDATE, inst=1, rank=0, client=5, seq=seq,
+                rule="add", dtype=payload.dtype.str,
+                payload=payload.tobytes(),
+            )
+            assert T._recv_frame(fast)[0] == T._KIND_ACK
+            fast_done.append(time.monotonic())
+        assert t.is_alive(), "fast client should finish before the dribble"
+        t.join(30)
+        assert T._recv_frame(slow)[0] == T._KIND_ACK
+        assert applied.count(5) == 10 and applied.count(77) == 1
+        slow.close()
+        fast.close()
+    finally:
+        lst.close()
+
+
+def test_listener_client_dies_mid_chunk_event_loop():
+    """A client that dies mid-chunk-container must not apply anything
+    (the frame never completed), must be reaped (connection gauge back
+    down), and must not disturb a concurrent healthy client."""
+    import socket
+    import time
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T, wire as W
+
+    applied = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            applied.append(np.asarray(msg.payload).sum())
+            msg.done.set()
+
+    lst = T._Listener(lambda i: FakeInst())
+    try:
+        n = 1 << 16
+        block = constants.get("wire_quant_block_size")
+        chunk_bytes = 4096
+        total, nchunks = W.container_nbytes(n, W.WIRE_INT8, block,
+                                            chunk_bytes)
+        assert nchunks > 1
+        header, rule_b, dtype_b = T._frame_header(
+            T._KIND_UPDATE, 1, 0, 0, 3, 0, W.WIRE_INT8, nchunks,
+            "add", "<f4", total,
+        )
+        chunks = list(W.iter_encoded_chunks(
+            np.ones(n, np.float32), W.WIRE_INT8, block, chunk_bytes
+        ))
+        first = b"".join(bytes(memoryview(b).cast("B")) for b in chunks[0])
+        dying = socket.create_connection(("localhost", lst.port), timeout=10)
+        dying.sendall(header + rule_b + dtype_b + first)  # 1 of N chunks
+        time.sleep(0.2)
+        dying.close()  # mid-container EOF
+        # healthy client unaffected; the torn frame never applied
+        s = socket.create_connection(("localhost", lst.port), timeout=10)
+        s.settimeout(10)
+        payload = np.full(4, 2.0, np.float32)
+        T._send_frame(
+            s, T._KIND_UPDATE, inst=1, rank=0, client=9, seq=1, rule="add",
+            dtype=payload.dtype.str, payload=payload.tobytes(),
+        )
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        assert applied == [8.0], applied
+        s.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            stats = {}
+            q = getattr(lst._pool, "_work_queue", None)
+            if lst._loop.connection_count() == 0:
+                break
+            time.sleep(0.05)
+        assert lst._loop.connection_count() == 0
+        assert lst._disconnects >= 2 and lst._accepts >= 2
+    finally:
+        lst.close()
+
+
+def test_busy_backpressure_roundtrip():
+    """With a tiny admission budget and a slow apply, concurrent updates
+    get BUSY/retry-after replies; the _PeerChannel retries them with
+    backoff TRANSPARENTLY and every update applies exactly once."""
+    import threading
+    import time
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applies = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                time.sleep(0.05)
+                applies.append(float(np.asarray(msg.payload).sum()))
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    prev = constants.get("ps_pending_frame_budget")
+    constants.set("ps_pending_frame_budget", 1)
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        errors = []
+
+        def one(i):
+            try:
+                ch.request(
+                    T._KIND_UPDATE, 1, 0, i, rule="add",
+                    payload_arr=np.full(2, float(i), np.float32),
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        assert sorted(applies) == [2.0 * i for i in range(8)], sorted(applies)
+        assert lst._busy_rejects > 0  # backpressure actually engaged
+    finally:
+        ch.close()
+        lst.close()
+        constants.set("ps_pending_frame_budget", prev)
+
+
+def test_busy_order_fence_on_connection():
+    """Once an UPDATE is BUSY-rejected, later pipelined UPDATEs on the
+    same connection are rejected too (even with budget available) until
+    the first rejected seq retries — so retried updates can never apply
+    out of their assignment order."""
+    import socket
+    import threading
+    import time
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    release = threading.Event()
+    applied = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                release.wait(30)
+                applied.append(float(np.asarray(msg.payload).sum()))
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    prev = constants.get("ps_pending_frame_budget")
+    constants.set("ps_pending_frame_budget", 1)
+    lst = T._Listener(lambda i: FakeInst())
+    try:
+        s = socket.create_connection(("localhost", lst.port), timeout=10)
+        s.settimeout(10)
+        p = np.ones(1, np.float32)
+        kw = dict(inst=1, rank=0, client=0, rule="add",
+                  dtype=p.dtype.str, payload=p.tobytes())
+        T._send_frame(s, T._KIND_UPDATE, seq=1, **kw)  # admitted (budget 1)
+        time.sleep(0.1)
+        T._send_frame(s, T._KIND_UPDATE, seq=2, **kw)  # over budget: BUSY
+        assert T._recv_frame(s)[0] == T._KIND_BUSY
+        release.set()  # seq 1 applies; budget frees
+        assert T._recv_frame(s)[0] == T._KIND_ACK  # seq 1's ack
+        time.sleep(0.3)
+        # seq 3 arrives with budget available — but the order fence is
+        # armed at seq 2: it must be BUSY'd, not admitted ahead of seq 2
+        T._send_frame(s, T._KIND_UPDATE, seq=3, **kw)
+        assert T._recv_frame(s)[0] == T._KIND_BUSY
+        # the retry of seq 2 clears the fence and applies...
+        T._send_frame(s, T._KIND_UPDATE, seq=2, **kw)
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        # ...and seq 3's retry is then admitted normally
+        T._send_frame(s, T._KIND_UPDATE, seq=3, **kw)
+        assert T._recv_frame(s)[0] == T._KIND_ACK
+        assert len(applied) == 3
+        s.close()
+    finally:
+        lst.close()
+        constants.set("ps_pending_frame_budget", prev)
+
+
+def test_ps_listen_backlog_knob(monkeypatch):
+    """ps_listen_backlog reaches the listener's listen(2) call."""
+    import socket
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    seen = []
+    real_listen = socket.socket.listen
+
+    def spy(self, backlog):
+        seen.append(backlog)
+        return real_listen(self, backlog)
+
+    monkeypatch.setattr(socket.socket, "listen", spy)
+    prev = constants.get("ps_listen_backlog")
+    constants.set("ps_listen_backlog", 131)
+    try:
+        lst = T._Listener(lambda i: None)
+        lst.close()
+    finally:
+        constants.set("ps_listen_backlog", prev)
+    assert 131 in seen
+
+
+def test_connection_lifecycle_stats_and_telemetry():
+    """The ps_listener collector reports connection lifecycle counts and
+    the admitted-frame backlog; with telemetry on, the labelled
+    gauge/counters and the server-side queue/apply histograms record."""
+    import socket
+
+    from torchmpi_tpu import telemetry
+    from torchmpi_tpu.parameterserver import transport as T
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            msg.done.set()
+
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        T._SRV_MET = None  # re-resolve handles against the fresh registry
+        lst = T._Listener(lambda i: FakeInst())
+        try:
+            p = np.ones(2, np.float32)
+            socks = []
+            for cid in (1, 2):
+                s = socket.create_connection(
+                    ("localhost", lst.port), timeout=10
+                )
+                s.settimeout(10)
+                socks.append(s)
+                T._send_frame(
+                    s, T._KIND_UPDATE, inst=1, rank=0, client=cid, seq=1,
+                    rule="add", dtype=p.dtype.str, payload=p.tobytes(),
+                )
+                assert T._recv_frame(s)[0] == T._KIND_ACK
+            from torchmpi_tpu.telemetry import metrics as reg
+
+            snap = reg.snapshot()
+            stats = snap["ps_listener"]
+            assert stats["accepted"] >= 2
+            assert stats["connections"] >= 2
+            assert stats["pending_frames"] == 0  # all replied
+            label = f"listener={lst.port}"
+            assert snap["tm_ps_accepts_total"]["series"][label] >= 2
+            assert snap["tm_ps_connections_open"]["series"][label] >= 2
+            qh = snap["tm_ps_server_queue_seconds"]["series"]["kind=update"]
+            ah = snap["tm_ps_server_apply_seconds"]["series"]["kind=update"]
+            assert qh["count"] >= 2 and ah["count"] >= 2
+            for s in socks:
+                s.close()
+            import time as _time
+
+            deadline = _time.monotonic() + 5
+            while _time.monotonic() < deadline:
+                if reg.snapshot()["ps_listener"]["disconnected"] >= 2:
+                    break
+                _time.sleep(0.05)
+            assert reg.snapshot()["ps_listener"]["disconnected"] >= 2
+        finally:
+            lst.close()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+        T._SRV_MET = None
+
+
+def test_instance_replica_chain_layout():
+    """Replica chains derive deterministically from (owners, knob):
+    head = owner, successors = next distinct procs in ring order;
+    replicas allocate real storage; the fingerprint pins the layout."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver.server import _Instance
+    from torchmpi_tpu.parameterserver.transport import instance_fingerprint
+
+    prev = constants.get("ps_replication")
+    constants.set("ps_replication", 2)
+    try:
+        full = np.arange(8, dtype=np.float32)
+        a = _Instance(7, full, 2, owners=[0, 1], my_proc=0)
+        b = _Instance(7, full, 2, owners=[0, 1], my_proc=1)
+        assert a.chains == [[0, 1], [1, 0]] and b.chains == a.chains
+        # head stores its own shard AND its replica shard
+        assert a.has_storage(0) and a.has_storage(1)
+        assert b.has_storage(0) and b.has_storage(1)
+        assert a.is_local(0) and not a.is_local(1)
+        # chain successor: head forwards to the replica; replica is tail
+        assert a.next_in_chain(0) == 1 and a.next_in_chain(1) is None
+        assert b.next_in_chain(1) == 0 and b.next_in_chain(0) is None
+        # replicated layout fingerprints differently from unreplicated
+        assert a.fingerprint == b.fingerprint
+        assert a.fingerprint != instance_fingerprint(
+            full.shape, full.dtype, 2, [0, 1], a.shard_rotation, 1
+        )
+    finally:
+        constants.set("ps_replication", prev)
+
+
+def _chain_listener(inst_map, forward=None):
+    from torchmpi_tpu.parameterserver import transport as T
+
+    return T._Listener(lambda i: inst_map.get(i))
+
+
+def test_replica_chain_failover_exactly_once():
+    """THE failover acceptance test: a 2-process replica chain
+    [head, replica] with chained forwarding; the head is killed
+    MID-STREAM; the client fails over to the replica, re-issuing
+    unacknowledged updates with their origin seqs — and the surviving
+    replica's state matches the expected apply sequence exactly (no
+    lost updates, no double-applies), because forwarded frames carried
+    the same (client, oseq) dedup identity the re-issues use."""
+    import threading
+    import time
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import (
+        _Instance, _Message, _ReplicaPump,
+    )
+
+    prev = constants.get("ps_replication")
+    constants.set("ps_replication", 2)
+    try:
+        full = np.zeros(4, np.float32)  # 2 ranks x 2-element shards
+        # "process 1" (the replica): a real _Instance + its own listener
+        inst_b = _Instance(3, full, 2, owners=[0, 1], my_proc=1)
+        lst_b = _chain_listener({3: inst_b})
+        # "process 0" (the head): real _Instance + listener + a pump
+        # forwarding rank-0 applies to the replica over a real channel
+        inst_a = _Instance(3, full, 2, owners=[0, 1], my_proc=0)
+        lst_a = _chain_listener({3: inst_a})
+        pool = T._PeerPool({1: ("127.0.0.1", lst_b.port)})
+
+        def forward(succ, r, msg):
+            pool.request(
+                succ, T._KIND_UPDATE, 3, r, msg.client,
+                rule=msg.rule, payload_arr=np.asarray(msg.payload),
+                oseq=msg.oseq,
+            )
+
+        inst_a.attach_replication(forward)
+        assert inst_a._pump is not None
+        # drive both instances' mailboxes like the global server thread
+        stop = threading.Event()
+
+        def serve():
+            while not stop.is_set():
+                worked = inst_a.serve_once() | inst_b.serve_once()
+                if not worked:
+                    time.sleep(0.0005)
+
+        server_thread = threading.Thread(target=serve, daemon=True)
+        server_thread.start()
+
+        # the client: sends updates to the HEAD, with origin seqs — the
+        # replicated-update path Transport.update takes
+        ch_a = T._PeerChannel({0: ("127.0.0.1", lst_a.port)}, 0)
+        ch_b = T._PeerChannel({1: ("127.0.0.1", lst_b.port)}, 1)
+        acked = []
+        unacked = []
+        killed = threading.Event()
+
+        def client():
+            for oseq in range(1, 25):
+                payload = np.full(2, float(oseq), np.float32)
+                try:
+                    ch_a.request(
+                        T._KIND_UPDATE, 3, 0, 0, rule="add",
+                        payload_arr=payload, oseq=oseq,
+                    )
+                    acked.append(oseq)
+                except Exception:  # noqa: BLE001 - head died mid-stream
+                    unacked.append(oseq)
+                if oseq == 10:
+                    killed.set()  # signal the main thread to kill the head
+                    time.sleep(0.3)
+
+        ct = threading.Thread(target=client, daemon=True)
+        ct.start()
+        assert killed.wait(30)
+        lst_a.close()  # kill the head server mid-stream
+        ct.join(60)
+        assert unacked, "the kill must have interrupted some updates"
+        # failover: re-issue every unacknowledged update to the replica
+        # with the SAME origin seq (what Transport.update does when the
+        # chain head raises ConnectionError)
+        for oseq in unacked:
+            payload = np.full(2, float(oseq), np.float32)
+            ch_b.request(
+                T._KIND_UPDATE, 3, 0, 0, rule="add",
+                payload_arr=payload, oseq=oseq,
+            )
+        # ... and a duplicate re-issue of an ACKED update (an ack whose
+        # delivery raced the kill): the replica's high-water dedups it
+        if acked:
+            dup = acked[-1]
+            ch_b.request(
+                T._KIND_UPDATE, 3, 0, 0, rule="add",
+                payload_arr=np.full(2, float(dup), np.float32), oseq=dup,
+            )
+        # the surviving replica's state == every update applied exactly
+        # once: sum over oseq 1..24 of full(oseq)
+        time.sleep(0.2)
+        expected = float(sum(range(1, 25)))
+        shard = inst_b.read_shard(0)
+        np.testing.assert_allclose(shard, np.full(2, expected))
+        # fetch failover: the replica serves the FETCH the head no
+        # longer can (Transport.trigger walks the same chain)
+        got = ch_b.request(T._KIND_TRIGGER, 3, 0, 0)
+        np.testing.assert_allclose(got, np.full(2, expected))
+        stop.set()
+        server_thread.join(10)
+        ch_a.close()
+        ch_b.close()
+        pool.close()
+        lst_b.close()
+    finally:
+        constants.set("ps_replication", prev)
+
+
+def test_transport_chain_routing_marks_dead_and_fails_over():
+    """Transport.update/trigger with a chain: a dead head is marked and
+    skipped; the update lands on the replica with its origin seq."""
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applied = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            if msg.kind == "trigger":
+                msg.reply.set_result(np.full(2, 9.0, np.float32))
+            else:
+                applied.append((msg.oseq, float(np.asarray(msg.payload)[0])))
+                msg.done.set()
+
+    lst = T._Listener(lambda i: FakeInst())
+    try:
+        tr = T.Transport.__new__(T.Transport)
+        tr.process_index = 9
+        tr.pool = T._PeerPool({
+            0: ("127.0.0.1", 1),  # dead head: nothing listens on port 1
+            1: ("127.0.0.1", lst.port),
+        })
+        tr._dead_procs = {}
+        tr._oseq = {}
+        from torchmpi_tpu.analysis import lockmon
+
+        tr._oseq_lock = lockmon.make_lock("test.oseq")
+        tr.update(
+            0, 5, 0, 0, "add", np.full(2, 3.0, np.float32), chain=[0, 1]
+        )
+        assert 0 in tr._dead_procs
+        assert applied == [(1, 3.0)]  # oseq assigned, replica applied
+        # subsequent traffic skips the dead head immediately
+        out = tr.trigger(0, 5, 0, 0, chain=[0, 1])
+        np.testing.assert_allclose(out, np.full(2, 9.0, np.float32))
+        # the dead-mark is NOT permanent: within the retry window the
+        # head is skipped, but once ps_dead_peer_retry_s elapses the
+        # chain walk re-probes it (bounding the split-brain window a
+        # transient stall can open)
+        from torchmpi_tpu import constants
+
+        assert tr._alive_chain([0, 1]) == [1]
+        tr._dead_procs[0] -= 3600.0  # age the mark past any window
+        assert tr._alive_chain([0, 1]) == [0, 1]
+        prev = constants.get("ps_dead_peer_retry_s")
+        constants.set("ps_dead_peer_retry_s", 0.0)  # 0 = permanent
+        try:
+            assert tr._alive_chain([0, 1]) == [1]
+        finally:
+            constants.set("ps_dead_peer_retry_s", prev)
+        tr.pool.close()
+    finally:
+        lst.close()
+
+
+def test_malformed_delta_trigger_releases_admission_slot():
+    """A TRIGGER with a garbage delta rule is answered with ERROR and
+    releases its admission slot — it must not leak budget (enough leaks
+    would wedge the listener into BUSYing everything) or kill the
+    connection."""
+    from torchmpi_tpu.parameterserver import transport as T
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            msg.reply.set_result(np.full(2, 7.0, np.float32))
+
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        with pytest.raises(RuntimeError, match="bad delta trigger rule"):
+            ch.request(T._KIND_TRIGGER, 1, 0, 0, rule="delta:x")
+        assert lst._pending_frames == 0  # slot released, not leaked
+        # same connection still serves: a healthy trigger roundtrips
+        out = ch.request(T._KIND_TRIGGER, 1, 0, 0)
+        np.testing.assert_allclose(
+            np.frombuffer(out, np.float32) if isinstance(out, bytes)
+            else out,
+            np.full(2, 7.0, np.float32),
+        )
+    finally:
+        ch.close()
+        lst.close()

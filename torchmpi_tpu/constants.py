@@ -152,6 +152,42 @@ class _Constants:
     # (shard, client) pair server-side.
     parameterserver_delta_encoding: bool = False
 
+    # --- parameter-server fabric (event-multiplexed listener) ---
+    # TCP accept backlog of the PS listener socket. The event loop
+    # accepts promptly, so the backlog only has to absorb connect bursts
+    # (a fleet of clients starting at once); raise it for synthetic
+    # fleets or mass worker restarts.
+    ps_listen_backlog: int = 64
+    # Admission budget: max decoded frames a listener may have admitted
+    # to the apply stage (queued or applying, reply not yet sent) before
+    # new UPDATE/TRIGGER frames are answered with a BUSY/retry-after
+    # reply instead of being queued. The client channel retries BUSY
+    # frames with jittered exponential backoff, so overload degrades to
+    # bounded queue depth + retry latency instead of unbounded memory
+    # growth. Control frames (barrier/gather) are always admitted.
+    # 0 disables admission control.
+    ps_pending_frame_budget: int = 4096
+    # Base retry-after hint (milliseconds) carried on BUSY replies; the
+    # client channel backs off base * 2^attempt with +-50% jitter
+    # (capped at 2s) before replaying the rejected frame.
+    ps_busy_retry_ms: int = 20
+    # Replica-chain length per shard: each shard rank's updates are
+    # chain-forwarded to the next (ps_replication - 1) distinct owner
+    # processes (ack after chain-apply; fetches served by the head), so
+    # one server process death no longer loses PS state — clients fail
+    # over to the next live chain member (addresses already known from
+    # the bootstrap exchange) and the survivor's per-(shard, client)
+    # seq high-water dedups replays. 1 disables replication. Takes
+    # effect for instances whose owners span >= 2 processes.
+    ps_replication: int = 1
+    # Seconds a chain member observed dead (ConnectionError after the
+    # channel's replay budget) stays skipped by failover routing before
+    # it is re-probed. Expiry bounds the split-brain window a TRANSIENT
+    # stall can open: without it one client would route to the replica
+    # forever while everyone else still talks to the recovered head.
+    # 0 makes dead-marks permanent (until restart).
+    ps_dead_peer_retry_s: float = 5.0
+
     # --- distributed flight recorder / hang watchdog ---
     # Seconds a collective dispatch or PS RPC may stay in flight (or a
     # peer's heartbeat stay stale) before the watchdog dumps a structured

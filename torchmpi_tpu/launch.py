@@ -78,6 +78,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--coordinator", default=None,
                     help="host:port of process 0 (required when nnodes > 1; "
                     "default: localhost:<free port>)")
+    ap.add_argument("--set-constant", action="append", default=[],
+                    metavar="NAME=VALUE",
+                    help="override a torchmpi_tpu.constants knob in every "
+                    "rank (repeatable), e.g. --set-constant ps_replication=2 "
+                    "--set-constant parameterserver_wire_dtype=int8. "
+                    "Applied by start() before the runtime bootstraps "
+                    "(and re-applied over persisted tuned values), so "
+                    "fabric knobs like the PS replica-chain length are "
+                    "deployable without editing the training script.")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="elastic full-job restarts: when a rank dies, kill "
                     "the survivors and relaunch ALL ranks up to this many "
@@ -115,6 +124,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error(
             f"--watchdog-timeout must be >= 0, got {args.watchdog_timeout}"
         )
+    for spec in args.set_constant:
+        if "=" not in spec:
+            ap.error(f"--set-constant expects NAME=VALUE, got {spec!r}")
 
     target = (
         [sys.executable, "-m", args.module]
@@ -198,6 +210,10 @@ def _run_world(args, target, extra, restart: int) -> int:
             # armed at telemetry import in the rank (pre-start coverage);
             # heartbeats + hang reports land beside the telemetry dumps
             env["TORCHMPI_TPU_WATCHDOG"] = str(args.watchdog_timeout)
+        if args.set_constant:
+            # applied by runtime_state.start() in the rank, before any
+            # runtime state exists; explicit start(**overrides) still win
+            env["TORCHMPI_TPU_CONSTANTS"] = ";".join(args.set_constant)
         if args.cpu_devices:
             env["XLA_FLAGS"] = (
                 env.get("XLA_FLAGS", "")

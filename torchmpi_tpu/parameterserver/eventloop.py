@@ -1,0 +1,499 @@
+"""Event-multiplexed socket server core for the PS transport listener.
+
+The pre-fabric listener was thread-per-connection: an accept loop spawned
+one blocking reader thread per client, so the server's thread count grew
+O(clients) and topped out at tens of connections — the endpoint-
+concurrency wall the TensorFlow+CUDA-aware-MPI characterization hits
+once the wire itself is fast. This module replaces that with ONE event
+loop thread multiplexing every connection through ``selectors`` (epoll
+on Linux):
+
+- all sockets are non-blocking; each connection owns an **incremental
+  frame state machine** (:class:`Conn`) that fills preallocated buffers
+  with ``recv_into`` exactly like the blocking ``_recv_exact_into``
+  path did — header, rule/dtype, then either a raw payload or the PR 5
+  chunk containers, dequantized chunk-by-chunk into the preallocated
+  logical array as each chunk completes (decode still overlaps wire
+  I/O, now across *all* connections at once);
+- completed frames are handed to the listener's dispatch callback on
+  the loop thread, preserving per-connection wire order (the mailbox-
+  order contract the dedup tables rely on);
+- replies are **queued**, never sent from pool threads: a pool worker
+  enqueues the encoded reply buffers and wakes the loop via a self-
+  pipe; the loop flushes with non-blocking sends and only registers
+  write-interest while a connection's queue is non-empty, so one
+  dead/slow client can never wedge a shared apply worker.
+
+Thread census with the fabric: 1 loop thread + the shared apply pool +
+the global server thread — O(pools), independent of client count.
+"""
+
+from __future__ import annotations
+
+import os
+import selectors
+import socket
+import threading
+from collections import deque
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..analysis import lockmon as _lockmon
+from . import wire as _wire
+
+
+class ConnectionClosed(Exception):
+    """Peer closed / protocol violation: tear down this connection only."""
+
+
+# parser phases
+_PH_HEAD = 0       # filling the frame header
+_PH_META = 1       # filling rule + dtype bytes
+_PH_RAW = 2        # filling an unchunked payload
+_PH_CHUNK_HDR = 3  # filling a chunk-container header
+_PH_CHUNK_BODY = 4  # filling one chunk's encoded span
+
+# one readiness event parses at most this many complete frames before
+# yielding back to the selector: a blasting client cannot starve its
+# neighbours (epoll is level-triggered — buffered bytes re-arm it)
+_FRAMES_PER_WAKE = 64
+
+
+def _transport():
+    # late import: transport imports this module at its top level
+    from . import transport as T
+
+    return T
+
+
+class Conn:
+    """One multiplexed connection: incremental frame parser + thread-safe
+    outbound write queue. Socket I/O happens ONLY on the event-loop
+    thread; any thread may enqueue replies via :meth:`queue_write`.
+
+    The parsed frame tuple is ``(kind, inst, rank, client, seq, oseq,
+    fp, rule, dtype, wire, nchunks, payload)`` — payload already decoded
+    to logical bytes for chunked/quantized frames, exactly what the
+    blocking ``_recv_frame`` produced.
+    """
+
+    __slots__ = (
+        "sock", "fd", "out", "out_lock", "want_write", "closed",
+        "busy_floor",
+        "_phase", "_buf", "_view", "_got",
+        "_kind", "_inst", "_rank", "_client", "_seq", "_oseq", "_fp",
+        "_wirec", "_nchunks", "_rl", "_dl", "_pl",
+        "_rule", "_dtype", "_dt",
+        "_payload_left", "_out_arr", "_out_mv", "_chunk_meta", "_scratch",
+    )
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.out: "deque[memoryview]" = deque()
+        self.out_lock = _lockmon.make_lock("eventloop.py:Conn.out_lock")
+        self.want_write = False
+        self.closed = False
+        # admission-control order fence: the lowest BUSY-rejected UPDATE
+        # seq on this connection (see _Listener._handle_frame)
+        self.busy_floor: Optional[int] = None
+        self._scratch = bytearray()
+        self._start_header()
+
+    # -- parser -----------------------------------------------------------
+    def _start_header(self) -> None:
+        T = _transport()
+        self._phase = _PH_HEAD
+        self._buf = bytearray(T._HEADER.size)
+        self._view = memoryview(self._buf)
+        self._got = 0
+
+    def _begin(self, buf: bytearray, phase: int) -> None:
+        self._phase = phase
+        self._buf = buf
+        self._view = memoryview(buf)
+        self._got = 0
+
+    def _begin_view(self, view: memoryview, phase: int) -> None:
+        self._phase = phase
+        self._buf = None
+        self._view = view
+        self._got = 0
+
+    def _begin_payload(self):
+        """Transition out of the header/meta phases; returns a completed
+        frame tuple for empty payloads, else None."""
+        if self._pl == 0:
+            return self._emit(b"")
+        if self._nchunks == 0:
+            self._begin(bytearray(self._pl), _PH_RAW)
+            return None
+        self._dt = np.dtype(self._dtype or "<f4")
+        self._payload_left = self._pl
+        self._out_arr = None
+        self._out_mv = None
+        self._begin(bytearray(_wire.CHUNK_HDR_SIZE), _PH_CHUNK_HDR)
+        return None
+
+    def _emit(self, payload):
+        frame = (
+            self._kind, self._inst, self._rank, self._client, self._seq,
+            self._oseq, self._fp, self._rule, self._dtype, self._wirec,
+            self._nchunks, payload,
+        )
+        self._out_arr = None
+        self._out_mv = None
+        self._start_header()
+        return frame
+
+    def _advance(self):
+        """One phase transition after the current view filled; returns a
+        completed frame tuple or None."""
+        T = _transport()
+        if self._phase == _PH_HEAD:
+            (magic, kind, inst, rank, client, seq, oseq, fp, token, wirec,
+             nchunks, rl, dl, pl) = T._HEADER.unpack(self._buf)
+            if magic != T._MAGIC:
+                raise ConnectionClosed(
+                    f"bad parameter-server frame magic 0x{magic:x}"
+                )
+            if token != T._auth_token():
+                raise ConnectionClosed(
+                    "parameter-server frame failed authentication"
+                )
+            (self._kind, self._inst, self._rank, self._client, self._seq,
+             self._oseq, self._fp, self._wirec, self._nchunks) = (
+                kind, inst, rank, client, seq, oseq, fp, wirec, nchunks)
+            self._rl, self._dl, self._pl = rl, dl, pl
+            self._rule = self._dtype = ""
+            if rl or dl:
+                self._begin(bytearray(rl + dl), _PH_META)
+                return None
+            return self._begin_payload()
+        if self._phase == _PH_META:
+            self._rule = bytes(self._buf[: self._rl]).decode()
+            self._dtype = bytes(self._buf[self._rl:]).decode()
+            return self._begin_payload()
+        if self._phase == _PH_RAW:
+            return self._emit(self._buf)
+        if self._phase == _PH_CHUNK_HDR:
+            off, total, cn, nb, block = _wire.read_chunk_header(self._buf)
+            self._payload_left -= _wire.CHUNK_HDR_SIZE + nb
+            self._chunk_meta = (off, cn, nb, block)
+            if self._out_arr is None:
+                self._out_arr = np.empty(total, self._dt)
+                self._out_mv = memoryview(self._out_arr).cast("B")
+            if self._wirec == _wire.WIRE_FULL:
+                it = self._dt.itemsize
+                self._begin_view(
+                    self._out_mv[off * it:off * it + nb], _PH_CHUNK_BODY
+                )
+            else:
+                if len(self._scratch) < nb:
+                    self._scratch = bytearray(nb)
+                self._begin_view(
+                    memoryview(self._scratch)[:nb], _PH_CHUNK_BODY
+                )
+            if nb == 0:
+                return self._chunk_done()
+            return None
+        # _PH_CHUNK_BODY
+        return self._chunk_done()
+
+    def _chunk_done(self):
+        off, cn, nb, block = self._chunk_meta
+        if self._wirec != _wire.WIRE_FULL:
+            self._out_arr[off:off + cn] = _wire.decode_span(
+                memoryview(self._scratch)[:nb], cn, self._wirec, block,
+                self._dt,
+            )
+        if self._payload_left > 0:
+            self._begin(bytearray(_wire.CHUNK_HDR_SIZE), _PH_CHUNK_HDR)
+            return None
+        return self._emit(memoryview(self._out_arr).cast("B"))
+
+    def feed(self) -> List[tuple]:
+        """Drain readable bytes into the state machine; returns the list
+        of frames completed by this readiness event. Raises
+        :class:`ConnectionClosed` on EOF / protocol violation."""
+        frames: List[tuple] = []
+        while len(frames) < _FRAMES_PER_WAKE:
+            need = len(self._view) - self._got
+            if need > 0:
+                try:
+                    n = self.sock.recv_into(self._view[self._got:], need)
+                except (BlockingIOError, InterruptedError):
+                    return frames
+                except OSError as e:
+                    raise ConnectionClosed(str(e)) from None
+                if n == 0:
+                    raise ConnectionClosed(
+                        "peer closed parameter-server connection"
+                    )
+                self._got += n
+                if self._got < len(self._view):
+                    return frames  # short read: kernel buffer drained
+            frame = self._advance()
+            # a zero-size phase (empty payload, 0-byte chunk) may chain
+            # several transitions before new bytes are needed
+            while frame is None and len(self._view) == self._got == 0:
+                frame = self._advance()
+            if frame is not None:
+                frames.append(frame)
+        return frames
+
+    # -- writes -----------------------------------------------------------
+    def queue_write(self, bufs) -> None:
+        """Enqueue reply buffers (any thread). Dropped if the connection
+        already closed — the peer is gone, matching the old behavior of
+        swallowing a send on a broken socket."""
+        views = [
+            b if isinstance(b, memoryview) else memoryview(bytes(b))
+            for b in bufs
+        ]
+        with self.out_lock:
+            if self.closed:
+                return
+            self.out.extend(v.cast("B") for v in views if len(v))
+
+    def try_send_direct(self, bufs) -> bool:
+        """Optimistic reply fast path (any thread): when nothing is
+        queued, write straight to the non-blocking socket instead of
+        paying the wake-pipe + loop-iteration hop. Any unsent remainder
+        is queued; returns True when fully sent (no loop wake needed).
+        Safe against the loop's flush: EVERY send on this socket happens
+        under ``out_lock`` and queued bytes always precede new ones."""
+        with self.out_lock:
+            if self.closed:
+                return True  # peer gone: drop, like queue_write
+            if self.out or self.want_write:
+                self.out.extend(
+                    memoryview(b).cast("B")
+                    if isinstance(b, memoryview)
+                    else memoryview(bytes(b)).cast("B")
+                    for b in bufs if len(b)
+                )
+                return False
+            for i, b in enumerate(bufs):
+                view = (
+                    b if isinstance(b, memoryview) else memoryview(bytes(b))
+                ).cast("B")
+                if not len(view):
+                    continue
+                sent = 0
+                while sent < len(view):
+                    try:
+                        sent += self.sock.send(view[sent:])
+                    except (BlockingIOError, InterruptedError):
+                        self.out.append(view[sent:])
+                        self.out.extend(
+                            (v if isinstance(v, memoryview)
+                             else memoryview(bytes(v))).cast("B")
+                            for v in bufs[i + 1:] if len(v)
+                        )
+                        return False
+                    except OSError:
+                        return True  # broken: the loop reaps the conn
+            return True
+
+    def flush(self) -> bool:
+        """Non-blocking drain of the write queue (loop thread only).
+        True when fully drained; False when the kernel buffer filled
+        (caller registers write-interest). Raises ConnectionClosed on a
+        broken socket. The lock is held across the send — sends are
+        non-blocking, and it serializes against ``try_send_direct``."""
+        while True:
+            with self.out_lock:
+                if not self.out:
+                    return True
+                buf = self.out[0]
+                try:
+                    n = self.sock.send(buf)
+                except (BlockingIOError, InterruptedError):
+                    return False
+                except OSError as e:
+                    raise ConnectionClosed(str(e)) from None
+                if n < len(buf):
+                    self.out[0] = buf[n:]
+                else:
+                    self.out.popleft()
+
+
+class EventLoop:
+    """One thread multiplexing accept + read + write over all listener
+    connections. Frame dispatch (``handle_frame(conn, frame)``) runs on
+    the loop thread and must not block — the listener posts mailbox
+    messages and offloads waits to its pool, exactly the split the old
+    per-connection readers had."""
+
+    def __init__(
+        self,
+        server_sock: socket.socket,
+        handle_frame: Callable[[Conn, tuple], None],
+        on_open: Optional[Callable[[Conn], None]] = None,
+        on_close: Optional[Callable[[Conn], None]] = None,
+        name: str = "tm-ps-loop",
+    ):
+        self._srv = server_sock
+        self._handle = handle_frame
+        self._on_open = on_open
+        self._on_close = on_close
+        self._sel = selectors.DefaultSelector()
+        self._rpipe, self._wpipe = os.pipe()
+        os.set_blocking(self._rpipe, False)
+        os.set_blocking(self._wpipe, False)
+        self._sel.register(self._srv, selectors.EVENT_READ, "accept")
+        self._sel.register(self._rpipe, selectors.EVENT_READ, "wake")
+        self._plock = _lockmon.make_lock("eventloop.py:EventLoop._plock")
+        self._pending_write: set = set()
+        self._conns: set = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def connection_count(self) -> int:
+        return len(self._conns)  # racy read; stats only
+
+    def send(self, conn: Conn, bufs) -> None:
+        """Thread-safe reply send: straight to the socket when the
+        connection's queue is empty (the common case — saves the
+        wake-pipe + loop-iteration hop per reply), else enqueue + wake
+        the loop to flush in order."""
+        if conn.try_send_direct(bufs):
+            return
+        with self._plock:
+            self._pending_write.add(conn)
+        self._wake()
+
+    def _wake(self) -> None:
+        try:
+            os.write(self._wpipe, b"x")
+        except (BlockingIOError, OSError):
+            pass  # pipe full (already pending) or closing
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    # -- loop internals ---------------------------------------------------
+    def _accept(self) -> None:
+        while True:
+            try:
+                s, _ = self._srv.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return  # listener socket closing
+            s.setblocking(False)
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = Conn(s)
+            self._conns.add(conn)
+            self._sel.register(s, selectors.EVENT_READ, conn)
+            if self._on_open is not None:
+                self._on_open(conn)
+
+    def _close_conn(self, conn: Conn) -> None:
+        if conn.closed:
+            return
+        with conn.out_lock:
+            conn.closed = True
+            conn.out.clear()
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._conns.discard(conn)
+        with self._plock:
+            self._pending_write.discard(conn)
+        if self._on_close is not None:
+            self._on_close(conn)
+
+    def _flush_conn(self, conn: Conn) -> None:
+        if conn.closed:
+            return
+        try:
+            drained = conn.flush()
+        except ConnectionClosed:
+            self._close_conn(conn)
+            return
+        if not drained and not conn.want_write:
+            conn.want_write = True
+            self._sel.modify(
+                conn.sock,
+                selectors.EVENT_READ | selectors.EVENT_WRITE,
+                conn,
+            )
+        elif drained and conn.want_write:
+            conn.want_write = False
+            self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    events = self._sel.select(timeout=0.5)
+                except OSError:
+                    if self._stop.is_set():
+                        return
+                    continue
+                with self._plock:
+                    pend, self._pending_write = self._pending_write, set()
+                for conn in pend:
+                    self._flush_conn(conn)
+                for key, mask in events:
+                    data = key.data
+                    if data == "wake":
+                        try:
+                            while os.read(self._rpipe, 4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                        continue
+                    if data == "accept":
+                        self._accept()
+                        continue
+                    conn = data
+                    if conn.closed:
+                        continue
+                    if mask & selectors.EVENT_WRITE:
+                        self._flush_conn(conn)
+                    if mask & selectors.EVENT_READ and not conn.closed:
+                        try:
+                            frames = conn.feed()
+                        except ConnectionClosed:
+                            self._close_conn(conn)
+                            continue
+                        for frame in frames:
+                            try:
+                                self._handle(conn, frame)
+                            except Exception:  # noqa: BLE001
+                                # a dispatch bug must not kill the shared
+                                # loop; the old per-conn reader died alone
+                                self._close_conn(conn)
+                                break
+        finally:
+            for conn in list(self._conns):
+                self._close_conn(conn)
+            for fd in (self._rpipe, self._wpipe):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            try:
+                self._sel.close()
+            except OSError:
+                pass

@@ -162,6 +162,76 @@ class _Message:
     delta: Optional[int] = None
     wire: int = 0
     origin: int = 0
+    # origin seq under shard replication: the channel-independent dedup
+    # identity this update keeps when chain-forwarded to a replica (0 =
+    # not replicated / local update; see transport.py's oseq field)
+    oseq: int = 0
+
+
+class _ReplicaPump:
+    """Per-instance in-order replica forwarder. ``serve_once`` (the
+    single server thread) applies an update and hands it here instead of
+    completing its done event; this thread forwards down the chain in
+    APPLY ORDER (one FIFO per instance, so the successor observes the
+    same per-rank update sequence the local shards did) and only then
+    sets the done event — the ack-after-chain-apply contract.
+
+    A successor that fails a forward is marked dead and the chain
+    degrades to head-only for it (counted via
+    ``tm_ps_replica_forward_failures_total``) rather than failing every
+    later update: replica death costs durability-against-a-SECOND-fault,
+    not availability. Reconfiguring a fresh replica in is out of scope
+    (see docs/PARITY "PS fabric")."""
+
+    def __init__(self, forward):
+        self._forward = forward  # (succ_proc, rank, msg) -> None, blocking
+        self._q: deque = deque()
+        self._cv = _lockmon.make_condition("server.py:_ReplicaPump._cv")
+        self._dead: set = set()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name="tm-ps-replica", daemon=True
+        )
+        self._thread.start()
+
+    def enqueue(self, succ: int, r: int, msg: "_Message") -> None:
+        with self._cv:
+            if self._stopped or succ in self._dead:
+                msg.done.set()
+                return
+            self._q.append((succ, r, msg))
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if not self._q:
+                    return  # stopped and drained
+                succ, r, msg = self._q.popleft()
+            if succ not in self._dead:
+                try:
+                    self._forward(succ, r, msg)
+                except Exception:  # noqa: BLE001 - degrade, never strand
+                    self._dead.add(succ)
+                    try:
+                        from .. import telemetry as _telemetry
+                        from .transport import _srv_metric_handles
+
+                        if _telemetry.enabled():
+                            _srv_metric_handles()[6].inc()
+                    except Exception:  # noqa: BLE001
+                        pass
+            msg.done.set()
+
+    def stop(self) -> None:
+        """Stop accepting; the thread drains what's queued (completing
+        every done event) and exits. Not joined — a forward blocked on a
+        dead network must not block instance teardown."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
 
 
 class _Instance:
@@ -199,13 +269,43 @@ class _Instance:
         # which processes already must agree on (collective creation
         # order) — the rotation inherits that agreement.
         self.shard_rotation = instance_id % size
+        # replica chains: each shard rank's chain is [owner (head), then
+        # the next (ps_replication - 1) DISTINCT owner processes in ring
+        # order]. Derived deterministically from (owners, knob), so every
+        # process agrees without coordination; single-process instances
+        # (or ps_replication == 1) degenerate to [owner].
+        rep = max(1, int(constants.get("ps_replication")))
+        distinct = sorted(set(self.owners))
+        if rep > 1 and len(distinct) > 1:
+            k = min(rep, len(distinct))
+            pos = {p: i for i, p in enumerate(distinct)}
+            self.chains: List[List[int]] = [
+                [distinct[(pos[o] + j) % len(distinct)] for j in range(k)]
+                for o in self.owners
+            ]
+        else:
+            self.chains = [[o] for o in self.owners]
+        self.replication = max(len(c) for c in self.chains)
+        # chain successor per rank (None at the tail / when this process
+        # is not in the chain) + the replica forwarding pump, attached by
+        # ParameterServer once the transport exists
+        self._next_chain: Dict[int, Optional[int]] = {}
+        for r, chain in enumerate(self.chains):
+            nxt = None
+            if my_proc in chain:
+                i = chain.index(my_proc)
+                if i + 1 < len(chain):
+                    nxt = chain[i + 1]
+            self._next_chain[r] = nxt
+        self._pump: Optional[_ReplicaPump] = None
         self.ranges: List[Tuple[int, int]] = []
         sizes = []
         for r in range(size):
             s, e = shard_range(flat.shape[0], size, r, self.shard_rotation)
             self.ranges.append((s, e))
-            # remote shards get zero-size local storage
-            sizes.append(e - s if self.owners[r] == my_proc else 0)
+            # ranks with no storage here (neither owned nor replicated)
+            # get zero-size local storage
+            sizes.append(e - s if my_proc in self.chains[r] else 0)
         # delta-fetch bookkeeping (socket transport): per-shard update
         # version + per-(rank, client, origin process) reconstruction
         # snapshots — what that client holds after its last (possibly
@@ -229,7 +329,7 @@ class _Instance:
                         [
                             flat[s:e]
                             for r, (s, e) in enumerate(self.ranges)
-                            if self.owners[r] == my_proc
+                            if my_proc in self.chains[r]
                         ]
                         or [flat[:0]]
                     )
@@ -238,7 +338,7 @@ class _Instance:
                 self.native = None
         if self.native is None:
             self._shards: List[Optional[np.ndarray]] = [
-                flat[s:e].copy() if self.owners[r] == my_proc else None
+                flat[s:e].copy() if my_proc in self.chains[r] else None
                 for r, (s, e) in enumerate(self.ranges)
             ]
         self.mailboxes: List[deque] = [deque() for _ in range(size)]
@@ -250,18 +350,40 @@ class _Instance:
         from .transport import instance_fingerprint
 
         self.fingerprint = instance_fingerprint(
-            self.shape, self.dtype, size, self.owners, self.shard_rotation
+            self.shape, self.dtype, size, self.owners, self.shard_rotation,
+            self.replication,
         )
 
     def is_local(self, r: int) -> bool:
+        """True iff this process is shard ``r``'s HEAD (owner). Client
+        routing keys off this; replicas hold storage but are not heads."""
         return self.owners[r] == self.my_proc
+
+    def has_storage(self, r: int) -> bool:
+        """True iff this process stores shard ``r`` — as its owner or as
+        a member of its replica chain."""
+        return self.my_proc in self.chains[r]
+
+    def next_in_chain(self, r: int) -> Optional[int]:
+        """The replica process applied updates to shard ``r`` must be
+        forwarded to (None at the chain tail / off-chain)."""
+        return self._next_chain.get(r)
+
+    def attach_replication(self, forward) -> None:
+        """Arm the replica pump: ``forward(succ_proc, rank, msg)`` is
+        called (blocking, in apply order) for every applied update to a
+        rank this process must chain-forward. No-op when no rank here
+        has a successor."""
+        if any(v is not None for v in self._next_chain.values()):
+            self._pump = _ReplicaPump(forward)
 
     # --- storage backend dispatch ---
     def apply_rule(self, r: int, rule: str, payload) -> None:
-        if not self.is_local(r):
+        if not self.has_storage(r):
             raise RuntimeError(
-                f"shard {r} is owned by process {self.owners[r]}, not this "
-                f"process ({self.my_proc})"
+                f"shard {r} is owned by process {self.owners[r]} (chain "
+                f"{self.chains[r]}), not stored on this process "
+                f"({self.my_proc})"
             )
         if self.native is not None:
             from ..runtime.native import NativeShardStore
@@ -279,10 +401,11 @@ class _Instance:
             UPDATE_RULES[rule](self._shards[r], payload)
 
     def read_shard(self, r: int) -> np.ndarray:
-        if not self.is_local(r):
+        if not self.has_storage(r):
             raise RuntimeError(
-                f"shard {r} is owned by process {self.owners[r]}, not this "
-                f"process ({self.my_proc})"
+                f"shard {r} is owned by process {self.owners[r]} (chain "
+                f"{self.chains[r]}), not stored on this process "
+                f"({self.my_proc})"
             )
         if self.native is not None:
             return self.native.read(r)
@@ -345,7 +468,22 @@ class _Instance:
                         msg.error = f"{type(e).__name__}: {e}"
                     finally:
                         if msg.done:
-                            msg.done.set()
+                            succ = self._next_chain.get(r)
+                            if (
+                                msg.error is None
+                                and succ is not None
+                                and self._pump is not None
+                            ):
+                                # chain replication: the done event (the
+                                # client's ack) completes only after the
+                                # successor applied too. Handed off HERE,
+                                # on the single server thread, so the
+                                # pump's queue order == apply order — the
+                                # successor observes the same per-rank
+                                # update sequence the local shard did.
+                                self._pump.enqueue(succ, r, msg)
+                            else:
+                                msg.done.set()
                 elif msg.kind == "trigger":
                     try:
                         if msg.delta is not None:
@@ -485,6 +623,8 @@ class _GlobalServer:
                         msg.reply.set_exception(
                             RuntimeError("parameter server freed")
                         )
+        if inst._pump is not None:
+            inst._pump.stop()
         inst.release_storage()
 
     def unregister(self, inst: _Instance) -> None:
@@ -596,6 +736,21 @@ class ParameterServer:
 
             self._transport = _t.ensure_transport()
             self._inst = _server.register(full, comm.size, owners, my_proc)
+            if any(len(c) > 1 for c in self._inst.chains):
+                # arm the replica pump: forwarded frames keep the
+                # original (client, oseq) dedup identity so a failover
+                # re-issue to the successor is answered from its applied
+                # high-water instead of double-applying
+                tr, inst = self._transport, self._inst
+
+                def _fwd(proc, r, msg):
+                    tr.forward_update(
+                        proc, inst.id, r, msg.client, msg.rule,
+                        np.asarray(msg.payload), fp=inst.fingerprint,
+                        oseq=msg.oseq,
+                    )
+
+                self._inst.attach_replication(_fwd)
             self._transport.barrier(
                 set(owners), f"ps-init-{self._inst.id}-{self._inst.fingerprint}"
             )
@@ -689,25 +844,30 @@ class ParameterServer:
 
             # a slice large enough to chunk-stream goes per-rank (the
             # chunk pipeline overlaps encode with wire I/O); small slices
-            # coalesce into one multi frame per peer as before
+            # coalesce into one multi frame per peer as before. Under
+            # replication every slice goes per-rank: each rank has its
+            # own chain (and failover target), and per-rank frames are
+            # what the origin-seq dedup identity covers.
             chunk_bytes = constants.get("ps_chunk_bytes")
             big = (
                 (4 * chunk_bytes) if chunk_bytes > 0 else float("inf")
             )
+            replicated = any(len(c) > 1 for c in inst.chains)
 
             def send_to(proc, ranks, errs):
                 try:
                     # acked after the peer APPLIED the rule (clientSend's
-                    # Ssend happens-before, parameterserver.cpp:339-347);
-                    # all of a peer's small shard slices travel in ONE
-                    # frame, oversized ones stream chunked per rank
+                    # Ssend happens-before, parameterserver.cpp:339-347) —
+                    # and, under replication, after the whole chain
+                    # applied; all of a peer's small shard slices travel
+                    # in ONE frame, oversized ones stream chunked per rank
                     small = [
                         r for r in ranks
                         if flat[inst.ranges[r][0]:inst.ranges[r][1]].nbytes
                         <= big
                     ]
                     large = [r for r in ranks if r not in small]
-                    if len(small) > 1:
+                    if len(small) > 1 and not replicated:
                         transport.update_multi(
                             proc, inst.id,
                             [
@@ -723,6 +883,7 @@ class ParameterServer:
                         transport.update(
                             proc, inst.id, r, client, rule, flat[s:e],
                             fp=inst.fingerprint,
+                            chain=inst.chains[r] if replicated else None,
                         )
                 except Exception as e:
                     errs.append(e)
@@ -814,15 +975,20 @@ class ParameterServer:
                 else:
                     by_proc.setdefault(inst.owners[r], []).append(r)
 
+            replicated = any(len(c) > 1 for c in inst.chains)
+
             def fetch_from(proc, ranks, errs):
                 try:
                     for r in ranks:
                         # clientReceive's trigger + Ssend-back
-                        # (parameterserver.cpp:356-400)
+                        # (parameterserver.cpp:356-400); under
+                        # replication a dead head fails over to the next
+                        # live chain member's replicated shard
                         s, e = inst.ranges[r]
                         out[s:e] = transport.trigger(
                             proc, inst.id, r, client, fp=inst.fingerprint,
                             logical_dtype=dtype,
+                            chain=inst.chains[r] if replicated else None,
                         )
                 except Exception as e:
                     errs.append(e)
@@ -887,10 +1053,12 @@ class ParameterServer:
         free() on every backend (storage may be released natively)."""
         if self._inst.freed:
             raise RuntimeError("parameter server freed")
-        if not self._inst.is_local(rank) and self._transport is not None:
+        if not self._inst.has_storage(rank) and self._transport is not None:
+            chain = self._inst.chains[rank]
             return self._transport.trigger(
                 self._inst.owners[rank], self._inst.id, rank, 0,
                 fp=self._inst.fingerprint, logical_dtype=self._inst.dtype,
+                chain=chain if len(chain) > 1 else None,
             )
         return self._inst.read_shard(rank)
 

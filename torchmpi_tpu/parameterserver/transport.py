@@ -40,6 +40,7 @@ import struct
 import threading
 import time
 from collections import OrderedDict, deque
+from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -56,6 +57,12 @@ _KIND_ACK = 3
 _KIND_SHARD = 4
 _KIND_ERROR = 5
 _KIND_BARRIER = 6
+# admission-control reject: the listener's pending-frame budget is
+# exhausted; rule carries the retry-after hint (milliseconds). The
+# client channel replays the frame after a jittered backoff — overload
+# degrades to bounded queue depth + retry latency, never to unbounded
+# queueing or accept failures.
+_KIND_BUSY = 9
 # host-blob allgather frame: rule = tag, client = origin process,
 # payload = opaque bytes. Powers host-staged collectives (the DCN hop of
 # use_staged_collectives) without touching device links.
@@ -133,19 +140,79 @@ def _metric_handles():
                 "tm_ps_delta_fetches_total",
                 "delta-encoded fetch outcomes, by reply (full/delta/same)",
             ),
+            m.counter(
+                "tm_ps_busy_retries_total",
+                "BUSY/retry-after replies honored client-side with "
+                "jittered backoff",
+            ),
         )
     return _MET
 
 
+# server-side fabric series (connection lifecycle, admission control,
+# queue-vs-apply attribution), labelled by listener port
+_SRV_MET = None
+
+
+def _srv_metric_handles():
+    global _SRV_MET
+    if _SRV_MET is None:
+        m = _telemetry.metrics
+        _SRV_MET = (
+            m.counter(
+                "tm_ps_busy_rejected_total",
+                "frames rejected by the listener's admission budget, "
+                "by listener",
+            ),
+            m.gauge(
+                "tm_ps_connections_open",
+                "currently open listener connections, by listener",
+            ),
+            m.counter(
+                "tm_ps_accepts_total",
+                "connections accepted, by listener",
+            ),
+            m.counter(
+                "tm_ps_disconnects_total",
+                "connections closed (peer EOF, protocol error, broken "
+                "socket), by listener",
+            ),
+            m.histogram(
+                "tm_ps_server_queue_seconds",
+                "admission-to-apply-start wait per admitted PS frame "
+                "(time spent queued for a pool worker), by kind",
+            ),
+            m.histogram(
+                "tm_ps_server_apply_seconds",
+                "apply time per admitted PS frame (mailbox apply wait, "
+                "incl. chain forwarding), by kind",
+            ),
+            m.counter(
+                "tm_ps_replica_forward_failures_total",
+                "replica-chain forwards that failed; the chain degrades "
+                "to head-only for that successor",
+            ),
+        )
+    return _SRV_MET
+
+
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
-#        fp u32, token u32, wire u8, nchunks u32, rule_len u16,
-#        dtype_len u16, payload_len u64
+#        oseq u64, fp u32, token u32, wire u8, nchunks u32,
+#        rule_len u16, dtype_len u16, payload_len u64
 #
 # - seq: per-channel monotone sequence on EVERY frame; echoed on the
 #   reply (the client demux correlates by it — the server replies out
 #   of order), and for UPDATE/BARRIER/GATHER frames also the dedup key
 #   ((inst, rank, client, seq) / per-origin high-water) so a reconnect
 #   retry after a lost ACK cannot double-apply or double-count.
+# - oseq: ORIGIN sequence, nonzero only under shard replication: a
+#   channel-independent per-(inst, rank, client) monotone update id
+#   assigned by the originating client's Transport. It is the dedup
+#   identity that survives failover — the same update re-issued to a
+#   replica (a different channel, fresh channel seqs) or chain-forwarded
+#   by the head carries the same oseq, so the replica's applied
+#   high-water answers duplicates with an ACK instead of re-applying.
+#   0 = dedup by the channel seq (the non-replicated fast path).
 # - fp: instance fingerprint (shape/dtype/size/owners); catches
 #   process-local instance-id desync loudly instead of applying updates
 #   to the wrong tensor.
@@ -159,7 +226,7 @@ def _metric_handles():
 #   (``wire.py``): nchunks x [chunk header | encoded span], streamed so
 #   encode/decode of chunk k+1 overlaps the wire I/O of chunk k. 0 means
 #   the payload is one raw blob (control frames, multi-frame containers).
-_HEADER = struct.Struct(">HBIIIQIIBIHHQ")
+_HEADER = struct.Struct(">HBIIIQQIIBIHHQ")
 
 
 # Auto-derived per-job frame secret (see _init_job_token): 0 only until
@@ -204,7 +271,7 @@ def _init_job_token() -> None:
 
 
 def instance_fingerprint(shape, dtype, size: int, owners,
-                         rotation: int = 0) -> int:
+                         rotation: int = 0, replication: int = 1) -> int:
     import zlib
 
     desc = f"{tuple(shape)}|{np.dtype(dtype).str}|{size}|{tuple(owners)}"
@@ -213,6 +280,11 @@ def instance_fingerprint(shape, dtype, size: int, owners,
         # placement): a rotation disagreement means a ranges disagreement
         # and must fail as loudly as any other layout desync
         desc += f"|rot{rotation}"
+    if replication > 1:
+        # chain layout disagreement (one process replicating, another
+        # not) would silently skip forwarding: fail as loudly as any
+        # other layout desync
+        desc += f"|rep{replication}"
     return zlib.crc32(desc.encode()) & 0xFFFFFFFF
 
 
@@ -278,10 +350,11 @@ def _frame_header(
     rule: str = "",
     dtype: str = "",
     payload_len: int = 0,
+    oseq: int = 0,
 ):
     rule_b, dtype_b = rule.encode(), dtype.encode()
     header = _HEADER.pack(
-        _MAGIC, kind, inst, rank, client, seq, fp, _auth_token(),
+        _MAGIC, kind, inst, rank, client, seq, oseq, fp, _auth_token(),
         wire, nchunks, len(rule_b), len(dtype_b), payload_len,
     )
     return header, rule_b, dtype_b
@@ -299,10 +372,11 @@ def _frame_bytes(
     payload: bytes = b"",
     wire: int = 0,
     nchunks: int = 0,
+    oseq: int = 0,
 ) -> bytes:
     header, rule_b, dtype_b = _frame_header(
         kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
-        len(payload),
+        len(payload), oseq,
     )
     return header + rule_b + dtype_b + payload
 
@@ -320,26 +394,56 @@ def _send_frame(
     payload: _Buffers = b"",
     wire: int = 0,
     nchunks: int = 0,
+    oseq: int = 0,
 ) -> None:
     if isinstance(payload, list):
         total = sum(len(memoryview(b).cast("B")) for b in payload)
         header, rule_b, dtype_b = _frame_header(
             kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
-            total,
+            total, oseq,
         )
         _send_buffers(sock, [header, rule_b, dtype_b] + payload)
     else:
         sock.sendall(
             _frame_bytes(
                 kind, inst, rank, client, seq, fp, rule, dtype, payload,
-                wire, nchunks,
+                wire, nchunks, oseq,
             )
         )
 
 
+def _reply_bufs(
+    kind: int,
+    inst: int = 0,
+    rank: int = 0,
+    client: int = 0,
+    seq: int = 0,
+    fp: int = 0,
+    rule: str = "",
+    dtype: str = "",
+    payload: _Buffers = b"",
+    wire: int = 0,
+    nchunks: int = 0,
+):
+    """Encode a reply frame as a buffer list for the event loop's write
+    queue (never sent inline: pool threads enqueue, the loop flushes)."""
+    if isinstance(payload, list):
+        total = sum(len(memoryview(b).cast("B")) for b in payload)
+        header, rule_b, dtype_b = _frame_header(
+            kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
+            total,
+        )
+        return [header, rule_b, dtype_b, *payload]
+    header, rule_b, dtype_b = _frame_header(
+        kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
+        len(payload),
+    )
+    return [header, rule_b, dtype_b, payload]
+
+
 def _recv_head(sock: socket.socket):
     header = _recv_exact(sock, _HEADER.size)
-    (magic, kind, inst, rank, client, seq, fp, token, wire, nchunks,
+    (magic, kind, inst, rank, client, seq, oseq, fp, token, wire, nchunks,
      rl, dl, pl) = _HEADER.unpack(header)
     if magic != _MAGIC:
         raise ConnectionError(
@@ -457,7 +561,26 @@ def _parse_multi_payload(payload, dt: np.dtype, wire: int = 0):
 
 
 class _Listener:
-    """Accept loop serving this process's shard ranks."""
+    """Event-multiplexed listener serving this process's shard ranks.
+
+    One :class:`~.eventloop.EventLoop` thread multiplexes EVERY client
+    connection (non-blocking sockets, per-connection incremental frame
+    state machines); mailbox posting happens on the loop thread in wire
+    order, applied-waits and replies run on the shared apply pool, and
+    replies are queued back through the loop — so the server's thread
+    count is O(pools), independent of how many clients connect. The
+    pre-fabric design (accept loop + one blocking reader thread per
+    connection) topped out at tens of clients; see ``eventloop.py``.
+
+    Admission control: at most ``ps_pending_frame_budget`` decoded
+    frames may be in the apply stage at once; beyond that, new
+    UPDATE/TRIGGER frames get a BUSY/retry-after reply the client
+    channel honors with jittered backoff. A per-connection BUSY *floor*
+    keeps rejections order-safe: once an UPDATE is rejected, every
+    later pipelined UPDATE on that connection is rejected too until the
+    first rejected seq is retried, so retried updates can never apply
+    out of their assignment order.
+    """
 
     def __init__(self, lookup_instance):
         self._lookup = lookup_instance
@@ -471,7 +594,8 @@ class _Listener:
             self._sock.bind((bind_host, 0))
         except OSError:
             self._sock.bind(("0.0.0.0", 0))
-        self._sock.listen(64)
+        self._sock.listen(max(1, int(constants.get("ps_listen_backlog"))))
+        self._sock.setblocking(False)
         self.port = self._sock.getsockname()[1]
         # UPDATE dedup: last applied seq per (inst, rank, client) — a
         # reconnect retry after a lost ACK must not double-apply. The
@@ -513,7 +637,15 @@ class _Listener:
             "transport.py:_Listener._barrier_cv"
         )
         self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
+        # admission control + connection-lifecycle counters (ints under
+        # one small lock; mirrored into telemetry series when enabled)
+        self._pending_lock = _lockmon.make_lock(
+            "transport.py:_Listener._pending_lock"
+        )
+        self._pending_frames = 0
+        self._busy_rejects = 0
+        self._accepts = 0
+        self._disconnects = 0
         # ONE listener-wide pool for applied-waits and replies, sized
         # from the expected in-flight frames (the PS pool size bounds
         # concurrent applies; 2x covers waits stacked behind them). A
@@ -532,10 +664,17 @@ class _Listener:
             ),
             thread_name_prefix="tm-ps-apply",
         )
+        from .eventloop import EventLoop
+
+        self._loop = EventLoop(
+            self._sock, self._handle_frame,
+            on_open=self._on_open, on_close=self._on_close,
+        )
         # listener health producer: queue depth (frames waiting for a
-        # pool worker) + thread counts, read at snapshot time only. A
-        # weakref keeps the collector from pinning a closed listener; a
-        # rebootstrapped transport's listener re-registers over it.
+        # pool worker), admitted-frame backlog, and connection lifecycle
+        # counts, read at snapshot time only. A weakref keeps the
+        # collector from pinning a closed listener; a rebootstrapped
+        # transport's listener re-registers over it.
         import weakref
 
         ref = weakref.ref(self)
@@ -549,17 +688,35 @@ class _Listener:
                 "alive": not listener._stop.is_set(),
                 "queue_depth": q.qsize() if q is not None else None,
                 "pool_workers": len(getattr(listener._pool, "_threads", ())),
-                "conn_threads": sum(
-                    1 for t in listener._threads if t.is_alive()
-                ),
+                "connections": listener._loop.connection_count(),
+                "accepted": listener._accepts,
+                "disconnected": listener._disconnects,
+                "busy_rejected": listener._busy_rejects,
+                "pending_frames": listener._pending_frames,
                 "port": listener.port,
             }
 
         _telemetry.metrics.register_collector("ps_listener", _listener_stats)
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="tm-ps-listener", daemon=True
-        )
-        self._accept_thread.start()
+        self._loop.start()
+
+    # -- connection lifecycle (loop thread) ---------------------------------
+    def _on_open(self, conn) -> None:
+        self._accepts += 1
+        if _telemetry.enabled():
+            met = _srv_metric_handles()
+            met[2].inc(listener=str(self.port))
+            met[1].set(
+                self._loop.connection_count(), listener=str(self.port)
+            )
+
+    def _on_close(self, conn) -> None:
+        self._disconnects += 1
+        if _telemetry.enabled():
+            met = _srv_metric_handles()
+            met[3].inc(listener=str(self.port))
+            met[1].set(
+                self._loop.connection_count(), listener=str(self.port)
+            )
 
     def _submit(self, fn, *args) -> None:
         """Schedule reply work on the shared pool; during close() the
@@ -634,273 +791,322 @@ class _Listener:
                 self._gather_seen.pop(tag, None)
             return out
 
-    def _accept_loop(self):
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except OSError:
-                return  # socket closed
-            t = threading.Thread(
-                target=self._serve_conn, args=(conn,),
-                name="tm-ps-conn", daemon=True,
-            )
-            t.start()
-            self._threads.append(t)
-
-    def _serve_conn(self, conn: socket.socket):
-        """Per-connection reader. Frames are READ and POSTED in wire order
-        on this thread (per-(inst, rank) apply order is mailbox order, so
-        a client's updates to one shard still apply in its program order),
-        but the applied-WAITS and replies run on the LISTENER-WIDE worker
-        pool (``self._pool``): replies are correlated by the echoed frame
-        seq, not FIFO, so one slow shard apply no longer head-of-line-
-        blocks every later frame on the connection — the per-instance
-        independence of the reference's Iprobe dispatch
-        (``parameterserver.cpp:404-541``). The pool is shared across
-        connections so reconnect churn cannot multiply threads."""
-        import threading as _threading
-        from concurrent.futures import Future
-
-        send_lock = _lockmon.make_lock(
-            "transport.py:_Listener._serve_conn.send_lock"
+    def _admit(self, conn, kind: int, seq: int) -> bool:
+        """Admission control (loop thread): True admits the frame into
+        the apply stage; False means the caller must reply BUSY. The
+        per-connection ``busy_floor`` keeps rejections order-safe for
+        pipelined updates (see class docstring)."""
+        budget = constants.get("ps_pending_frame_budget")
+        if budget <= 0:
+            return True
+        update_kind = kind in (_KIND_UPDATE, _KIND_UPDATE_MULTI)
+        with self._pending_lock:
+            over = self._pending_frames >= budget
+        forced = (
+            update_kind
+            and conn.busy_floor is not None
+            and seq > conn.busy_floor
         )
+        if over or forced:
+            if update_kind and conn.busy_floor is None:
+                conn.busy_floor = seq
+            self._busy_rejects += 1
+            if _telemetry.enabled():
+                _srv_metric_handles()[0].inc(listener=str(self.port))
+            return False
+        if (
+            update_kind
+            and conn.busy_floor is not None
+            and seq <= conn.busy_floor
+        ):
+            conn.busy_floor = None
+        return True
 
-        def reply(kind: int, seq: int, **kw) -> None:
-            try:
-                with send_lock:
-                    _send_frame(conn, kind, seq=seq, **kw)
-            except (ConnectionError, OSError):
-                pass  # the reader sees the broken socket and exits
+    def _make_finisher(self, reply, fl):
+        """Wrap ``reply`` so the frame's admission slot is released and
+        its server-side flight entry completed exactly once, whichever
+        pool path answers it."""
+        done = [False]
 
-        try:
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            while not self._stop.is_set():
-                (kind, inst_id, rank, client, seq, fp, rule, dtype,
-                 wire, nchunks, pl) = _recv_head(conn)
-                # chunked payloads stream + dequantize chunk-by-chunk into
-                # one preallocated logical buffer (decode of chunk k
-                # overlaps wire I/O of chunk k+1); the decoded payload is
-                # applied as ONE atomic message below — per-chunk apply
-                # would let a concurrent trigger read a torn shard and a
-                # connection torn mid-stream would partially apply a
-                # non-idempotent rule that the channel replay then doubles
-                payload = (
-                    _read_payload(conn, pl, wire, nchunks, dtype)
-                    if pl
-                    else b""
-                )
-                if kind == _KIND_BARRIER:
-                    # subset barrier: record (tag, origin) and ack receipt;
-                    # a replayed frame (seq already applied) is ACKed
-                    # without re-counting the arrival
-                    if not seq or self._fresh_seq(
-                        self._barrier_applied, client, seq
-                    ):
-                        self.barrier_arrived(rule, client)
-                    reply(_KIND_ACK, seq)
-                    continue
-                if kind == _KIND_GATHER:
-                    # host-blob allgather contribution, same replay dedup
-                    if not seq or self._fresh_seq(
-                        self._gather_applied, client, seq
-                    ):
-                        self.gather_arrived(rule, client, payload)
-                    reply(_KIND_ACK, seq)
-                    continue
-                inst = self._lookup(inst_id)
-                if inst is None:
-                    reply(
-                        _KIND_ERROR, seq,
-                        rule=f"unknown parameter-server instance {inst_id}",
-                    )
-                    continue
-                if fp and fp != inst.fingerprint:
-                    # instance-id desync (processes created PSs in
-                    # different orders): fail loudly, never apply to the
-                    # wrong tensor
-                    reply(
-                        _KIND_ERROR, seq,
-                        rule=(
-                            f"instance {inst_id} fingerprint mismatch "
-                            "(parameter servers must be created in the "
-                            "same order on every process)"
-                        ),
-                    )
-                    continue
-                timeout = constants.get("deadlock_timeout_seconds") or None
-                from .server import _Message
+        def finish(rkind: int, rseq: int, **kw) -> None:
+            if not done[0]:
+                done[0] = True
+                with self._pending_lock:
+                    self._pending_frames -= 1
+                if fl is not None:
+                    if rkind == _KIND_ERROR:
+                        _flight.FlightRecorder.fail(fl)
+                    else:
+                        _flight.FlightRecorder.complete(fl)
+            reply(rkind, rseq, **kw)
 
-                if kind in (_KIND_UPDATE, _KIND_UPDATE_MULTI):
-                    dkey = (inst_id, rank, client)
-                    ikey = (dkey, seq)
-                    owner = True
-                    pending: Optional[_threading.Event] = None
-                    poisoned = None
-                    replay_applied = False
-                    with self._applied_lock:
-                        # applied / poisoned / inflight are decided in ONE
-                        # critical section: were the applied-check and the
-                        # inflight registration split, the original apply
-                        # could complete (recording seq and popping its
-                        # inflight entry) between them, and a reconnect
-                        # retry would register itself as a fresh owner and
-                        # re-post a non-idempotent rule.
-                        #
-                        # _failed is consulted BEFORE the _applied high-
-                        # water check: seqs are channel-monotone, so a
-                        # LATER update's success advances the mark past a
-                        # failed seq — the replay of the failed frame
-                        # must be re-answered with its recorded ERROR,
-                        # never a false ACK (ADVICE r5).
-                        if seq:
-                            poisoned = self._failed.get(ikey)
-                            if poisoned is None:
-                                if self._applied.get(dkey, 0) >= seq:
-                                    replay_applied = True
-                                else:
-                                    pending = self._inflight.get(ikey)
-                                    if pending is None:
-                                        self._inflight[ikey] = (
-                                            _threading.Event()
-                                        )
-                                    else:
-                                        owner = False
-                    if poisoned is not None:
-                        # retry of a failed frame whose ERROR response was
-                        # lost (single UPDATE, or a partially-applied
-                        # multi): re-report from the record, never
-                        # re-apply (multi items that succeeded would
-                        # double)
-                        if _telemetry.enabled():
-                            _metric_handles()[5].inc(outcome="poisoned")
-                        reply(_KIND_ERROR, seq, rule=poisoned)
-                        continue
-                    if replay_applied:
-                        # retry of an already-applied update: ack only
-                        if _telemetry.enabled():
-                            _metric_handles()[5].inc(outcome="acked")
-                        reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
-                        continue
-                    if not owner:
-                        # a reconnect retry racing the FIRST apply (its
-                        # seq not yet recorded): wait for that apply and
-                        # report ITS outcome — re-posting would apply a
-                        # non-idempotent rule ('add') twice. Own thread,
-                        # NOT the pool: this wait completes only when the
-                        # owner's _finish_update (a pool task) sets the
-                        # event — parked on a pool worker it could starve
-                        # the very task it waits for.
-                        if _telemetry.enabled():
-                            _metric_handles()[5].inc(outcome="waited")
-                        _threading.Thread(
-                            target=self._await_other_apply,
-                            args=(reply, dkey, seq, pending, inst_id,
-                                  rank, timeout),
-                            name="tm-ps-replay-wait", daemon=True,
-                        ).start()
-                        continue
-                    try:
-                        dt = np.dtype(dtype)
-                        if kind == _KIND_UPDATE_MULTI:
-                            items = _parse_multi_payload(payload, dt, wire)
-                            owned = wire != _wire.WIRE_FULL
+        return finish
+
+    def _server_types(self):
+        """Cached (_Message, _CancelToken) from ``.server`` — imported
+        lazily (the module cycle forbids a top-level import) but only
+        ONCE, not per frame on the single event-loop thread."""
+        types = self.__dict__.get("_server_types_cache")
+        if types is None:
+            from .server import _CancelToken, _Message
+
+            types = self.__dict__["_server_types_cache"] = (
+                _Message, _CancelToken,
+            )
+        return types
+
+    def _handle_frame(self, conn, frame) -> None:
+        """One decoded frame, dispatched on the EVENT-LOOP thread. Frames
+        are POSTED in wire order here (per-(inst, rank) apply order is
+        mailbox order, so a client's updates to one shard still apply in
+        its program order), but the applied-WAITS and replies run on the
+        LISTENER-WIDE worker pool (``self._pool``): replies are
+        correlated by the echoed frame seq, not FIFO, so one slow shard
+        apply never head-of-line-blocks every later frame on the
+        connection — the per-instance independence of the reference's
+        Iprobe dispatch (``parameterserver.cpp:404-541``). Replies are
+        QUEUED through the loop, never sent from pool threads, so a
+        dead client cannot wedge a shared worker."""
+        (kind, inst_id, rank, client, seq, oseq, fp, rule, dtype,
+         wire, nchunks, payload) = frame
+        loop = self._loop
+
+        def reply(rkind: int, rseq: int, **kw) -> None:
+            loop.send(conn, _reply_bufs(rkind, seq=rseq, **kw))
+
+        if kind == _KIND_BARRIER:
+            # subset barrier: record (tag, origin) and ack receipt; a
+            # replayed frame (seq already applied) is ACKed without
+            # re-counting the arrival. Control frames bypass admission
+            # control — they are cheap and correctness-critical.
+            if not seq or self._fresh_seq(
+                self._barrier_applied, client, seq
+            ):
+                self.barrier_arrived(rule, client)
+            reply(_KIND_ACK, seq)
+            return
+        if kind == _KIND_GATHER:
+            # host-blob allgather contribution, same replay dedup
+            if not seq or self._fresh_seq(
+                self._gather_applied, client, seq
+            ):
+                self.gather_arrived(rule, client, payload)
+            reply(_KIND_ACK, seq)
+            return
+        if kind not in (_KIND_UPDATE, _KIND_UPDATE_MULTI, _KIND_TRIGGER):
+            reply(_KIND_ERROR, seq, rule=f"bad kind {kind}")
+            return
+        if not self._admit(conn, kind, seq):
+            reply(
+                _KIND_BUSY, seq,
+                rule=str(constants.get("ps_busy_retry_ms")),
+            )
+            return
+        inst = self._lookup(inst_id)
+        if inst is None:
+            reply(
+                _KIND_ERROR, seq,
+                rule=f"unknown parameter-server instance {inst_id}",
+            )
+            return
+        if fp and fp != inst.fingerprint:
+            # instance-id desync (processes created PSs in different
+            # orders): fail loudly, never apply to the wrong tensor
+            reply(
+                _KIND_ERROR, seq,
+                rule=(
+                    f"instance {inst_id} fingerprint mismatch "
+                    "(parameter servers must be created in the "
+                    "same order on every process)"
+                ),
+            )
+            return
+        timeout = constants.get("deadlock_timeout_seconds") or None
+        # the frame is now in the apply stage: it holds one admission
+        # slot and one server-side flight entry until its reply goes out
+        t_admit = time.monotonic()
+        fl = None
+        if _flight.enabled():
+            fl = _flight.recorder.record(
+                f"ps:server:{self.port}",
+                _KIND_NAMES.get(kind, str(kind)),
+                payload=f"{len(payload)}B",
+                backend="socket",
+                routing=f"inst={inst_id},rank={rank},client={client}",
+            )
+        with self._pending_lock:
+            self._pending_frames += 1
+        finish = self._make_finisher(reply, fl)
+        _Message, _CancelToken = self._server_types()
+        # dedup identity: the origin seq under replication (it survives
+        # failover to a replica), the channel seq otherwise
+        dseq = oseq or seq
+        if kind in (_KIND_UPDATE, _KIND_UPDATE_MULTI):
+            dkey = (inst_id, rank, client)
+            ikey = (dkey, dseq)
+            owner = True
+            pending: Optional[threading.Event] = None
+            poisoned = None
+            replay_applied = False
+            with self._applied_lock:
+                # applied / poisoned / inflight are decided in ONE
+                # critical section: were the applied-check and the
+                # inflight registration split, the original apply could
+                # complete (recording seq and popping its inflight
+                # entry) between them, and a reconnect retry would
+                # register itself as a fresh owner and re-post a
+                # non-idempotent rule.
+                #
+                # _failed is consulted BEFORE the _applied high-water
+                # check: seqs are channel-monotone, so a LATER update's
+                # success advances the mark past a failed seq — the
+                # replay of the failed frame must be re-answered with
+                # its recorded ERROR, never a false ACK (ADVICE r5).
+                if dseq:
+                    poisoned = self._failed.get(ikey)
+                    if poisoned is None:
+                        if self._applied.get(dkey, 0) >= dseq:
+                            replay_applied = True
                         else:
-                            items = [(rank, np.frombuffer(payload, dt))]
-                            # a decoded container is a fresh buffer with no
-                            # other referents: safe to hand to the mailbox
-                            # without the defensive copy
-                            owned = nchunks > 0
-                    except Exception as e:  # noqa: BLE001 - bad wire payload
-                        if seq:
-                            with self._applied_lock:
-                                done_ev = self._inflight.pop(ikey, None)
-                            if done_ev is not None:
-                                done_ev.set()
-                        reply(_KIND_ERROR, seq, rule=f"bad update payload: {e}")
-                        continue
-                    from .server import _CancelToken
-
-                    # posting happens HERE, on the reader thread, so the
-                    # per-rank mailboxes see this connection's updates in
-                    # wire order; only the waits/replies are offloaded
-                    posted = []
-                    try:
-                        for r, values in items:
-                            ev = _threading.Event()
-                            token = _CancelToken()
-                            msg = _Message(
-                                "update", client=client, rule=rule,
-                                payload=values if owned else values.copy(),
-                                done=ev, cancelled=token,
-                            )
-                            inst.post(r, msg)
-                            posted.append((ev, token, msg))
-                    except Exception as e:  # noqa: BLE001 - e.g. bad rank
-                        # PARTIALLY-posted frame (an out-of-range rank
-                        # makes inst.post raise): withdraw what we can,
-                        # reply ERROR, and release the inflight slot the
-                        # old inline finally covered — leaking it would
-                        # hang the channel replay's not-owner wait forever
-                        self._submit(
-                            self._abort_partial_post, reply, kind, ikey,
-                            seq, posted, f"update post failed: {e}",
-                        )
-                        continue
-                    self._submit(
-                        self._finish_update, reply, kind, dkey, ikey, seq,
-                        inst_id, rank, posted, timeout,
-                    )
-                elif kind == _KIND_TRIGGER:
-                    f: Future = Future()
-                    delta_base = None
-                    delta_origin = 0
-                    if rule.startswith("delta:"):
-                        # delta-encoded fetch: the client names the version
-                        # of its cached copy (and its origin process — two
-                        # processes may share a client id, e.g. the default
-                        # client=0, and must not overwrite each other's
-                        # reconstruction snapshots); the server thread
-                        # answers with 'same' / a delta against its
-                        # recorded reconstruction / a fresh full shard
-                        fields = rule.split(":")
-                        delta_base = int(fields[1])
-                        if len(fields) > 2:
-                            delta_origin = int(fields[2])
-                    inst.post(
-                        rank,
-                        _Message(
-                            "trigger", client=client, reply=f,
-                            delta=delta_base, wire=wire,
-                            origin=delta_origin,
-                        ),
-                    )
-                    self._submit(
-                        self._finish_trigger, reply, f, seq, inst_id, rank,
-                        timeout, wire,
-                    )
-                else:
-                    reply(_KIND_ERROR, seq, rule=f"bad kind {kind}")
-        except (ConnectionError, OSError):
-            pass
-        finally:
-            # the pool is listener-owned (shared): only the socket dies
-            # with the connection
+                            pending = self._inflight.get(ikey)
+                            if pending is None:
+                                self._inflight[ikey] = threading.Event()
+                            else:
+                                owner = False
+            if poisoned is not None:
+                # retry of a failed frame whose ERROR response was lost
+                # (single UPDATE, or a partially-applied multi):
+                # re-report from the record, never re-apply (multi items
+                # that succeeded would double)
+                if _telemetry.enabled():
+                    _metric_handles()[5].inc(outcome="poisoned")
+                finish(_KIND_ERROR, seq, rule=poisoned)
+                return
+            if replay_applied:
+                # retry of an already-applied update: ack only
+                if _telemetry.enabled():
+                    _metric_handles()[5].inc(outcome="acked")
+                finish(_KIND_ACK, seq, inst=inst_id, rank=rank)
+                return
+            if not owner:
+                # a reconnect retry racing the FIRST apply (its seq not
+                # yet recorded): wait for that apply and report ITS
+                # outcome — re-posting would apply a non-idempotent rule
+                # ('add') twice. Own thread, NOT the pool: this wait
+                # completes only when the owner's _finish_update (a pool
+                # task) sets the event — parked on a pool worker it
+                # could starve the very task it waits for.
+                if _telemetry.enabled():
+                    _metric_handles()[5].inc(outcome="waited")
+                threading.Thread(
+                    target=self._await_other_apply,
+                    args=(finish, dkey, dseq, seq, pending, inst_id,
+                          rank, timeout),
+                    name="tm-ps-replay-wait", daemon=True,
+                ).start()
+                return
             try:
-                conn.close()
-            except OSError:
-                pass
+                dt = np.dtype(dtype)
+                if kind == _KIND_UPDATE_MULTI:
+                    items = _parse_multi_payload(payload, dt, wire)
+                    owned = wire != _wire.WIRE_FULL
+                else:
+                    items = [(rank, np.frombuffer(payload, dt))]
+                    # a decoded container is a fresh buffer with no
+                    # other referents: safe to hand to the mailbox
+                    # without the defensive copy
+                    owned = nchunks > 0
+            except Exception as e:  # noqa: BLE001 - bad wire payload
+                if dseq:
+                    with self._applied_lock:
+                        done_ev = self._inflight.pop(ikey, None)
+                    if done_ev is not None:
+                        done_ev.set()
+                finish(_KIND_ERROR, seq, rule=f"bad update payload: {e}")
+                return
+            # posting happens HERE, on the loop thread, so the per-rank
+            # mailboxes see this connection's updates in wire order;
+            # only the waits/replies are offloaded
+            posted = []
+            try:
+                for r, values in items:
+                    ev = threading.Event()
+                    token = _CancelToken()
+                    msg = _Message(
+                        "update", client=client, rule=rule,
+                        payload=values if owned else values.copy(),
+                        done=ev, cancelled=token, oseq=oseq,
+                    )
+                    inst.post(r, msg)
+                    posted.append((ev, token, msg, r))
+            except Exception as e:  # noqa: BLE001 - e.g. bad rank
+                # PARTIALLY-posted frame (an out-of-range rank makes
+                # inst.post raise): withdraw what we can, reply ERROR,
+                # and release the inflight slot — leaking it would hang
+                # the channel replay's not-owner wait forever
+                self._submit(
+                    self._abort_partial_post, finish, kind, ikey,
+                    seq, posted, f"update post failed: {e}",
+                )
+                return
+            self._submit(
+                self._finish_update, finish, kind, dkey, ikey, dseq, seq,
+                inst_id, rank, posted, timeout, t_admit,
+            )
+        else:  # _KIND_TRIGGER
+            f: Future = Future()
+            delta_base = None
+            delta_origin = 0
+            if rule.startswith("delta:"):
+                # delta-encoded fetch: the client names the version of
+                # its cached copy (and its origin process — two
+                # processes may share a client id, e.g. the default
+                # client=0, and must not overwrite each other's
+                # reconstruction snapshots); the server thread answers
+                # with 'same' / a delta against its recorded
+                # reconstruction / a fresh full shard
+                fields = rule.split(":")
+                try:
+                    delta_base = int(fields[1])
+                    if len(fields) > 2:
+                        delta_origin = int(fields[2])
+                except (IndexError, ValueError) as e:
+                    # malformed rule must still release the admission
+                    # slot + flight entry it already holds — raising
+                    # here would leak them and wedge the budget shut
+                    finish(
+                        _KIND_ERROR, seq,
+                        rule=f"bad delta trigger rule {rule!r}: {e}",
+                    )
+                    return
+            try:
+                inst.post(
+                    rank,
+                    _Message(
+                        "trigger", client=client, reply=f,
+                        delta=delta_base, wire=wire,
+                        origin=delta_origin,
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 - e.g. bad rank
+                finish(_KIND_ERROR, seq, rule=f"trigger post failed: {e}")
+                return
+            self._submit(
+                self._finish_trigger, finish, f, seq, inst_id, rank,
+                timeout, wire, t_admit,
+            )
 
     def _abort_partial_post(
         self, reply, kind, ikey, seq, posted, failure
     ) -> None:
         try:
             applied_any = False
-            for ev, token, msg in posted:
+            for ev, token, msg, _r in posted:
                 if token.cancel():
                     continue  # never started: exactly withdrawn
                 ev.wait()  # applying or applied: let it finish
                 applied_any = True
-            if kind == _KIND_UPDATE_MULTI and seq and applied_any:
+            if kind == _KIND_UPDATE_MULTI and ikey[1] and applied_any:
                 # items that DID apply must never re-apply on a replay
                 # whose ERROR response was lost: poison the (key, seq)
                 if _telemetry.enabled():
@@ -911,18 +1117,18 @@ class _Listener:
                     self._failed[ikey] = failure
             reply(_KIND_ERROR, seq, rule=failure)
         finally:
-            if seq:
+            if ikey[1]:
                 with self._applied_lock:
                     done_ev = self._inflight.pop(ikey, None)
                 if done_ev is not None:
                     done_ev.set()
 
     def _await_other_apply(
-        self, reply, dkey, seq, pending, inst_id, rank, timeout
+        self, reply, dkey, dseq, seq, pending, inst_id, rank, timeout
     ) -> None:
         pending.wait(timeout)
         with self._applied_lock:
-            done = self._applied.get(dkey, 0) >= seq
+            done = self._applied.get(dkey, 0) >= dseq
         if done:
             reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
         else:
@@ -932,23 +1138,34 @@ class _Listener:
             )
 
     def _finish_update(
-        self, reply, kind, dkey, ikey, seq, inst_id, rank, posted, timeout
+        self, reply, kind, dkey, ikey, dseq, seq, inst_id, rank, posted,
+        timeout, t_admit=None,
     ) -> None:
         try:
+            t_start = time.monotonic()
             failure: Optional[str] = None
-            for ev, token, msg in posted:
-                if not ev.wait(timeout):
-                    # atomically withdraw: if the server has not STARTED
-                    # applying, it never will (serve_once CAS-checks the
-                    # token) and the failure report is exact; if it is
-                    # mid-apply, wait for it to finish and report the true
-                    # outcome instead of lying.
-                    if token.cancel():
-                        failure = "remote update apply timed out"
-                        continue
-                    ev.wait()  # apply in progress: completes
-                if msg.error is not None:
-                    failure = f"update apply failed: {msg.error}"
+            with _telemetry.span(
+                "ps.server.apply", kind=_KIND_NAMES.get(kind, str(kind)),
+                rank=rank,
+            ):
+                for ev, token, msg, _r in posted:
+                    if not ev.wait(timeout):
+                        # atomically withdraw: if the server has not
+                        # STARTED applying, it never will (serve_once
+                        # CAS-checks the token) and the failure report is
+                        # exact; if it is mid-apply, wait for it to finish
+                        # and report the true outcome instead of lying.
+                        if token.cancel():
+                            failure = "remote update apply timed out"
+                            continue
+                        ev.wait()  # apply in progress: completes
+                    if msg.error is not None:
+                        failure = f"update apply failed: {msg.error}"
+            if _telemetry.enabled() and t_admit is not None:
+                met = _srv_metric_handles()
+                kname = _KIND_NAMES.get(kind, str(kind))
+                met[4].observe(t_start - t_admit, kind=kname)
+                met[5].observe(time.monotonic() - t_start, kind=kname)
             if failure is not None:
                 # A frame is acked/deduped as a UNIT. The error is fatal
                 # client-side (the pool never resends on _KIND_ERROR) —
@@ -959,7 +1176,7 @@ class _Listener:
                 # UPDATE a LATER update's success advances the _applied
                 # high-water mark past this seq, and an unpoisoned replay
                 # would then be answered with a false ACK (ADVICE r5).
-                if seq:
+                if dseq:
                     if _telemetry.enabled():
                         _metric_handles()[4].inc(site="apply_failed")
                     with self._applied_lock:
@@ -969,30 +1186,38 @@ class _Listener:
                 reply(_KIND_ERROR, seq, rule=failure)
                 return
             with self._applied_lock:
-                if seq:
+                if dseq:
                     # max(): concurrent applies of two updates to the same
                     # (inst, rank, client) finish on different pool
                     # workers — a plain store could regress the
                     # high-water mark
                     self._applied[dkey] = max(
-                        self._applied.get(dkey, 0), seq
+                        self._applied.get(dkey, 0), dseq
                     )
             reply(_KIND_ACK, seq, inst=inst_id, rank=rank)
         finally:
-            if seq:
+            if dseq:
                 with self._applied_lock:
                     done_ev = self._inflight.pop(ikey, None)
                 if done_ev is not None:
                     done_ev.set()
 
     def _finish_trigger(
-        self, reply, fut, seq, inst_id, rank, timeout, req_wire: int = 0
+        self, reply, fut, seq, inst_id, rank, timeout, req_wire: int = 0,
+        t_admit=None,
     ) -> None:
+        t_start = time.monotonic()
         try:
-            shard = fut.result(timeout)
+            with _telemetry.span("ps.server.apply", kind="trigger",
+                                 rank=rank):
+                shard = fut.result(timeout)
         except Exception as e:  # noqa: BLE001 - reported to the client
             reply(_KIND_ERROR, seq, rule=str(e))
             return
+        if _telemetry.enabled() and t_admit is not None:
+            met = _srv_metric_handles()
+            met[4].observe(t_start - t_admit, kind="trigger")
+            met[5].observe(time.monotonic() - t_start, kind="trigger")
         from ..utils.tracing import wire_stats
 
         if isinstance(shard, dict):
@@ -1039,6 +1264,7 @@ class _Listener:
 
     def close(self):
         self._stop.set()
+        self._loop.stop()  # joins the loop; closes every connection
         try:
             self._sock.close()
         except OSError:
@@ -1052,7 +1278,8 @@ class _Waiter:
     original order — and the completion slot. ``t0``/``kind`` are
     telemetry fields (set only when telemetry is enabled)."""
 
-    __slots__ = ("event", "frame", "reply", "error", "t0", "kind", "flight")
+    __slots__ = ("event", "frame", "reply", "error", "t0", "kind", "flight",
+                 "busy")
 
     def __init__(self, frame: _Buffers):
         self.event = threading.Event()
@@ -1064,6 +1291,9 @@ class _Waiter:
         # flight-recorder entry for this RPC (set only when the recorder
         # is on); completed/failed by complete()
         self.flight: Optional[list] = None
+        # BUSY/retry-after rejections received for this frame (drives the
+        # exponential backoff of the channel's busy resender)
+        self.busy: int = 0
 
 
 class _PeerChannel:
@@ -1107,6 +1337,16 @@ class _PeerChannel:
         # queue for many windows behind slow-but-live applies; only a
         # connection with NO traffic for a full window is wedged.
         self._last_reply = time.monotonic()
+        # BUSY/retry-after backoff state: rejected seqs bank here and a
+        # lazy resender thread replays them (in seq order, preserving
+        # the server's order fence) once the jittered due time passes.
+        # Guarded by _busy_cv, NEVER nested inside self.lock.
+        self._busy_cv = _lockmon.make_condition(
+            "transport.py:_PeerChannel._busy_cv"
+        )
+        self._busy_seqs: set = set()
+        self._busy_due = 0.0
+        self._busy_thread: Optional[threading.Thread] = None
         self.closed = False
 
     def _connect(self) -> socket.socket:
@@ -1180,6 +1420,14 @@ class _PeerChannel:
                 self._on_broken(gen, e)
                 return
             rseq = frame[4]  # server echoes the request seq
+            if frame[0] == _KIND_BUSY:
+                # admission-control reject: the frame was NOT applied.
+                # Keep the waiter pending and schedule a jittered-backoff
+                # replay — overload degrades to retry latency, and the
+                # BUSY itself counts as traffic for the silence watchdog
+                # (the server is alive, just shedding).
+                self._on_busy(rseq, frame[6])
+                continue
             with self.lock:
                 w = self.pending.pop(rseq, None)
                 self._unacked_replays = 0  # traffic flows: reset budget
@@ -1187,6 +1435,90 @@ class _PeerChannel:
             if w is not None:
                 w.reply = frame
                 w.event.set()
+
+    def _on_busy(self, rseq: int, hint: str) -> None:
+        import random
+
+        try:
+            hint_ms = int(hint)
+        except (TypeError, ValueError):
+            hint_ms = 0
+        with self.lock:
+            self._unacked_replays = 0
+            self._last_reply = time.monotonic()
+            w = self.pending.get(rseq)
+            if w is None:
+                return  # already failed/answered
+            w.busy += 1
+            attempts = w.busy
+        if _telemetry.enabled():
+            _metric_handles()[8].inc()
+        base = (hint_ms or constants.get("ps_busy_retry_ms")) / 1000.0
+        delay = min(2.0, base * (1 << min(attempts - 1, 6)))
+        delay *= random.uniform(0.5, 1.5)
+        due = time.monotonic() + delay
+        with self._busy_cv:
+            self._busy_seqs.add(rseq)
+            self._busy_due = max(self._busy_due, due)
+            if self._busy_thread is None or not self._busy_thread.is_alive():
+                self._busy_thread = threading.Thread(
+                    target=self._busy_resend_loop,
+                    name=f"tm-ps-busy-{self.proc}", daemon=True,
+                )
+                self._busy_thread.start()
+            self._busy_cv.notify_all()
+
+    def _busy_resend_loop(self) -> None:
+        """Replays BUSY-rejected frames after their backoff, in seq order
+        (the server's per-connection order fence admits the lowest
+        rejected seq first). Lives only while the channel does."""
+        while True:
+            with self._busy_cv:
+                while not self._busy_seqs and not self.closed:
+                    self._busy_cv.wait()
+                if self.closed:
+                    return
+                now = time.monotonic()
+                if now < self._busy_due:
+                    self._busy_cv.wait(self._busy_due - now)
+                    continue
+                seqs = sorted(self._busy_seqs)
+                self._busy_seqs.clear()
+            rebank = None
+            with self.lock:
+                if self.closed:
+                    return
+                try:
+                    sock = self._connected_locked()
+                    for s in seqs:
+                        w = self.pending.get(s)
+                        if w is not None:
+                            _send_buffers(sock, w.frame)
+                except (ConnectionError, OSError) as e:
+                    if self.sock is not None:
+                        # mid-send break on a live socket: that socket's
+                        # reader observes the break and _on_broken
+                        # replays every pending frame (these included)
+                        pass
+                    elif self._unacked_replays >= 1:
+                        self._fail_pending_locked(ConnectionError(
+                            f"parameter-server peer {self.proc} "
+                            f"unreachable during BUSY retry: {e}"
+                        ))
+                    else:
+                        # connect itself failed: no reader exists to
+                        # recover these frames — re-bank them for one
+                        # more backoff window, charged against the same
+                        # replay budget _on_broken uses
+                        self._unacked_replays += 1
+                        rebank = seqs
+            if rebank is not None:
+                due = time.monotonic() + (
+                    constants.get("ps_busy_retry_ms") / 1000.0
+                )
+                with self._busy_cv:
+                    self._busy_seqs.update(rebank)
+                    self._busy_due = max(self._busy_due, due)
 
     def _fail_pending_locked(self, err: Exception) -> None:
         while self.pending:
@@ -1262,13 +1594,14 @@ class _PeerChannel:
         payload_raw: bytes = b"",
         dtype_str: str = "",
         wire: Optional[int] = None,
+        oseq: int = 0,
     ):
         """Pipelined request/response."""
         return self.complete(
             self.submit(
                 kind, inst, rank, client, fp=fp, rule=rule,
                 payload_arr=payload_arr, payload_raw=payload_raw,
-                dtype_str=dtype_str, wire=wire,
+                dtype_str=dtype_str, wire=wire, oseq=oseq,
             )
         )
 
@@ -1284,6 +1617,7 @@ class _PeerChannel:
         payload_raw: bytes = b"",
         dtype_str: str = "",
         wire: Optional[int] = None,
+        oseq: int = 0,
     ) -> _Waiter:
         """Put one frame on the wire and return its waiter WITHOUT waiting
         for the reply — fan-out callers (allgather_blob, barrier) submit to
@@ -1344,7 +1678,7 @@ class _PeerChannel:
             seq = self.seq
             header, rule_b, dtype_b = _frame_header(
                 kind, inst, rank, client, seq, fp, wire_eff, nchunks,
-                rule, dtype_str, total_len,
+                rule, dtype_str, total_len, oseq,
             )
             w = _Waiter([header, rule_b, dtype_b])
             if _telemetry.enabled():
@@ -1465,6 +1799,8 @@ class _PeerChannel:
             self._fail_pending_locked(
                 ConnectionError("parameter-server transport closed")
             )
+        with self._busy_cv:
+            self._busy_cv.notify_all()  # release the busy resender
 
 
 class _PeerPool:
@@ -1518,6 +1854,21 @@ class Transport:
         self._delta_guard = _lockmon.make_lock(
             "transport.py:Transport._delta_guard"
         )
+        # replication failover state: processes observed dead (a channel
+        # raised ConnectionError after its replay budget) are skipped
+        # when routing down a shard's replica chain — but only for
+        # ps_dead_peer_retry_s, after which they are re-probed (a
+        # permanent mark would let one transient stall split the brain:
+        # this client routing to the replica forever while other clients
+        # still talk to the recovered head). Per-(inst, rank, client)
+        # origin-seq counters give every replicated update a
+        # channel-independent dedup identity that survives re-issue to a
+        # replica (see the oseq header field).
+        self._dead_procs: Dict[int, float] = {}
+        self._oseq: Dict[Tuple[int, int, int], int] = {}
+        self._oseq_lock = _lockmon.make_lock(
+            "transport.py:Transport._oseq_lock"
+        )
 
     @staticmethod
     def _exchange_addresses(host: str, port: int) -> Dict[int, Tuple[str, int]]:
@@ -1540,13 +1891,73 @@ class Transport:
             out[p] = (h, int(pt))
         return out
 
+    def next_oseq(self, inst: int, rank: int, client: int) -> int:
+        """Channel-independent monotone update id per (inst, rank,
+        client) — the dedup identity a replicated update keeps across
+        failover re-issues and chain forwarding."""
+        with self._oseq_lock:
+            v = self._oseq.get((inst, rank, client), 0) + 1
+            self._oseq[(inst, rank, client)] = v
+            return v
+
+    def _mark_dead(self, proc: int) -> None:
+        self._dead_procs[proc] = time.monotonic()
+
+    def _alive_chain(self, chain) -> List[int]:
+        ttl = constants.get("ps_dead_peer_retry_s")
+        now = time.monotonic()
+        alive = [
+            p for p in chain
+            if p not in self._dead_procs
+            or (ttl and now - self._dead_procs[p] >= ttl)
+        ]
+        return alive if alive else list(chain)  # last resort: retry all
+
     def update(
         self, proc: int, inst: int, rank: int, client: int, rule: str,
-        payload: np.ndarray, fp: int = 0,
+        payload: np.ndarray, fp: int = 0, chain=None, oseq: int = 0,
     ) -> None:
+        """Apply ``rule`` to shard ``rank`` on its owner. With a replica
+        ``chain`` (length > 1), the update carries an origin seq and
+        fails over down the chain: a dead head is marked and the SAME
+        update (same oseq) is re-issued to the next live replica — whose
+        applied high-water dedups the re-issue if the head's chain
+        forward already delivered it, so failover never loses or
+        double-applies an update."""
+        if chain is None or len(chain) <= 1:
+            self.pool.request(
+                proc, _KIND_UPDATE, inst, rank, client,
+                fp=fp, rule=rule, payload_arr=payload, oseq=oseq,
+            )
+            return
+        if not oseq:
+            oseq = self.next_oseq(inst, rank, client)
+        last: Optional[Exception] = None
+        for p in self._alive_chain(chain):
+            try:
+                self.pool.request(
+                    p, _KIND_UPDATE, inst, rank, client,
+                    fp=fp, rule=rule, payload_arr=payload, oseq=oseq,
+                )
+                return
+            except ConnectionError as e:
+                self._mark_dead(p)
+                last = e
+        raise ConnectionError(
+            f"all replicas of shard {rank} (chain {list(chain)}) "
+            f"unreachable: {last}"
+        )
+
+    def forward_update(
+        self, proc: int, inst: int, rank: int, client: int, rule: str,
+        payload: np.ndarray, fp: int = 0, oseq: int = 0,
+    ) -> None:
+        """Chain-forward an APPLIED update to the next replica, keeping
+        the original (client, oseq) dedup identity. Called by the
+        server-side replica pump in apply order."""
         self.pool.request(
             proc, _KIND_UPDATE, inst, rank, client,
-            fp=fp, rule=rule, payload_arr=payload,
+            fp=fp, rule=rule, payload_arr=payload, oseq=oseq,
         )
 
     def update_multi(
@@ -1623,6 +2034,29 @@ class Transport:
             self._delta_cache[key] = entry
 
     def trigger(
+        self, proc: int, inst: int, rank: int, client: int, fp: int = 0,
+        logical_dtype=np.float32, chain=None,
+    ) -> np.ndarray:
+        """Fetch shard ``rank``. Served by the chain head; on a dead
+        head the fetch fails over to the next live replica (which holds
+        the chain-forwarded state)."""
+        if chain is not None and len(chain) > 1:
+            last: Optional[Exception] = None
+            for p in self._alive_chain(chain):
+                try:
+                    return self._trigger_one(
+                        p, inst, rank, client, fp, logical_dtype
+                    )
+                except ConnectionError as e:
+                    self._mark_dead(p)
+                    last = e
+            raise ConnectionError(
+                f"all replicas of shard {rank} (chain {list(chain)}) "
+                f"unreachable: {last}"
+            )
+        return self._trigger_one(proc, inst, rank, client, fp, logical_dtype)
+
+    def _trigger_one(
         self, proc: int, inst: int, rank: int, client: int, fp: int = 0,
         logical_dtype=np.float32,
     ) -> np.ndarray:

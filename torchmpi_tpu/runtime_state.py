@@ -33,6 +33,48 @@ class NotStartedError(RuntimeError):
     pass
 
 
+def _apply_env_constants() -> None:
+    """Apply ``launch --set-constant`` knob overrides (the
+    TORCHMPI_TPU_CONSTANTS env var: ``name=value;name=value``). Values
+    are coerced to the knob's current type (bool accepts
+    1/0/true/false); unknown names or uncoercible values fail loudly —
+    a typo'd fabric knob must never launch a silently-misconfigured
+    world."""
+    spec = os.environ.get("TORCHMPI_TPU_CONSTANTS", "")
+    if not spec:
+        return
+    snap = constants.snapshot()
+    for item in spec.split(";"):
+        if not item.strip():
+            continue
+        name, _, raw = item.partition("=")
+        name = name.strip()
+        if name not in snap:
+            raise KeyError(
+                f"TORCHMPI_TPU_CONSTANTS names unknown knob {name!r} "
+                "(see constants.snapshot() for valid knobs)"
+            )
+        current, raw = snap[name], raw.strip()
+        if isinstance(current, bool):
+            low = raw.lower()
+            if low in ("1", "true", "yes", "on"):
+                value: object = True
+            elif low in ("0", "false", "no", "off"):
+                value = False
+            else:
+                raise ValueError(
+                    f"TORCHMPI_TPU_CONSTANTS: bool knob {name!r} got "
+                    f"{raw!r} (expected 1/0/true/false/yes/no/on/off)"
+                )
+        elif isinstance(current, int):
+            value = int(raw)
+        elif isinstance(current, float):
+            value = float(raw)
+        else:
+            value = raw
+        constants.set(name, value)
+
+
 def start(
     with_tpu: Optional[bool] = None,
     with_ici_groups: bool = True,
@@ -90,6 +132,9 @@ def start(
     with _lock:
         if _started:
             raise RuntimeError("torchmpi_tpu.start() called twice")
+    # launcher-provided knob overrides (`launch --set-constant NAME=VALUE`)
+    # apply first; explicit start(**overrides) beat them
+    _apply_env_constants()
     for _name, _value in constant_overrides.items():
         constants.set(_name, _value)
     if with_tpu is False or os.environ.get(
@@ -217,7 +262,9 @@ def start(
                 load_tuning(comm=_stack.current, apply=True)
             except Exception:
                 pass  # cache is best-effort; defaults are always safe
-            # explicit user overrides beat persisted tuned values
+            # launcher + explicit user overrides beat persisted tuned
+            # values (explicit last: it wins over the launcher's too)
+            _apply_env_constants()
             for _name, _value in constant_overrides.items():
                 constants.set(_name, _value)
 

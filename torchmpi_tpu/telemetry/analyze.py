@@ -351,27 +351,63 @@ def _series_labels(label_str: str) -> dict:
     return out
 
 
+def _kind_series(metrics: dict, name: str) -> dict:
+    """Histogram series of ``name`` keyed by its ``kind`` label."""
+    out = {}
+    for label_str, h in metrics.get(name, {}).get("series", {}).items():
+        kind = _series_labels(label_str).get("kind", label_str)
+        out[kind] = {
+            "count": h.get("count"),
+            "mean_s": (
+                round(h["sum"] / h["count"], 6) if h.get("count") else None
+            ),
+            "quantiles_s": h.get("quantiles", {}),
+        }
+    return out
+
+
 def ps_health(ranks: Dict[int, dict]) -> dict:
-    """Per-server RPC latency quantiles + queue depth over time."""
+    """Per-server RPC latency quantiles, queue depth over time,
+    connection lifecycle, admission control, and the server-side
+    queue-vs-apply attribution (where an RPC's latency went: waiting for
+    a pool worker, or applying the rule)."""
     servers = {}
     for rank, data in sorted(ranks.items()):
         metrics = data["snapshot"].get("metrics", {})
-        lat = metrics.get("tm_ps_rpc_latency_seconds", {}).get("series", {})
-        rpc = {}
-        for label_str, h in lat.items():
-            kind = _series_labels(label_str).get("kind", label_str)
-            rpc[kind] = {
-                "count": h.get("count"),
-                "mean_s": (
-                    round(h["sum"] / h["count"], 6) if h.get("count") else None
-                ),
-                "quantiles_s": h.get("quantiles", {}),
+        rpc = _kind_series(metrics, "tm_ps_rpc_latency_seconds")
+        queue_t = _kind_series(metrics, "tm_ps_server_queue_seconds")
+        apply_t = _kind_series(metrics, "tm_ps_server_apply_seconds")
+        attribution = {}
+        for kind in set(queue_t) | set(apply_t):
+            q = (queue_t.get(kind) or {}).get("mean_s")
+            a = (apply_t.get(kind) or {}).get("mean_s")
+            attribution[kind] = {
+                "queue_mean_s": q,
+                "apply_mean_s": a,
+                # the actionable verdict: a queue-dominated server needs
+                # admission budget / pool tuning; an apply-dominated one
+                # needs faster rules or more shards
+                "dominant": (
+                    "queue" if (q or 0) > (a or 0) else "apply"
+                ) if (q is not None or a is not None) else None,
             }
+        connections = {}
+        for name, key in (
+            ("tm_ps_connections_open", "open"),
+            ("tm_ps_accepts_total", "accepted"),
+            ("tm_ps_disconnects_total", "disconnected"),
+            ("tm_ps_busy_rejected_total", "busy_rejected"),
+        ):
+            series = metrics.get(name, {}).get("series", {})
+            if series:
+                connections[key] = sum(series.values())
         listener = metrics.get("ps_listener")
         timeline = metrics.get("ps_queue_timeline") or []
-        if rpc or listener or timeline:
+        if rpc or listener or timeline or attribution or connections:
             servers[str(rank)] = {
                 "rpc_latency": rpc,
+                "server_time": attribution,
+                "connections": connections or None,
                 "listener": listener,
                 "queue_depth_timeline": timeline,
                 "queue_depth_max": max(
