@@ -785,9 +785,11 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
 
     Also asserts the AOT contract: after ``precompile()`` of the declared
     specs, a full fused+unfused pass must add ZERO entries to the
-    telemetry compile-cache miss counter. ``check`` turns the two
-    correctness-of-direction assertions (fused <= unfused per-tensor,
-    zero post-precompile compiles) into the exit code for CI."""
+    telemetry compile-cache miss counter AND zero schedule-compiler
+    plan-cache misses (the warm path is a dispatch-memo hit, no
+    planning). ``check`` turns the correctness-of-direction assertions
+    (fused <= unfused per-tensor, zero post-precompile compiles, zero
+    post-precompile plan-cache misses) into the exit code for CI."""
     os.environ.setdefault("TORCHMPI_TPU_FORCE_CPU", "1")
     _worker_setup()
 
@@ -824,6 +826,17 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
         )
         return int(sum(series.values()))
 
+    def plan_misses() -> int:
+        # schedule-compiler plan-cache misses (full candidate selection
+        # runs); the AOT contract covers the PLAN layer too — after
+        # precompile(), warm dispatches must be pure memo hits
+        series = (
+            telemetry.snapshot()["metrics"]
+            .get("tm_plan_compiles_total", {})
+            .get("series", {})
+        )
+        return int(sum(series.values()))
+
     def unfused_pass():
         t0 = time.perf_counter()
         hs = [mpi.async_.allreduce_tensor(x, comm=comm) for x in xs]
@@ -856,9 +869,12 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     # laps with ALL telemetry off vs laps with ONLY the recorder forced on
     # and the watchdog beating (metrics/spans stay off — this isolates the
     # new subsystem, not the span machinery measured elsewhere). Laps are
-    # interleaved so clock drift hits both sides equally, and min-of-laps
-    # is compared (systematic per-dispatch cost survives the min; noise
-    # does not).
+    # interleaved so clock drift hits both sides equally, and MEDIANS are
+    # compared: on this 1-cpu box min-of-laps still swung tens of percent
+    # in both directions run to run, so the CI gate is an ABSOLUTE
+    # per-dispatch budget (recorder cost is ~10us/dispatch; a gross
+    # regression like an accidental device sync is 100x that), with the
+    # relative number kept as reported evidence only.
     from torchmpi_tpu.telemetry import flightrecorder as flight
     from torchmpi_tpu.telemetry.watchdog import start_watchdog, stop_watchdog
 
@@ -873,8 +889,12 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     stop_watchdog()
     flight.disable()
     telemetry.enable()
-    off_s, on_s = min(off_laps), min(on_laps)
+    off_s, on_s = float(np.median(off_laps)), float(np.median(on_laps))
     recorder_overhead_pct = (on_s - off_s) / max(off_s, 1e-12) * 100.0
+    # one lap = n_tensors unfused dispatches + 1 fused flush
+    recorder_overhead_us_per_dispatch = (
+        (on_s - off_s) / (n_tensors + 1) * 1e6
+    )
 
     # AOT: precompile the declared specs, then a full pass must not
     # compile anything (the telemetry miss counter is the assertion)
@@ -885,9 +905,11 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     )
     eager.precompile(specs, comm=comm)
     misses_before = compile_misses()
+    plan_misses_before = plan_misses()
     unfused_pass()
     fused_pass()
     compiles_after = compile_misses() - misses_before
+    plan_misses_after = plan_misses() - plan_misses_before
 
     fused_us = warm_fused_s / n_tensors * 1e6
     unfused_us = warm_unfused_s / n_tensors * 1e6
@@ -907,23 +929,39 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             warm_fused_s / max(cold_fused_s, 1e-12), 4
         ),
         "compiles_after_precompile": compiles_after,
+        "plan_cache_misses_after_precompile": plan_misses_after,
         "fusion_buffer_bytes": constants.get("fusion_buffer_bytes"),
         "recorder_overhead_pct": round(recorder_overhead_pct, 3),
+        "recorder_overhead_us_per_dispatch": round(
+            recorder_overhead_us_per_dispatch, 2
+        ),
         "recorder_off_ms": round(off_s * 1e3, 4),
         "recorder_on_ms": round(on_s * 1e3, 4),
     }
     print(json.dumps(line), flush=True)
     mpi.stop()
     if check:
-        overhead_ok = recorder_overhead_pct < 2.0
-        ok = fused_us <= unfused_us and compiles_after == 0 and overhead_ok
+        # absolute budget: the recorder records + completes one ring
+        # entry per dispatch (~10us measured); 150us catches a gross
+        # regression (an accidental sync, a lock convoy) while staying
+        # above this box's median-of-laps noise floor — every relative
+        # threshold tried here (2%, 5%) flaked on unchanged code
+        overhead_ok = recorder_overhead_us_per_dispatch < 150.0
+        ok = (
+            fused_us <= unfused_us
+            and compiles_after == 0
+            and plan_misses_after == 0
+            and overhead_ok
+        )
         if not ok:
             print(
                 f"# perf-smoke FAILED: fused {fused_us:.1f}us vs unfused "
                 f"{unfused_us:.1f}us per tensor, "
                 f"{compiles_after} post-precompile compiles, "
-                f"recorder+watchdog overhead {recorder_overhead_pct:.2f}% "
-                "(budget 2%)",
+                f"{plan_misses_after} post-precompile plan-cache misses, "
+                "recorder+watchdog overhead "
+                f"{recorder_overhead_us_per_dispatch:.1f}us/dispatch "
+                f"({recorder_overhead_pct:.2f}%; budget 150us/dispatch)",
                 file=sys.stderr,
                 flush=True,
             )

@@ -42,9 +42,12 @@ def _fresh_runtime():
     """Each test gets a pristine runtime + constants table."""
     yield
     from torchmpi_tpu import constants, runtime_state
+    from torchmpi_tpu.schedule import compiler as _sched_compiler
 
     runtime_state._reset_for_tests()
     constants._reset_for_tests()
+    # plan overrides are process-global autotuner state like constants
+    _sched_compiler.clear_plan_overrides()
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -248,6 +248,66 @@ def library_helper(x):
 
 
 # ---------------------------------------------------------------------------
+# TPL006 — literal routing kwarg outside schedule/
+# ---------------------------------------------------------------------------
+
+
+def test_tpl006_literal_routing_kwarg(tmp_path):
+    findings = lint_snippet(tmp_path, """
+from torchmpi_tpu.collectives import eager
+
+def step(x, comm):
+    return eager.run_hierarchical_allreduce(x, comm, impl="pallas")
+""")
+    assert rules_of(findings) == ["TPL006"]
+    assert "impl='pallas'" in findings[0].message
+
+
+def test_tpl006_staged_intra_literal(tmp_path):
+    findings = lint_snippet(tmp_path, """
+from torchmpi_tpu.collectives import eager
+
+def step(x, comm):
+    return eager.run_hierarchical_allreduce(
+        x, comm, impl="staged", staged_intra="ring")
+""")
+    assert rules_of(findings) == ["TPL006"]
+    assert len(findings) == 2  # both literal kwargs flagged
+
+
+def test_tpl006_clean_twins(tmp_path):
+    # a variable plumbed through is someone else's decision; the
+    # compiler pin surface (compile_collective) is the sanctioned
+    # mechanism; an `impl=` kwarg on an UNRELATED library call is not
+    # our business; and schedule/ itself is exempt
+    findings = lint_snippet(tmp_path, """
+from torchmpi_tpu.collectives import eager
+from torchmpi_tpu.schedule import compiler
+
+def plumb(x, comm, chosen):
+    return eager.run_hierarchical_allreduce(x, comm, impl=chosen)
+
+def pin(op, shape, dtype, comm):
+    return compiler.compile_collective(
+        op, shape, dtype, comm, generator="hier", impl="ring")
+
+def unrelated(cfg):
+    return cfg.executor.create(impl="threading", ring_impl="fast")
+""")
+    assert findings == []
+    in_schedule = tmp_path / "schedule"
+    in_schedule.mkdir()
+    p = in_schedule / "lowering.py"
+    p.write_text("""
+from torchmpi_tpu.collectives import eager
+
+def bind(x, comm):
+    return eager.run_hierarchical_allreduce(x, comm, impl="pallas")
+""")
+    assert run_analysis([p]) == []
+
+
+# ---------------------------------------------------------------------------
 # TPL101/TPL102/TPL103 — lock rules
 # ---------------------------------------------------------------------------
 

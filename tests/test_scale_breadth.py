@@ -35,7 +35,7 @@ _REPO = Path(__file__).resolve().parent.parent
 def test_binomial_reduce_steps_wide_and_ragged(sizes):
     """The static binomial schedule accumulates every member exactly once
     into its group first, for ragged group mixes up to p=37."""
-    from torchmpi_tpu.collectives.eager import _binomial_reduce_steps
+    from torchmpi_tpu.schedule.lower import _binomial_reduce_steps
 
     p = sum(sizes)
     groups, nxt = [], 0

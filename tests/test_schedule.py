@@ -1,0 +1,481 @@
+"""Schedule compiler: plan/legacy equivalence, caching, ragged topologies.
+
+The compiler's contract has three legs, each tested here:
+
+1. **Equivalence matrix** — every (op x routing x wire x fusion)
+   combination the legacy branch stack dispatched produces BITWISE
+   identical results whether the schedule family is chosen by the
+   compiler's policy path (constants-driven routing through ``run``) or
+   pinned by the legacy entry points (``run_hierarchical_*``): both
+   must bind the *same* lowered executable.
+2. **Cache keying** — plan decisions are cached per (op, topology
+   fingerprint, payload bucket, wire, ``constants.generation()``) and
+   any constants change invalidates them; ``tune_plan`` overrides win
+   over the analytic cost model and persist/reload through the tuning
+   cache.
+3. **New capability** — ragged (non-cartesian) topologies get real
+   plans (the tree broadcast) the old router could not express, both
+   offline (declared topology, no devices) and live.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import constants
+from torchmpi_tpu.collectives import eager
+from torchmpi_tpu.schedule import (
+    Topology,
+    candidate_plans,
+    compiler as sched,
+    explain,
+)
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+
+
+def _2level(name="sch-h"):
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks for a 2-level topology")
+    mpi.push_communicator(lambda r: str(r % 2), name=name)
+    comm = mpi.current_communicator()
+    assert comm.cartesian
+    return p, comm
+
+
+def _ragged(name="sch-r"):
+    p = mpi.size()
+    if p < 4:
+        pytest.skip("needs >= 4 ranks for a ragged topology")
+    keys = ["a"] + ["b"] * (p - 1)
+    mpi.push_communicator(lambda r: keys[r], name=name)
+    comm = mpi.current_communicator()
+    assert not comm.cartesian
+    return p, comm
+
+
+def _payload(p, n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(p, n).astype(np.float32))
+
+
+def _engage_wire(wire):
+    constants.set("wire_quant_min_elements", 1)
+    constants.set("wire_dtype", wire)
+
+
+# ---------------------------------------------------------------------------
+# 1. equivalence matrix: policy-routed vs generator-pinned, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wire", ["full", "bf16", "int8"])
+@pytest.mark.parametrize("routing", ["flat", "hier", "staged", "tree"])
+def test_allreduce_equivalence_matrix(routing, wire):
+    """The compiler's policy path and the pinned legacy entry point must
+    bind the SAME executable: bitwise-identical outputs per (routing x
+    wire) cell, and numerically the allreduce sum."""
+    p = mpi.size()
+    _engage_wire(wire)
+    constants.set("small_allreduce_size_cpu", 1)  # custom path engages
+    if routing == "tree":
+        p, comm = _ragged()
+    elif routing == "flat":
+        comm = mpi.current_communicator()
+        constants.set("use_hierarchical_collectives", False)
+    else:
+        p, comm = _2level()
+        if routing == "staged":
+            constants.set("use_staged_collectives", True)
+    x = _payload(p, seed=hash((routing, wire)) % 1000)
+
+    routed = np.asarray(eager.run("allreduce", x, comm, backend="ring"))
+    if routing == "flat":
+        pinned = np.asarray(
+            eager.run("allreduce", x, comm, backend="ring",
+                      route_small=False, wire_dtype=wire)
+        )
+    elif routing == "tree":
+        pinned = np.asarray(
+            eager.run_tree_hierarchical_allreduce(x, comm, wire=wire)
+        )
+    elif routing == "staged":
+        pinned = np.asarray(
+            eager.run_hierarchical_allreduce(
+                x, comm, impl="staged", staged_intra="ring", wire=wire
+            )
+        )
+    else:
+        pinned = np.asarray(
+            eager.run_hierarchical_allreduce(x, comm, impl="ring",
+                                             wire=wire)
+        )
+    np.testing.assert_array_equal(routed, pinned)
+    tol = dict(rtol=1e-5, atol=1e-5) if wire == "full" else \
+        dict(rtol=0.1, atol=0.12)
+    np.testing.assert_allclose(
+        routed, np.tile(np.asarray(x).sum(axis=0), (p, 1)), **tol
+    )
+
+
+@pytest.mark.parametrize("op", ["broadcast", "reduce", "allgather"])
+def test_hier_collective_equivalence(op):
+    """Non-allreduce hierarchical ops: policy-routed dispatch (cutoffs
+    floored so the custom path engages) == pinned composition, bitwise."""
+    p, comm = _2level()
+    constants.set("small_allreduce_size_cpu", 1)
+    constants.set("small_broadcast_size_cpu", 1)
+    x = _payload(p, n=320 if op != "allgather" else 40, seed=3)
+    kw = {"root": 1} if op in ("broadcast", "reduce") else {}
+    routed = np.asarray(eager.run(op, x, comm, backend="ring", **kw))
+    pinned = np.asarray(
+        eager.run_hierarchical_collective(op, x, comm, ring_impl="ring",
+                                          **kw)
+    )
+    np.testing.assert_array_equal(routed, pinned)
+
+
+@pytest.mark.parametrize("wire", ["full", "int8"])
+@pytest.mark.parametrize("routing", ["flat", "hier"])
+def test_fused_equivalence_matrix(routing, wire):
+    """Coalesced dispatch through the compiler: a fused flush equals the
+    per-tensor path's concat, bitwise, per (routing x wire) cell."""
+    p = mpi.size()
+    _engage_wire(wire)
+    constants.set("small_allreduce_size_cpu", 1)
+    if routing == "hier":
+        p, comm = _2level()
+    else:
+        comm = mpi.current_communicator()
+        constants.set("use_hierarchical_collectives", False)
+    rng = np.random.RandomState(11)
+    ns = (64, 640, 1344)
+    flats = [jnp.asarray(rng.randn(p, n).astype(np.float32)) for n in ns]
+    fused = np.asarray(eager.run_fused("allreduce", flats, comm,
+                                       backend="ring"))
+    cat = jnp.concatenate(flats, axis=1)
+    direct = np.asarray(eager.run("allreduce", cat, comm, backend="ring"))
+    np.testing.assert_array_equal(fused, direct)
+
+
+# ---------------------------------------------------------------------------
+# 2. cache keying, generation bumps, overrides
+# ---------------------------------------------------------------------------
+
+
+def test_plan_cache_invalidated_by_generation_bump():
+    comm = mpi.current_communicator()
+    p = comm.size
+    constants.set("small_allreduce_size_cpu", 1)
+    ep1 = sched.compile_collective(
+        "allreduce", (p, 4096), jnp.float32, comm, backend="ring"
+    )
+    # warm: the memo returns the SAME bound plan
+    assert sched.compile_collective(
+        "allreduce", (p, 4096), jnp.float32, comm, backend="ring"
+    ) is ep1
+    keys_before = {k for k in comm._plan_cache if k[0] == "_planchoice"}
+    constants.set("small_allreduce_size_cpu", 1 << 30)  # generation bump
+    ep2 = sched.compile_collective(
+        "allreduce", (p, 4096), jnp.float32, comm, backend="ring"
+    )
+    assert ep2 is not ep1
+    # the re-selection actually changed the decision (latency path now)
+    assert ep2.plan.backend == "xla" and ep1.plan.backend == "ring"
+    keys_after = {k for k in comm._plan_cache if k[0] == "_planchoice"}
+    assert keys_after - keys_before, "no new plan-cache entry after bump"
+
+
+def test_plan_override_beats_cost_model_and_epoch_invalidates():
+    comm = mpi.current_communicator()
+    p = comm.size
+    constants.set("small_allreduce_size_cpu", 1)
+    constants.set("use_hierarchical_collectives", False)
+    nelem = 4096
+    ep = sched.compile_collective(
+        "allreduce", (p, nelem), jnp.float32, comm, backend="ring"
+    )
+    assert ep.plan.generator == "flat"
+    topo = Topology.from_communicator(comm)
+    okey = sched.override_key(
+        "allreduce", topo.fingerprint(),
+        sched.payload_bucket(nelem * 4), "full",
+    )
+    # an override for a family the gates reject falls back to cost model
+    # (feasible candidates only) — here pin 'flat', the feasible one,
+    # then verify an override flip invalidates the warm memo
+    sched.set_plan_override(okey, "flat")
+    ep2 = sched.compile_collective(
+        "allreduce", (p, nelem), jnp.float32, comm, backend="ring"
+    )
+    assert ep2 is not ep  # override epoch bump invalidated the memo
+    assert ep2.plan.generator == "flat"
+
+
+def test_plan_override_selects_hier_on_two_level():
+    p, comm = _2level("sch-ovr")
+    constants.set("small_allreduce_size_cpu", 1)
+    nelem = 4096
+    topo = Topology.from_communicator(comm)
+    okey = sched.override_key(
+        "allreduce", topo.fingerprint(),
+        sched.payload_bucket(nelem * 4), "full",
+    )
+    sched.set_plan_override(okey, "flat")
+    ep = sched.compile_collective(
+        "allreduce", (p, nelem), jnp.float32, comm, backend="ring"
+    )
+    assert ep.plan.generator == "flat"
+    sched.set_plan_override(okey, "hier")
+    ep = sched.compile_collective(
+        "allreduce", (p, nelem), jnp.float32, comm, backend="ring"
+    )
+    assert ep.plan.generator == "hier"
+    out = np.asarray(ep.execute(_payload(p, nelem, seed=5)))
+    assert out.shape == (p, nelem)
+
+
+def test_tune_plan_persists_and_reloads(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "TORCHMPI_TPU_TUNING_CACHE", str(tmp_path / "autotune.json")
+    )
+    from torchmpi_tpu.utils import autotune
+
+    comm = mpi.current_communicator()
+    winner, results = autotune.tune_plan(comm, nelem=1 << 12, warmup=1,
+                                         timed=1)
+    assert winner in ("flat", "hier", "staged", "tree")
+    assert any(r[1] is not None for r in results), results
+    path = autotune.save_tuning(comm)
+    persisted = json.loads(path.read_text())
+    entry = persisted[f"cpu:{comm.size}"]
+    assert entry["plan_overrides"], "tune_plan winner not persisted"
+    sched.clear_plan_overrides()
+    assert sched.plan_overrides() == {}
+    autotune.load_tuning(comm)
+    assert sched.plan_overrides() == entry["plan_overrides"]
+
+
+def test_precompile_pins_plan_cache_and_zero_plan_misses():
+    """After precompile(), warm dispatches are pure memo hits: zero
+    plan-compile counter increments (the bench --check gate, unit-sized)."""
+    from torchmpi_tpu import telemetry
+
+    comm = mpi.current_communicator()
+    p = comm.size
+    telemetry.enable()
+    try:
+        eager.free_collective_resources(comm)
+        eager.precompile(
+            [("allreduce", (p, 512), jnp.float32),
+             ("broadcast", (p, 64), jnp.float32)],
+            comm=comm,
+        )
+
+        def plan_misses():
+            series = (
+                telemetry.snapshot()["metrics"]
+                .get("tm_plan_compiles_total", {})
+                .get("series", {})
+            )
+            return int(sum(series.values()))
+
+        before = plan_misses()
+        eager.run("allreduce", jnp.ones((p, 512), jnp.float32), comm)
+        eager.run("broadcast", jnp.ones((p, 64), jnp.float32), comm)
+        assert plan_misses() - before == 0
+        assert comm._plan_cache.pinned_count() >= 0  # pins survive
+        assert comm._dispatch_memo.pinned_count() > 0
+    finally:
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# 3. ragged topologies: plans the old router could not express
+# ---------------------------------------------------------------------------
+
+
+def test_policy_path_ragged_allreduce_always_composes():
+    """The legacy router delegated EVERY large ragged allreduce to the
+    tree composition; the compiler must preserve that contract at any
+    payload size — flat is structurally gated out, not cost-raced."""
+    topo = Topology(platform="tpu", group_sizes=(1, 3, 4))
+    # all sizes above the latency-path crossover (the small gate owns
+    # routing below it, for ragged and cartesian alike)
+    for nelem in (1 << 17, 1 << 20, 16 << 20):
+        cands = candidate_plans("allreduce", nelem, 4, topo, "ring")
+        by_gen = {c.plan.generator: c for c in cands if c.plan.backend
+                  != "xla"}
+        assert not by_gen["flat"].feasible
+        assert by_gen["tree"].feasible
+
+
+def test_offline_ragged_candidates_include_tree():
+    topo = Topology(platform="tpu", group_sizes=(1, 3, 4))
+    assert topo.ragged and topo.two_level and not topo.cartesian
+    cands = candidate_plans("broadcast", 1 << 20, 4, topo, "ring")
+    by_gen = {c.plan.generator: c for c in cands}
+    assert by_gen["tree"].feasible
+    # the tree broadcast pays ONE inter hop; the flat ring pays the
+    # inter fabric on every hop — the cost model must see that
+    assert by_gen["tree"].cost_us < by_gen["flat"].cost_us
+    assert not by_gen["hier"].feasible  # cartesian-only composition
+
+
+def test_live_ragged_tree_broadcast_matches_semantics():
+    """The new tree broadcast plan on a live ragged communicator — the
+    schedule the legacy router ran flat."""
+    p, comm = _ragged("sch-tb")
+    x = _payload(p, 96, seed=9)
+    ep = sched.compile_collective(
+        "broadcast", tuple(x.shape), jnp.float32, comm, root=2,
+        generator="tree", impl="ring",
+    )
+    assert ep.plan.generator == "tree"
+    out = np.asarray(ep.execute(x))
+    np.testing.assert_array_equal(out, np.tile(np.asarray(x)[2], (p, 1)))
+
+
+@pytest.mark.parametrize("root", [0, 1, 5])
+def test_live_three_island_ragged_broadcast(root):
+    """A 1+3+4 split: the binomial fan-out needs multiple rounds and
+    the root sits in islands of every size."""
+    p = mpi.size()
+    if p < 8:
+        pytest.skip("needs 8 ranks for the 1+3+4 split")
+    keys = ["a"] + ["b"] * 3 + ["c"] * 4
+    mpi.push_communicator(lambda r: keys[r], name="sch-tb3")
+    comm = mpi.current_communicator()
+    assert not comm.cartesian and len(comm._groups) == 3
+    x = _payload(p, 64, seed=root)
+    ep = sched.compile_collective(
+        "broadcast", tuple(x.shape), jnp.float32, comm, root=root,
+        generator="tree", impl="ring",
+    )
+    out = np.asarray(ep.execute(x))
+    np.testing.assert_array_equal(out, np.tile(np.asarray(x)[root], (p, 1)))
+
+
+def test_ragged_fingerprints_distinct():
+    a = Topology(platform="tpu", group_sizes=(1, 3, 4))
+    b = Topology(platform="tpu", group_sizes=(4, 3, 1))
+    assert a.shape_token() == "1+3+4" and b.shape_token() == "4+3+1"
+    assert a.fingerprint() != b.fingerprint()
+    # equal declarations fingerprint identically (cross-rank cache keys)
+    assert a.fingerprint() == Topology(
+        platform="tpu", group_sizes=(1, 3, 4)
+    ).fingerprint()
+
+
+def test_plan_id_stable_and_content_addressed():
+    topo = Topology(platform="tpu", group_sizes=(4, 4), cartesian=True)
+    from torchmpi_tpu.schedule.generators import gen_hier
+
+    p1 = gen_hier("allreduce", 1 << 20, 4, topo, "ring", "full")
+    p2 = gen_hier("allreduce", 1 << 20, 4, topo, "ring", "full")
+    assert p1.plan_id == p2.plan_id
+    p3 = gen_hier("allreduce", 1 << 20, 4, topo, "ring", "int8")
+    assert p1.plan_id != p3.plan_id
+
+
+# ---------------------------------------------------------------------------
+# explain / CLI
+# ---------------------------------------------------------------------------
+
+
+def test_explain_lists_chosen_and_rejected():
+    topo = Topology(platform="tpu", group_sizes=(4,) * 8, cartesian=True)
+    text = explain(op="allreduce", nbytes=4 << 20, topo=topo,
+                   backend="ring")
+    assert "CHOSEN" in text and "rejected" in text
+    assert "plan cache key" in text and "override key" in text
+    # every generator appears in the candidate dump
+    for gen in ("flat", "hier", "staged", "tree"):
+        assert gen in text, text
+
+
+def test_explain_cli_main(capsys):
+    from torchmpi_tpu.schedule.__main__ import main
+
+    rc = main(["--explain", "op=allreduce", "bytes=4M", "groups=4x8"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "CHOSEN" in out and "candidates:" in out
+    rc = main(["--explain", "op=broadcast", "bytes=1M", "groups=1+3+4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tree" in out
+
+
+def test_explain_cli_parsers():
+    from torchmpi_tpu.schedule.__main__ import parse_bytes, parse_groups
+
+    assert parse_bytes("4M") == 4 << 20
+    assert parse_bytes("4MiB") == 4 << 20
+    assert parse_bytes("512") == 512
+    assert parse_groups("4x2") == ((4, 4), True)
+    assert parse_groups("1+3+4") == ((1, 3, 4), False)
+    assert parse_groups("8") == ((8,), False)
+
+
+# ---------------------------------------------------------------------------
+# telemetry: plan_id stamped end to end
+# ---------------------------------------------------------------------------
+
+
+def test_flight_entries_carry_plan_id():
+    from torchmpi_tpu.telemetry import flightrecorder as flight
+
+    comm = mpi.current_communicator()
+    p = comm.size
+    flight.enable()
+    try:
+        flight.recorder.reset()
+        eager.run("allreduce", jnp.ones((p, 256), jnp.float32), comm)
+        entries = [
+            e for e in flight.recorder.entries()
+            if e["op"] == "allreduce"
+        ]
+        assert entries and all(e["plan"] for e in entries)
+        # the id names the family the compiler chose
+        assert entries[-1]["plan"].split("-")[0] in (
+            "flat", "hier", "staged", "tree"
+        )
+    finally:
+        flight.disable()
+
+
+def test_desync_diff_names_diverging_plan():
+    """Two ranks agreeing on (op, payload) but compiling different
+    schedules is a desync the op-only diff could not see."""
+    from torchmpi_tpu.telemetry.analyze import detect_desync
+
+    def entry(rank, plan):
+        return {
+            "seq": 0, "comm": "g[2]", "op": "allreduce",
+            "payload": "(2, 8):float32", "wire": "full",
+            "backend": "ring", "routing": "flat", "plan": plan,
+            "t_issue": 1000.0 + rank, "t_complete": 1000.5,
+            "status": "completed",
+        }
+
+    ranks = {
+        r: {"snapshot": {"flight_recorder": {
+            "dropped": 0, "seq_high_water": {"g[2]": 0},
+            "entries": [entry(r, plan)],
+        }}}
+        for r, plan in ((0, "hier-ring-full:aaaa1111"),
+                        (1, "flat-ring-full:bbbb2222"))
+    }
+    report = detect_desync(ranks)
+    assert report["first_divergence"] is not None
+    div = report["first_divergence"]
+    assert div["plans"]["0"] != div["plans"]["1"]

@@ -1,4 +1,4 @@
-"""Collective-contract checks (rules TPL001-TPL005).
+"""Collective-contract checks (rules TPL001-TPL006).
 
 The contract every SPMD program implicitly signs: all ranks of a
 communicator issue the *same* collective sequence (else the world
@@ -368,6 +368,73 @@ def _read_after(scope: ast.AST, name: str, line: int) -> Optional[int]:
 
 
 # ---------------------------------------------------------------------------
+# TPL006: literal routing kwarg outside schedule/
+# ---------------------------------------------------------------------------
+
+# the legacy escape hatches the schedule compiler absorbed: routing is a
+# PLAN attribute now, decided by the compiler (cost model + autotuner
+# overrides), not a per-call-site kwarg
+_ROUTING_KWARGS = {"impl", "staged_intra", "ring_impl"}
+
+# callees the rule applies to: the collective surface plus the
+# generator-pinning wrappers that still accept routing kwargs —
+# `impl=` on an unrelated library call is not our business, and the
+# compiler's own pin surface (compile_collective / pinned_plan, the
+# sanctioned mechanism) is not in this set
+_ROUTED_CALLEES = COLLECTIVE_NAMES | {
+    "run_hierarchical_allreduce",
+    "run_hierarchical_collective",
+    "run_tree_hierarchical_allreduce",
+}
+
+
+def _in_schedule_package(sf: SourceFile) -> bool:
+    parts = sf.display.replace("\\", "/").split("/")
+    return "schedule" in parts
+
+
+def check_literal_routing(sf: SourceFile) -> List[Finding]:
+    """TPL006: a call passing a literal routing kwarg (``impl='pallas'``,
+    ``staged_intra='ring'``, ``ring_impl=...``) outside ``schedule/``.
+
+    The schedule compiler owns routing: flat/hierarchical/staged/tree is
+    a cost-modeled (and autotunable) plan decision, and a call site that
+    pins it with a string literal silently bypasses the cost model, the
+    measured ``tune_plan`` overrides, AND the plan cache keying — the
+    exact escape hatch the compiler deleted. Passing a *variable*
+    through (plumbing someone else's decision) is fine; hardcoding the
+    schedule family at a call site is not. The generator-pinning
+    wrappers delegate to the compiler's pin surface
+    (``compile_collective``/``pinned_plan``), which is exempt."""
+    if _in_schedule_package(sf):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain or chain[-1] not in _ROUTED_CALLEES:
+            continue
+        for kw in node.keywords:
+            if kw.arg in _ROUTING_KWARGS and isinstance(
+                kw.value, ast.Constant
+            ):
+                findings.append(Finding(
+                    "TPL006", sf.display, node.lineno,
+                    f"collective call passes literal routing kwarg "
+                    f"{kw.arg}={kw.value.value!r} outside schedule/ — "
+                    "the schedule compiler owns this decision (cost "
+                    "model + tune_plan overrides), and a hardcoded "
+                    "family bypasses both",
+                    hint="drop the kwarg and let schedule.compile() "
+                    "choose, or plumb a variable through; pin a "
+                    "generator only via the run_hierarchical_* wrappers "
+                    "/ compile_collective",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # TPL005: collectives outside the start()/stop() window
 # ---------------------------------------------------------------------------
 
@@ -448,4 +515,5 @@ def check_file(sf: SourceFile) -> List[Finding]:
     out.extend(check_leaked_handles(sf))
     out.extend(check_donated_reuse(sf))
     out.extend(check_lifecycle(sf))
+    out.extend(check_literal_routing(sf))
     return out

@@ -38,6 +38,11 @@ RULES: Dict[str, Tuple[str, str]] = {
         "collective-outside-lifecycle",
         "collective invoked before start() or after stop()",
     ),
+    "TPL006": (
+        "literal-routing-kwarg",
+        "literal routing kwarg (impl=/staged_intra=/ring_impl=) outside "
+        "schedule/ bypasses the schedule compiler",
+    ),
     "TPL101": (
         "lock-order-cycle",
         "cycle in the static lock acquisition graph",

@@ -22,24 +22,31 @@ Key reference mechanics preserved:
   in-flight arrays (the stream-handle variant of ``resources.h:230-253``);
   launch overhead is the Python dispatch cost, mirroring the <50µs assertion
   in ``test/collectives_all.lua:192-199``.
-- **Small/large routing**: ``op_route`` consults the frozen constants to pick
-  the latency path (fused XLA collective) below the element cutoffs and the
-  bandwidth path (chunked ring) above, the analog of falling back to stock
-  MPI below ``kSmallAllreduceSize`` (``lib/collectives.cpp:296-301``,
-  ``lib/collectives_cuda.cpp:419-425``).
+- **Routing is compiled, not branched**: every dispatch flows through the
+  schedule compiler (:mod:`torchmpi_tpu.schedule`) — the request is resolved
+  to a cost-modeled :class:`~torchmpi_tpu.schedule.ir.Plan` against the
+  declared topology and bound to an executable; the small/large latency
+  routing (the analog of falling back to stock MPI below
+  ``kSmallAllreduceSize``, ``lib/collectives.cpp:296-301``), hierarchical /
+  staged / tree composition, and wire-format choice are all plan-compiler
+  decisions now. The ``run_hierarchical_*`` entry points remain as thin
+  wrappers that pin a plan generator.
+
+This module keeps the executor-side machinery the compiler lowers onto:
+the per-communicator executable caches (with AOT pin semantics), the flat
+kernel table over the xla / ppermute-ring / pallas backends, and the
+telemetry dispatch wrapper that stamps every call with its ``plan_id``.
 """
 
 from __future__ import annotations
 
 import time
 from collections import OrderedDict
-from functools import partial
 from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import constants, telemetry as _telemetry
@@ -83,19 +90,23 @@ def _metric_handles():
 
 def _dispatch(fn, x, op: str, backend: str, wire: str, nelem: int,
               cache_hit: Optional[bool], comm: Optional[Communicator] = None,
-              payload=None, routing: str = ""):
+              payload=None, routing: str = "", plan: str = ""):
     """Run ``fn(x)`` (a compiled eager executable, or a composition like
     the staged allreduce), recording the dispatch (span + metrics) when
     telemetry is enabled, plus a flight-recorder entry (per-comm seq, op,
     payload, issue/complete stamps) when the recorder is on; one branch
     each when disabled. ``cache_hit=None`` means no single executable
     cache applies (multi-phase compositions). ``payload`` is the raw
-    (shape, dtype) pair — stringified only at snapshot time."""
+    (shape, dtype) pair — stringified only at snapshot time. ``plan`` is
+    the schedule compiler's stable plan_id: the cross-rank identity that
+    lets the desync analyzer name the diverging *plan*, not just the op
+    (hierarchical sub-structure included — the old entries said
+    ``routing="hier"`` and nothing else)."""
     entry = None
     if _flight.enabled() and comm is not None:
         entry = _flight.recorder.record(
             _flight.comm_key(comm), op, payload=payload, wire=wire,
-            backend=backend, routing=routing,
+            backend=backend, routing=routing, plan=plan,
         )
     if not _telemetry.enabled():
         if entry is None:
@@ -109,6 +120,8 @@ def _dispatch(fn, x, op: str, backend: str, wire: str, nelem: int,
         return out
     calls, lat, compiles, hits = _metric_handles()
     attrs = {"backend": backend, "wire_dtype": wire, "nelem": nelem}
+    if plan:
+        attrs["plan"] = plan
     if cache_hit is not None:
         attrs["cache"] = "hit" if cache_hit else "miss"
     t0 = time.perf_counter()
@@ -158,7 +171,9 @@ class _LRUCache(OrderedDict):
     pinned entries are never LRU-evicted, so a tester sweep cannot silently
     evict the executables a training loop declared up front. They still go
     away with the whole cache (``free_collective_resources`` / ``stop()``,
-    whose contract is a wholesale teardown)."""
+    whose contract is a wholesale teardown). The schedule compiler's plan
+    cache and dispatch memo reuse this class — same bound, same pin
+    semantics, same teardown."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -215,11 +230,12 @@ def _resource_cache(comm: Communicator) -> dict:
 
 
 def _dispatch_memo(comm: Communicator) -> dict:
-    """The warm-dispatch fast-path memo: (call signature) -> terminal
-    plan. A SEPARATE LRU from the executable cache so memo entries never
-    perturb the executable-count accounting (tests and the reference's
-    per-resource model count executables, not lookups) — but the same
-    bound and the same wholesale teardown."""
+    """The warm-dispatch fast-path memo: (call signature) -> bound
+    :class:`~torchmpi_tpu.schedule.compiler.ExecutablePlan`. A SEPARATE
+    LRU from the executable cache so memo entries never perturb the
+    executable-count accounting (tests and the reference's per-resource
+    model count executables, not lookups) — but the same bound and the
+    same wholesale teardown."""
     memo = getattr(comm, "_dispatch_memo", None)
     if memo is None:
         memo = _LRUCache()
@@ -229,12 +245,12 @@ def _dispatch_memo(comm: Communicator) -> dict:
 
 def free_collective_resources(comm: Communicator) -> None:
     """Drop every cached compiled executable / sharding / selector decision
-    / fusion buffer attached to ``comm`` — the analog of the reference's
-    ``freeCollectiveResources`` (``torchmpi/cache.lua:19-61``, invoked by
-    the tester between sizes, ``torchmpi/tester.lua:131-133``). Safe at any
-    time: the next collective simply recompiles, and pending fused
-    submissions are flushed first so no handle is orphaned. Pinned AOT
-    entries go too — this is the wholesale teardown, not LRU pressure.
+    / plan-cache entry / fusion buffer attached to ``comm`` — the analog of
+    the reference's ``freeCollectiveResources`` (``torchmpi/cache.lua:19-61``,
+    invoked by the tester between sizes, ``torchmpi/tester.lua:131-133``).
+    Safe at any time: the next collective simply recompiles, and pending
+    fused submissions are flushed first so no handle is orphaned. Pinned
+    AOT entries go too — this is the wholesale teardown, not LRU pressure.
     Called by ``stop()`` for every live stack level."""
     fb = getattr(comm, "_fusion_buffer", None)
     if fb is not None:
@@ -245,6 +261,7 @@ def free_collective_resources(comm: Communicator) -> None:
     for attr in (
         "_collective_resources",
         "_dispatch_memo",
+        "_plan_cache",
         "_selector_cache",
         "_fusion_buffer",
     ):
@@ -340,7 +357,7 @@ def broadcast_plan(nelem: int, dtype, platform: str) -> Tuple[bool, int]:
     switch); above it, the pipelined chunk count from the buffer-size
     bounds — every chunk <= max_buffer_size and no smaller than
     min_buffer_size (constants.cpp:142-150). One source of truth for the
-    flat AND hierarchical routes."""
+    flat AND hierarchical lowerings (schedule/lower.py consumes it)."""
     suffix = constants.platform_suffix(platform)
     block_bytes = nelem * jnp.dtype(dtype).itemsize
     if block_bytes <= constants.get(f"broadcast_size_tree_based_{suffix}"):
@@ -381,11 +398,12 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple,
     """Return a kernel fn(block) for the given op/backend.
 
     For ``backend='ring'`` broadcasts, ``extra`` carries the tree-vs-pipeline
-    decision (made in :func:`run` from the platform-appropriate constant, so
-    it participates in the executable cache key — ``collectives.cpp:58-64``'s
-    4MB switch) plus the pipelined chunk count; ``tuning`` carries
-    (min_bytes, max_bytes, num_buffers) for byte-bounded ring chunking;
-    ``wire`` the resolved wire format for the bandwidth-path reductions."""
+    decision (made by the flat lowering from the platform-appropriate
+    constant, so it participates in the executable cache key —
+    ``collectives.cpp:58-64``'s 4MB switch) plus the pipelined chunk count;
+    ``tuning`` carries (min_bytes, max_bytes, num_buffers) for byte-bounded
+    ring chunking; ``wire`` the resolved wire format for the bandwidth-path
+    reductions."""
     minb, maxb, nbuf = tuning if tuning else (None, None, 1)
     wire_arg = wire if wire != "full" else None
 
@@ -463,7 +481,8 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple,
             lambda b, k: ring_broadcast_pallas(b, root, _AXIS, num_chunks=k)
         )
         # a compressed wire pins the unidirectional kernel (the bidir
-        # ring has no quant path; run() drops the marker accordingly)
+        # ring has no quant path; the flat lowering drops the marker
+        # accordingly)
         if wire_arg is not None:
             def _pallas_allreduce(b, axis):
                 return ring_allreduce_pallas(b, axis, wire_dtype=wire_arg)
@@ -538,7 +557,9 @@ def _record_wire(op: str, nelem: int, dtype, wire: str) -> None:
 def op_route(op: str, nelem: int, platform: str, requested: str = "ring") -> str:
     """Size-based latency/bandwidth routing (reference
     ``collectives.cpp:296-301``): below the cutoff use the fused XLA path,
-    above it the requested bandwidth backend (ring or pallas)."""
+    above it the requested bandwidth backend (ring or pallas). Consumed by
+    the schedule compiler's backend resolution — the cutoff constants are
+    the MEASURED crossover the cost model defers to."""
     suffix = constants.platform_suffix(platform)
     if op == "allreduce":
         cutoff = constants.get(f"small_allreduce_size_{suffix}")
@@ -549,24 +570,10 @@ def op_route(op: str, nelem: int, platform: str, requested: str = "ring") -> str
     return "xla" if nelem <= cutoff else requested
 
 
-def run(
-    op: str,
-    x,
-    comm: Communicator,
-    backend: str = "xla",
-    root: int = 0,
-    src: int = 0,
-    dst: int = 0,
-    route_small: bool = True,
-    wire_dtype: Optional[str] = None,
-):
-    """Synchronous eager collective on a rank-stacked array.
-
-    ``wire_dtype``: per-call wire-format override for the bandwidth-path
-    reductions ('full' | 'bf16' | 'int8'; None = the ``wire_dtype``
-    constant). See :func:`resolve_wire_dtype` for the engagement gates.
-    """
-    x = jnp.asarray(x)
+def _validate(op: str, x, comm: Communicator, root: int,
+              wire_dtype: Optional[str]):
+    """Shared argument validation for the compiled dispatch path; returns
+    the (possibly lifted) input."""
     _check_rank_stacked(x, comm)
     if wire_dtype not in (None, "full", "bf16", "int8"):
         # validated unconditionally: a typo must not pass silently just
@@ -595,146 +602,43 @@ def run(
                 f"[r, s] = rank r's payload for rank s); got shape "
                 f"{tuple(x.shape)} for p={comm.size}"
             )
-    # warm-dispatch fast path: a (signature -> terminal plan) memo that
-    # skips re-abstractification — routing, wire resolution, plan
-    # building, and the executable-cache key construction — for call
-    # signatures seen before. Entries embed the constants generation, so
-    # ANY constants change (cutoffs, wire knob, donation) invalidates
-    # them in O(1); only the flat terminal path is memoized (hierarchical
-    # compositions re-route per call).
-    memo = _dispatch_memo(comm)
-    fkey = (
-        "_fast", op, backend, root, src, dst, route_small, wire_dtype,
-        tuple(x.shape), str(jnp.result_type(x)),
-    )
-    ent = memo.get(fkey)
-    if ent is not None and ent[0] == constants.generation():
-        _, fn, effective, wire, nelem = ent
-        if effective in ("ring", "pallas") and op in _WIRE_OPS:
-            _record_wire(op, nelem, jnp.result_type(x), wire)
-        sharding = _rank_sharding(comm, x.ndim)
-        if getattr(x, "sharding", None) != sharding:
-            x = jax.device_put(x, sharding)
-        return _dispatch(fn, x, op, effective, wire, nelem, True,
-                         comm=comm, payload=(x.shape, x.dtype),
-                         routing="flat")
-    platform = comm._devices[0].platform
-    effective = backend
-    if backend in ("ring", "pallas") and route_small:
-        effective = op_route(op, _nelem_per_rank(x), platform, backend)
-    if effective == "pallas":
-        from ..ops import ring_kernels
+    return x
 
-        dt = jnp.result_type(x)
-        # dtype gates: REDUCTIONS must preserve the dtype exactly (round-1
-        # silently corrupted int32 >= 2^24 via an f32 cast) — unsupported
-        # dtypes take the ppermute ring. Data-movement ops carry any real
-        # dtype losslessly as a byte view; only complex must fall back.
-        if op in ("allreduce", "reduce", "reducescatter"):
-            if not ring_kernels.supports_dtype(dt):
-                effective = "ring"
-        elif jnp.dtype(dt).kind == "c":
-            effective = "ring"
-    # wire-format decision (made once, BEFORE the hierarchical split, so
-    # flat and hierarchical routes ship the same bytes). Byte accounting
-    # happens at the TERMINAL dispatch — the flat path below, or inside
-    # the hierarchical composition this call may delegate to (which also
-    # covers direct run_hierarchical_* callers).
-    wire = "full"
-    if effective in ("ring", "pallas") and op in _WIRE_OPS:
-        wire = resolve_wire_dtype(
-            op, _nelem_per_rank(x), jnp.result_type(x), wire_dtype
-        )
-    hier = (
-        effective in ("ring", "pallas")
-        # route_small=False pins the EXACT backend (tester/autotuner
-        # contract: each path measured on its own) — no hier rerouting
-        and route_small
-        and constants.get("use_hierarchical_collectives")
-        and comm.has_inter_collective
-        and comm.has_intra_collective
+
+def run(
+    op: str,
+    x,
+    comm: Communicator,
+    backend: str = "xla",
+    root: int = 0,
+    src: int = 0,
+    dst: int = 0,
+    route_small: bool = True,
+    wire_dtype: Optional[str] = None,
+):
+    """Synchronous eager collective on a rank-stacked array.
+
+    The request is compiled by the schedule compiler
+    (:func:`torchmpi_tpu.schedule.compile_collective`): effective backend,
+    wire format, and schedule family (flat / hierarchical / staged /
+    tree) are one cached plan decision, and the bound executable replays
+    through the telemetry dispatch with its ``plan_id``. Warm calls are
+    a single memo hit — no routing work at all.
+
+    ``wire_dtype``: per-call wire-format override for the bandwidth-path
+    reductions ('full' | 'bf16' | 'int8'; None = the ``wire_dtype``
+    constant). See :func:`resolve_wire_dtype` for the engagement gates.
+    """
+    x = jnp.asarray(x)
+    x = _validate(op, x, comm, root, wire_dtype)
+    from ..schedule import compiler as _sched
+
+    ep = _sched.compile_collective(
+        op, tuple(x.shape), jnp.result_type(x), comm,
+        backend=backend, route_small=route_small, wire_dtype=wire_dtype,
+        root=root, src=src, dst=dst,
     )
-    if hier and comm.cartesian:
-        # two-level composition on hierarchical cartesian comms
-        # (collectives_cuda.cpp:501-581,1057-1141); staged-vs-direct inter
-        # transport selected by use_staged_collectives
-        # (kUseStagedCollectives, detail/collectives_cuda.cpp:877-899)
-        if op == "allreduce":
-            # the intra (ICI) level is where the custom transport pays:
-            # when the selector routed to pallas, the composition's intra
-            # phase runs the RDMA ring (collectives_cuda.cpp:501-581 — the
-            # reference's intra-IPC transport was the custom one there too)
-            if constants.get("use_staged_collectives"):
-                # the staged variant keeps the routed INTRA transport
-                # (the reference's staged path still ran its custom IPC
-                # rings inside the node, collectives_cuda.cpp:390-683)
-                return run_hierarchical_allreduce(
-                    x, comm, impl="staged", staged_intra=effective,
-                    wire=wire,
-                )
-            return run_hierarchical_allreduce(
-                x, comm, impl=effective, wire=wire
-            )
-        if op in ("broadcast", "reduce", "allgather"):
-            return run_hierarchical_collective(
-                op, x, comm, root=root, ring_impl=effective
-            )
-    elif hier and op == "allreduce":
-        # non-cartesian (ragged/tree) comms: grouped reduce + roots
-        # exchange + the trailing intra broadcast
-        # (collectives_cuda.cpp:569-579)
-        return run_tree_hierarchical_allreduce(x, comm, wire=wire)
-    # flat terminal path: the byte accounting for this dispatch
-    if effective in ("ring", "pallas") and op in _WIRE_OPS:
-        _record_wire(op, _nelem_per_rank(x), jnp.result_type(x), wire)
-    extra: Tuple = (src, dst) if op == "sendreceive" else ()
-    if (
-        effective == "pallas"
-        and op == "allreduce"
-        and constants.get("ring_implementation") == "pallas_bidir"
-        and wire == "full"
-    ):
-        # bidirectional-ring variant; participates in the executable cache
-        # key via ``extra`` so toggling the constant recompiles. The
-        # quantized wire runs the unidirectional kernel (the bidir ring
-        # has no quant path); dropping the marker here keeps the cache
-        # key honest about which kernel actually compiled.
-        extra = extra + ("bidir",)
-    tuning: Tuple = ()
-    if effective in ("ring", "pallas"):
-        tuning = ring_tuning(platform)
-    if effective in ("ring", "pallas") and op == "broadcast":
-        tree, k = broadcast_plan(_nelem_per_rank(x), jnp.result_type(x), platform)
-        extra = extra + (("tree",) if tree else ("pipeline", ("chunks", k)))
-    # block size participates in the key only when an encoding engages
-    # (toggling it must recompile the quantized executable, not the full
-    # one)
-    wire_key = (
-        (wire, constants.get("wire_quant_block_size"))
-        if wire != "full"
-        else ("full",)
-    )
-    aval = (tuple(x.shape), jnp.result_type(x))
-    static = (root,) + extra + (tuning, wire_key)
-    fn, hit = _compile(
-        comm,
-        op,
-        effective,
-        aval,
-        static,
-        lambda: _kernels(op, effective, root, extra, tuning, wire),
-    )
-    # memoize the terminal plan for this signature (see the fast path
-    # above); generation-stamped so constants changes invalidate it
-    memo[fkey] = (
-        constants.generation(), fn, effective, wire, _nelem_per_rank(x)
-    )
-    # Place the input on the communicator's devices (no-op if already there).
-    sharding = _rank_sharding(comm, x.ndim)
-    if getattr(x, "sharding", None) != sharding:
-        x = jax.device_put(x, sharding)
-    return _dispatch(fn, x, op, effective, wire, _nelem_per_rank(x), hit,
-                     comm=comm, payload=(x.shape, x.dtype), routing="flat")
+    return ep.execute(x)
 
 
 def run_fused(
@@ -752,13 +656,14 @@ def run_fused(
     pack + collective = 2). The GC3 move (arXiv:2201.11840): the plan is
     compiled once per (op, layout, dtype, routing) and replayed.
 
-    Routing (latency/bandwidth cutoff, wire format) is decided on the
-    TOTAL payload — coalescing is exactly what pushes small tensors past
-    the bandwidth-path and quantization cutoffs. Hierarchical
-    communicators delegate to the (cached) hierarchical composition after
-    a single-dispatch concat — 2 dispatches, still O(1) in k. Inputs are
-    caller arrays and are never donated. Returns the fused ``[p, total]``
-    result; callers slice their segments back out."""
+    Routing (latency/bandwidth cutoff, wire format) is decided by the
+    schedule compiler on the TOTAL payload — coalescing is exactly what
+    pushes small tensors past the bandwidth-path and quantization
+    cutoffs. Hierarchical communicators delegate to the (cached)
+    hierarchical composition after a single-dispatch concat — 2
+    dispatches, still O(1) in k. Inputs are caller arrays and are never
+    donated. Returns the fused ``[p, total]`` result; callers slice
+    their segments back out."""
     if op != "allreduce":
         raise CollectiveArgumentError(
             f"run_fused supports allreduce, got {op!r}"
@@ -775,98 +680,13 @@ def run_fused(
         dtype = jnp.result_type(*flats)
         flats = [f.astype(dtype) for f in flats]
     ns = tuple(int(f.shape[1]) for f in flats)
-    total = int(sum(ns))
-    cache = _resource_cache(comm)
-    memo = _dispatch_memo(comm)
-    # warm-dispatch memo (see run()): skips routing/wire/plan-key work
-    # for layouts seen before; generation-stamped against constants drift
-    fkey = ("_fastfused", op, backend, route_small, wire_dtype, ns, dtype)
-    ent = memo.get(fkey)
-    if ent is not None and ent[0] == constants.generation():
-        _, fn, effective, wire = ent
-        if effective in ("ring", "pallas"):
-            _record_wire(op, total, dtype, wire)
-        return _dispatch(
-            lambda args: fn(*args), flats, op, effective, wire, total, True,
-            comm=comm, payload=(ns, dtype), routing="fused",
-        )
-    platform = comm._devices[0].platform
-    effective = backend
-    if backend in ("ring", "pallas") and route_small:
-        effective = op_route(op, total, platform, backend)
-    if effective == "pallas":
-        from ..ops import ring_kernels
+    from ..schedule import compiler as _sched
 
-        if not ring_kernels.supports_dtype(dtype):
-            effective = "ring"
-    wire = "full"
-    if effective in ("ring", "pallas"):
-        wire = resolve_wire_dtype(op, total, dtype, wire_dtype)
-    hier = (
-        effective in ("ring", "pallas")
-        and route_small
-        and constants.get("use_hierarchical_collectives")
-        and comm.has_inter_collective
-        and comm.has_intra_collective
+    ep = _sched.compile_fused(
+        op, ns, dtype, comm,
+        backend=backend, route_small=route_small, wire_dtype=wire_dtype,
     )
-    if hier:
-        # concat in one dispatch, then the hierarchical composition (its
-        # own cached executable): 2 dispatches for k tensors
-        ckey = ("_fusecat", ns, str(jnp.dtype(dtype)))
-        cat = cache.get(ckey)
-        if cat is None:
-            cat = jax.jit(lambda *bs: jnp.concatenate(bs, axis=1))
-            cache[ckey] = cat
-        return run(
-            op, cat(*[f.astype(dtype) for f in flats]), comm,
-            backend=backend, route_small=route_small, wire_dtype=wire_dtype,
-        )
-    if effective in ("ring", "pallas"):
-        _record_wire(op, total, dtype, wire)
-    extra: Tuple = ()
-    if (
-        effective == "pallas"
-        and constants.get("ring_implementation") == "pallas_bidir"
-        and wire == "full"
-    ):
-        extra = ("bidir",)
-    tuning: Tuple = ()
-    if effective in ("ring", "pallas"):
-        tuning = ring_tuning(platform)
-    wire_key = (
-        (wire, constants.get("wire_quant_block_size"))
-        if wire != "full"
-        else ("full",)
-    )
-    key = (
-        "_fused", op, effective, ns, str(jnp.dtype(dtype)), extra, tuning,
-        wire_key,
-    )
-    fn = cache.get(key)
-    hit = fn is not None
-    if fn is None:
-        inner = _kernels(op, effective, 0, extra, tuning, wire)
-
-        def kernel(*blocks):  # each [1, n_i] per-rank slab
-            return inner(jnp.concatenate(blocks, axis=-1))
-
-        mesh = _flat_mesh(comm)
-        spec = _rank_spec(2)
-        shmapped = jax.shard_map(
-            kernel, mesh=mesh, in_specs=(spec,) * len(ns), out_specs=spec,
-            check_vma=False,
-        )
-        # in_shardings fold the device placement of every slab into this
-        # one dispatch (the flat path's explicit per-array device_put,
-        # amortized k-fold)
-        sharding = _rank_sharding(comm, 2)
-        fn = jax.jit(shmapped, in_shardings=(sharding,) * len(ns))
-        cache[key] = fn
-    memo[fkey] = (constants.generation(), fn, effective, wire)
-    return _dispatch(
-        lambda args: fn(*args), flats, op, effective, wire, total, hit,
-        comm=comm, payload=(ns, dtype), routing="fused",
-    )
+    return ep.execute(flats)
 
 
 def run_allgatherv(blocks, comm: Communicator, backend: str = "xla"):
@@ -995,36 +815,46 @@ def precompile(specs, comm: Optional[Communicator] = None,
     executable a ``FusionBuffer`` flush of that layout replays.
 
     Each spec is dispatched once on a zeros payload through the exact
-    production route (selector, wire resolution, hierarchical
-    composition), so both the jitted executable AND the per-signature
-    fast-path memo are warm afterwards; every cache entry the warm-up
-    touches — newly compiled OR already present — is pinned against LRU
-    eviction (``free_collective_resources`` still frees them — wholesale
-    teardown outranks pins). Returns the number of specs warmed.
-    Typically invoked via ``start(precompile_collectives=...)`` or
+    production route (schedule compiler, wire resolution, hierarchical
+    composition), so the jitted executable AND the plan cache AND the
+    per-signature dispatch memo are all warm afterwards; every entry the
+    warm-up touches in any of the three — newly compiled OR already
+    present — is pinned against LRU eviction
+    (``free_collective_resources`` still frees them — wholesale teardown
+    outranks pins). After precompile, a training loop's dispatches hit
+    zero executable compiles AND zero plan-cache misses (the
+    ``bench.py --microbench --check`` gates). Returns the number of
+    specs warmed. Typically invoked via
+    ``start(precompile_collectives=...)`` or
     ``AllReduceSGDEngine.precompile()``."""
     if comm is None:
         from .. import runtime_state
 
         comm = runtime_state.current_communicator()
-    cache = _resource_cache(comm)
-    touched: set = set()
+    from ..schedule import compiler as _sched
+
+    caches = [_resource_cache(comm), _dispatch_memo(comm),
+              _sched._plan_cache(comm)]
+    touched = [set(), set(), set()]
     if pin:
         # log every cache hit AND insert the warm-up dispatches make, so
         # pinning covers executables that already existed (a key diff
         # against a 'before' snapshot would silently skip those)
-        cache.log_accesses(touched)
+        for cache, log in zip(caches, touched):
+            cache.log_accesses(log)
     pending = []
     try:
         warmed = _precompile_dispatch(specs, comm, pending)
     finally:
         if pin:
-            cache.log_accesses(None)
+            for cache in caches:
+                cache.log_accesses(None)
     # drain so compile time is paid HERE, not inside step 1's first wait
     jax.block_until_ready(pending)
     if pin:
-        for key in touched:
-            cache.pin(key)
+        for cache, log in zip(caches, touched):
+            for key in log:
+                cache.pin(key)
     return warmed
 
 
@@ -1078,21 +908,25 @@ def _precompile_dispatch(specs, comm, pending) -> int:
     return warmed
 
 
+# ---------------------------------------------------------------------------
+# generator-pinning wrappers (the legacy hierarchical entry points)
+# ---------------------------------------------------------------------------
+
+
 def run_hierarchical_allreduce(
     x, comm: Communicator, impl: str = "ring", staged_intra: str = "ring",
     wire: str = "full",
 ):
-    """Explicit two-level allreduce over a cartesian communicator: ring
-    reduce within each intra group, ring across the inter dimension, then
-    the intra all-gather — the reference's hierarchical dispatch
-    (``allreducep2pHierarchicalImpl``, ``collectives_cuda.cpp:501-581``).
-    The *cartesian shortcut* is structural here: every device sits in an
-    inter ring of same-intra-rank peers, so no trailing intra broadcast is
-    needed (``docs/communicators.md:24-31``).
+    """Explicit two-level allreduce over a cartesian communicator — the
+    reference's hierarchical dispatch (``allreducep2pHierarchicalImpl``,
+    ``collectives_cuda.cpp:501-581``). Now a thin wrapper that PINS the
+    'hier' (or 'staged') plan generator on the schedule compiler; the
+    composition itself lives in ``schedule/lower.py``. ``wire`` is the
+    resolved wire format, passed through verbatim (no re-resolution —
+    direct callers pin the encoding like the legacy entry point did).
 
     Requires a cartesian comm with both levels populated; the flat path is
-    the right tool otherwise (callers fall back).
-    """
+    the right tool otherwise (callers fall back)."""
     x = jnp.asarray(x)
     _check_rank_stacked(x, comm)
     if not (comm.cartesian and comm.has_inter_collective and comm.has_intra_collective):
@@ -1100,308 +934,27 @@ def run_hierarchical_allreduce(
             "hierarchical allreduce needs a cartesian communicator with "
             "multiple intra groups of size > 1"
         )
-    # byte accounting for the composition (once per dispatch, like the
-    # flat path — run() no longer records for calls it delegates here, so
-    # direct callers and routed calls count identically)
-    if impl in ("ring", "pallas", "staged"):
-        _record_wire(
-            "allreduce", _nelem_per_rank(x), jnp.result_type(x), wire
-        )
+    from ..schedule import compiler as _sched
+
     if impl == "staged":
-        return _dispatch(
-            lambda a: _run_staged_hierarchical_allreduce(
-                a, comm, staged_intra, wire
-            ),
-            x, "staged_allreduce", staged_intra, wire,
-            _nelem_per_rank(x), None,
-            comm=comm, payload=(x.shape, x.dtype), routing="staged",
-        )
-    donate = constants.get("donate_eager_buffers")
-    tuning = (
-        ring_tuning(comm._devices[0].platform)
-        if impl in ("ring", "pallas")
-        else ()
-    )
-    # the uni-vs-bidirectional pallas variant participates in the cache
-    # key: the autotuner toggles ring_implementation between measurements
-    bidir = (
-        impl == "pallas"
-        and constants.get("ring_implementation") == "pallas_bidir"
-        and wire == "full"
-    )
-    wire_arg = wire if wire != "full" else None
-    key = (
-        "hier_allreduce", impl, tuple(x.shape), jnp.result_type(x), donate,
-        tuning, bidir,
-        (wire, constants.get("wire_quant_block_size"))
-        if wire != "full" else ("full",),
-    )
-
-    if impl == "pallas":
-        # intra = ICI: the Pallas RDMA ring (uni- or bidirectional per
-        # ring_implementation); inter = cross-ICI/DCN: the ppermute ring
-        # (XLA schedules it over the slower fabric) — the reference's
-        # intra-IPC-ring x inter-MPI split. The wire format applies to
-        # BOTH levels: the inter hop is the slowest fabric, exactly where
-        # compression pays most.
-        intra_ring, _ = _pallas_intra_ring(wire_arg)
-        minb, maxb, nbuf = tuning
-
-        def kernel(b):
-            b = intra_ring(b, "intra")
-            return prim.ring_allreduce(
-                b, "inter",
-                max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf, wire_dtype=wire_arg,
-            )
-    elif impl == "ring":
-        minb, maxb, nbuf = tuning
-
-        def kernel(b):
-            b = prim.ring_allreduce(
-                b, "intra",
-                max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf, wire_dtype=wire_arg,
-            )
-            return prim.ring_allreduce(
-                b, "inter",
-                max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf, wire_dtype=wire_arg,
-            )
+        generator, eff = "staged", staged_intra
     else:
-        def kernel(b):
-            return jax.lax.psum(jax.lax.psum(b, "intra"), "inter")
-
-    fn, hit = _hier_compile(comm, key, x.ndim, donate, kernel)
-    return _dispatch(
-        fn, x, "hier_allreduce", impl, wire, _nelem_per_rank(x), hit,
-        comm=comm, payload=(x.shape, x.dtype), routing="hier",
+        generator, eff = "hier", impl
+    ep = _sched.compile_collective(
+        "allreduce", tuple(x.shape), jnp.result_type(x), comm,
+        generator=generator, impl=eff, wire_override=wire,
     )
-
-
-def _pallas_intra_ring(wire_arg: Optional[str] = None):
-    """(ring_fn, bidir) for the intra (ICI) allreduce phase when the
-    selector routed 'pallas' — uni- or bidirectional per
-    ``ring_implementation``. The ONE selection site shared by the direct
-    and staged hierarchical paths, so their intra transports can never
-    diverge. A compressed ``wire_arg`` pins the unidirectional quantized
-    kernel (the bidir ring has no quant path)."""
-    from ..ops.ring_kernels import (
-        ring_allreduce_bidir_pallas,
-        ring_allreduce_pallas,
-    )
-
-    if wire_arg is not None:
-        def quant_ring(b, axis):
-            return ring_allreduce_pallas(b, axis, wire_dtype=wire_arg)
-
-        return quant_ring, False
-    bidir = constants.get("ring_implementation") == "pallas_bidir"
-    return (
-        ring_allreduce_bidir_pallas if bidir else ring_allreduce_pallas,
-        bidir,
-    )
-
-
-def _run_staged_hierarchical_allreduce(
-    x, comm: Communicator, intra_impl: str = "ring", wire: str = "full"
-):
-    """Host-staged cross-group allreduce — the TPU analog of
-    ``allreducep2pCrossNodesViaCPU`` (staged-via-pinned-CPU,
-    ``detail/collectives_cuda.cpp:390-683``), selected by
-    ``use_staged_collectives``:
-
-    1. device: ring-allreduce within each intra group (ICI-local) — the
-       ppermute ring, or the Pallas RDMA ring when the selector routed
-       ``intra_impl='pallas'`` (the reference's staged path likewise kept
-       its custom IPC transport inside the node);
-    2. host: fetch one representative group-sum per group, reduce across
-       groups in host memory (the DCN-staged hop);
-    3. device: push the global total back to every rank.
-
-    The staged hop trades device-collective bandwidth for not needing any
-    inter-group device link — exactly the reference's rationale when GDR
-    was unavailable.
-    """
-    cache = _resource_cache(comm)
-    tuning = ring_tuning(comm._devices[0].platform)
-    wire_arg = wire if wire != "full" else None
-    bidir = (
-        intra_impl == "pallas"
-        and constants.get("ring_implementation") == "pallas_bidir"
-        and wire_arg is None
-    )
-    key = (
-        "staged_allreduce", intra_impl, bidir, tuple(x.shape),
-        jnp.result_type(x), tuning,
-        (wire, constants.get("wire_quant_block_size"))
-        if wire_arg else ("full",),
-    )
-    entry = cache.get(key)
-    if entry is None:
-        perm = np.concatenate(comm._groups).astype(np.int32)
-        inv = np.argsort(perm).astype(np.int32)
-        mesh = comm.mesh
-        spec = P(("inter", "intra"), *([None] * (x.ndim - 1)))
-        minb, maxb, nbuf = tuning
-
-        if intra_impl == "pallas":
-            intra_ring, _ = _pallas_intra_ring(wire_arg)
-
-            def intra_kernel(b):
-                return intra_ring(b, "intra")
-        else:
-            def intra_kernel(b):
-                return prim.ring_allreduce(
-                    b, "intra",
-                    max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                    num_buffers=nbuf, wire_dtype=wire_arg,
-                )
-
-        shmapped = jax.shard_map(
-            intra_kernel, mesh=mesh, in_specs=spec, out_specs=spec,
-            check_vma=False,
-        )
-        perm_j = jnp.asarray(perm)
-        # the output stays in GROUP-MAJOR order, pinned to the SAME
-        # (inter, intra) mesh the shard_map runs on (a rank-order out
-        # sharding would use a different device order and jit rejects
-        # mixed orders). Row k is rank perm[k]'s group sum, one row per
-        # device — so the rep extraction below is partition-exact and
-        # position k maps to a rank through perm.
-        intra_fn = jax.jit(
-            lambda a: shmapped(jnp.take(a, perm_j, axis=0)),
-            out_shardings=NamedSharding(mesh, spec),
-        )
-        # reps (group firsts) sit at the head of each group-major block
-        isz = len(comm._groups[0])
-        rep_pos = np.arange(len(comm._groups), dtype=np.int32) * isz
-        entry = (intra_fn, rep_pos)
-        cache[key] = entry
-    intra_fn, rep_pos = entry
-    reduced = intra_fn(x)  # group-major; every row = its group's sum
-    # host-staged inter reduction (the DCN hop)
-    procs = sorted({d.process_index for d in comm._devices})
-    if len(procs) > 1:
-        # Multi-controller: jax.device_get of the full representative set
-        # would raise — most rep rows are non-addressable here. Instead
-        # each process sums the rep rows it OWNS (partition-exact: one
-        # group-major row per device) and the partials meet over the PS
-        # socket transport: host wires, no inter-group device link — the
-        # point of the staged path (collectives_cuda.cpp:390-683).
-        rep_set = {int(k) for k in rep_pos}
-        rows = {}
-        for shard in reduced.addressable_shards:
-            k = shard.index[0].start or 0
-            if k in rep_set and k not in rows:
-                rows[k] = np.asarray(shard.data)[0]
-        dt = np.dtype(reduced.dtype)
-        per_row = tuple(x.shape[1:])
-        partial = np.zeros(per_row, dt)
-        for row in rows.values():
-            partial = partial + row
-        partial = np.ascontiguousarray(partial, dt)
-        from ..parameterserver import transport as ps_transport
-
-        if ps_transport._transport is None and len(procs) < jax.process_count():
-            # Bootstrapping the transport does a JOB-global address
-            # exchange; entering it from a collective only a subset of
-            # processes runs would hang the subset forever. Bootstrap is
-            # a job-global act — demand it happen at one.
-            raise RuntimeError(
-                "staged hierarchical allreduce on a communicator spanning "
-                f"processes {procs} of {jax.process_count()}: the PS socket "
-                "transport is not bootstrapped, and bootstrapping is "
-                "job-global. Call torchmpi_tpu.parameterserver.transport."
-                "ensure_transport() once on EVERY process (e.g. right "
-                "after start()) before staged collectives on subset "
-                "communicators."
-            )
-        # distinct gather tag per exchange, scoped to the PARTICIPATING
-        # process set: SPMD program order is only guaranteed among the
-        # processes that actually run this collective, so a process-global
-        # counter would desync when subset communicators overlap
-        pkey = tuple(procs)
-        epoch = _staged_exchange_epochs.get(pkey, 0) + 1
-        _staged_exchange_epochs[pkey] = epoch
-        tag = f"staged-allreduce:{','.join(map(str, pkey))}:{epoch}"
-        blobs = ps_transport.ensure_transport().allgather_blob(
-            procs, tag, partial.tobytes(),
-            timeout=constants.get("deadlock_timeout_seconds") or None,
-        )
-        total = np.zeros(per_row, dt)
-        for blob in blobs.values():
-            total = total + np.frombuffer(blob, dt).reshape(per_row)
-        total = total.astype(dt, copy=False)
-    else:
-        host = np.asarray(jax.device_get(reduced[np.asarray(rep_pos)]))
-        total = host.sum(axis=0).astype(host.dtype)
-    stacked = np.broadcast_to(total, (comm.size,) + total.shape)
-    # make_array_from_callback works on single- AND multi-controller
-    # meshes (device_put with a global sharding does not on the latter)
-    return jax.make_array_from_callback(
-        stacked.shape, _rank_sharding(comm, x.ndim), lambda idx: stacked[idx]
-    )
-
-
-# monotone counters giving every staged exchange a distinct gather tag,
-# one per participating process set (SPMD program order holds within a
-# set, not across overlapping subset communicators)
-_staged_exchange_epochs: dict = {}
-
-
-def _hier_compile(comm: Communicator, key, ndim: int, donate: bool, kernel,
-                  post=None):
-    """Shared scaffolding for 2-level (cartesian) compositions: permute the
-    rank-stacked rows into group-major mesh order, shard_map ``kernel`` over
-    the (inter, intra) mesh, permute back (+ optional ``post(out, inv)``),
-    jit with donation, memoize under ``key``. Returns ``(fn, cache_hit)``."""
-    cache = _resource_cache(comm)
-    fn = cache.get(key)
-    if fn is not None:
-        return fn, True
-    perm = np.concatenate(comm._groups).astype(np.int32)
-    inv = np.argsort(perm).astype(np.int32)
-    mesh = comm.mesh  # 2D (inter, intra)
-    spec = P(("inter", "intra"), *([None] * (ndim - 1)))
-    shmapped = jax.shard_map(
-        kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
-    )
-    perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
-
-    def run_fn(a):
-        out = jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
-        return out if post is None else post(out, inv_j)
-
-    fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
-    cache[key] = fn
-    return fn, False
+    return ep.execute(x)
 
 
 def run_hierarchical_collective(
     op: str, x, comm: Communicator, root: int = 0, ring_impl: str = "ring"
 ):
     """Two-level composition of broadcast/reduce/allgather on a cartesian
-    communicator, routed like the hierarchical allreduce — the reference's
-    per-collective hierarchical dispatch (``collectives_cuda.cpp:501-581,
-    1057-1141``):
-
-    - broadcast: inter-level ring/tree broadcast from the root's group
-      within every intra row, then intra broadcast from the root's intra
-      rank (every rank ends with the root's block).
-    - reduce: intra ring-reduce to the root's intra rank, inter ring-reduce
-      to the root's group; non-root ranks keep their input (this API's
-      defined MPI_Reduce behavior).
-    - allgather: intra all-gather then inter all-gather along the last dim,
-      with the concatenation re-ordered from mesh (group-major) order to
-      global rank order.
-
-    ``ring_impl`` selects the INTRA-phase transport: ``'ring'`` (ppermute)
-    or ``'pallas'`` (ICI RDMA kernels) — the level where the custom
-    transport pays, like the reference's intra-IPC rings
-    (``collectives_cuda.cpp:1057-1141``). The inter phase always runs the
-    ppermute ring (it rides the slower cross-group fabric).
-    """
+    communicator (``collectives_cuda.cpp:501-581,1057-1141``) — a thin
+    wrapper pinning the 'hier' plan generator; ``ring_impl`` selects the
+    INTRA-phase transport ('ring' = ppermute, 'pallas' = ICI RDMA), the
+    plan's ``impl`` attribute now."""
     x = jnp.asarray(x)
     _check_rank_stacked(x, comm)
     if not (comm.cartesian and comm.has_inter_collective and comm.has_intra_collective):
@@ -1409,195 +962,42 @@ def run_hierarchical_collective(
             "hierarchical collectives need a cartesian communicator with "
             "multiple intra groups of size > 1"
         )
+    if op not in ("broadcast", "reduce", "allgather"):
+        raise CollectiveArgumentError(
+            f"hierarchical collective supports broadcast/reduce/allgather, "
+            f"got {op!r}"
+        )
     if op in ("broadcast", "reduce") and not 0 <= root < comm.size:
         raise CollectiveArgumentError(f"root {root} out of range")
-    donate = constants.get("donate_eager_buffers")
-    platform = comm._devices[0].platform
-    tuning = ring_tuning(platform)
-    minb, maxb, nbuf = tuning
-    tree, chunks = True, 1
-    if op == "broadcast":
-        tree, chunks = broadcast_plan(
-            _nelem_per_rank(x), jnp.result_type(x), platform
-        )
-    key = (
-        "hier", op, root, tuple(x.shape), jnp.result_type(x), donate, tuning,
-        (tree, chunks), ring_impl,
+    from ..schedule import compiler as _sched
+
+    ep = _sched.compile_collective(
+        op, tuple(x.shape), jnp.result_type(x), comm,
+        root=root, generator="hier", impl=ring_impl, wire_override="full",
     )
-    g0 = next(gi for gi, g in enumerate(comm._groups) if root in g)
-    i0 = comm.member(root).intra_rank
-    pallas_intra = ring_impl == "pallas"
-
-    def bcast_axis(b, r, axis):
-        if tree:
-            return prim.tree_broadcast(b, r, axis)
-        return prim.ring_broadcast(b, r, axis, num_chunks=chunks)
-
-    def intra_bcast(b):
-        if pallas_intra:
-            from ..ops.ring_kernels import ring_broadcast_pallas
-
-            return ring_broadcast_pallas(b, i0, "intra", num_chunks=chunks)
-        return bcast_axis(b, i0, "intra")
-
-    def intra_reduce(b):
-        if pallas_intra:
-            from ..ops.ring_kernels import ring_reduce_pallas
-
-            return ring_reduce_pallas(b, i0, "intra")
-        return prim.ring_reduce(
-            b, i0, "intra",
-            max_bytes_per_step=maxb, min_bytes_per_step=minb,
-            num_buffers=nbuf,
-        )
-
-    def intra_allgather(b):
-        if pallas_intra:
-            return _pallas_allgather_lastdim(b, "intra")
-        return prim.ring_allgather(b, "intra", dim=-1)
-
-    if op == "broadcast":
-        def kernel(b):
-            # inter phase within every intra row, then intra phase
-            b = bcast_axis(b, g0, "inter")
-            return intra_bcast(b)
-        post = None
-    elif op == "reduce":
-        def kernel(b):
-            y = intra_reduce(b)
-            z = prim.ring_reduce(
-                y, g0, "inter",
-                max_bytes_per_step=maxb, min_bytes_per_step=minb,
-                num_buffers=nbuf,
-            )
-            is_root = (lax.axis_index("inter") == g0) & (
-                lax.axis_index("intra") == i0
-            )
-            return jnp.where(is_root, z, b)
-        post = None
-    else:  # allgather
-        def kernel(b):
-            b = intra_allgather(b)
-            return prim.ring_allgather(b, "inter", dim=-1)
-
-        p, d = comm.size, int(x.shape[-1])
-
-        def post(out, inv_j):
-            # concat blocks arrive in mesh (group-major) order: put them
-            # in global rank order along the gathered dim
-            blocks = out.reshape(out.shape[:-1] + (p, d))
-            return jnp.take(blocks, inv_j, axis=-2).reshape(out.shape)
-
-    fn, hit = _hier_compile(comm, key, x.ndim, donate, kernel, post)
-    return _dispatch(
-        fn, x, f"hier_{op}", ring_impl, "full", _nelem_per_rank(x), hit,
-        comm=comm, payload=(x.shape, x.dtype), routing="hier",
-    )
-
-
-def _binomial_reduce_steps(groups, p: int):
-    """Static (perm, recv_mask) schedule per step of a binomial reduction to
-    each group's first member: member j at span s receives from j+span when
-    j % 2span == 0. ``log2(max group)`` steps; every value accumulated
-    exactly once."""
-    steps = []
-    span = 1
-    while True:
-        perm = []
-        mask = np.zeros((p,), bool)
-        for g in groups:
-            for j in range(0, len(g), 2 * span):
-                if j + span < len(g):
-                    perm.append((g[j + span], g[j]))
-                    mask[g[j]] = True
-        if not perm:
-            break
-        steps.append((perm, mask))
-        span *= 2
-    return steps
+    return ep.execute(x)
 
 
 def run_tree_hierarchical_allreduce(x, comm: Communicator,
                                     wire: str = "full"):
     """Hierarchical allreduce on a NON-cartesian (ragged/tree) communicator
-    — the reference's non-cartesian path (intra reduce to group root, inter
-    exchange among roots, final intra broadcast,
-    ``collectives_cuda.cpp:546-581``).
-
-    TPU-native expression: statically-scheduled binomial ``ppermute``
-    reductions (ragged groups forbid XLA's ``axis_index_groups``, which
-    requires equal-size groups on TPU): reduce within each group to its
-    root, reduce across the roots to the global root, then a static
-    cross-device gather broadcasts the total — the trailing broadcast of
-    the reference, collapsed to one hop.
-
-    A compressed ``wire`` encodes every binomial exchange hop (partials
-    quantized on send, f32 accumulate — non-target ranks receive zeros,
-    which decode to exact zeros); only the final one-hop gather broadcast
-    ships full precision.
-    """
+    — the reference's non-cartesian path (``collectives_cuda.cpp:546-581``),
+    now a thin wrapper pinning the 'tree' plan generator (binomial
+    ppermute schedule + one-hop gather broadcast, ``schedule/lower.py``).
+    A compressed ``wire`` encodes every binomial exchange hop."""
     x = jnp.asarray(x)
     _check_rank_stacked(x, comm)
     if not (comm.has_inter_collective and comm.has_intra_collective):
         raise CollectiveArgumentError(
             "hierarchical allreduce needs a communicator with both levels"
         )
-    # byte accounting (once per dispatch; run() delegates before recording)
-    _record_wire("allreduce", _nelem_per_rank(x), jnp.result_type(x), wire)
-    cache = _resource_cache(comm)
-    donate = constants.get("donate_eager_buffers")
-    wire_arg = wire if wire != "full" else None
-    block = constants.get("wire_quant_block_size")
-    key = (
-        "tree_hier_allreduce", tuple(x.shape), jnp.result_type(x), donate,
-        (wire, block) if wire_arg else ("full",),
+    from ..schedule import compiler as _sched
+
+    ep = _sched.compile_collective(
+        "allreduce", tuple(x.shape), jnp.result_type(x), comm,
+        generator="tree", impl="ring", wire_override=wire,
     )
-    fn = cache.get(key)
-    hit = fn is not None
-    if fn is None:
-        p = comm.size
-        groups = [list(map(int, g)) for g in comm._groups]
-        roots = [g[0] for g in groups]
-        schedule = _binomial_reduce_steps(groups, p) + _binomial_reduce_steps(
-            [roots], p
-        )
-        mesh = _flat_mesh(comm)
-        spec = _rank_spec(x.ndim)
-
-        def kernel(b):
-            for perm, mask in schedule:
-                if wire_arg:
-                    # non-targets receive zero q/scales -> decode to 0
-                    recv = prim._wire_send_recv(
-                        b, _AXIS, perm, wire_arg, block
-                    )
-                else:
-                    recv = lax.ppermute(b, _AXIS, perm)  # non-targets: 0
-                receives = jnp.take(
-                    jnp.asarray(mask), lax.axis_index(_AXIS)
-                )
-                b = jnp.where(receives, b + recv, b)
-            return b
-
-        shmapped = jax.shard_map(
-            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
-        )
-        sharding = _rank_sharding(comm, x.ndim)
-        # trailing broadcast: everyone reads the global root's total
-        idx = jnp.full((p,), roots[0], jnp.int32)
-
-        def run_fn(a):
-            y = shmapped(a)
-            return jax.lax.with_sharding_constraint(
-                jnp.take(y, idx, axis=0), sharding
-            )
-
-        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
-        cache[key] = fn
-    return _dispatch(
-        fn, x, "tree_hier_allreduce", "ring", wire, _nelem_per_rank(x), hit,
-        comm=comm, payload=(x.shape, x.dtype), routing="tree",
-    )
+    return ep.execute(x)
 
 
 def run_group_broadcast(x, comm: Communicator, root: int = 0):

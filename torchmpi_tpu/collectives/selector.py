@@ -14,6 +14,13 @@ backends are:
 
 ``collective_availability()`` renders the availability matrix string like the
 reference's introspection dump (``init.lua:557-660``).
+
+The selector answers *which backend executor is available/preferred*;
+*which schedule* a request actually runs (flat / hierarchical / staged
+/ tree, cost-modeled and cached) is the schedule compiler's decision —
+``python -m torchmpi_tpu.schedule --explain`` is the introspection
+surface for that, superseding this module's static preference dump for
+routing questions.
 """
 
 from __future__ import annotations

@@ -199,6 +199,24 @@ class _Constants:
     # Watchdog poll + heartbeat-file period, in seconds.
     watchdog_interval_seconds: int = 1
 
+    # --- schedule-compiler cost model (alpha-beta per link class) ---
+    # Per-hop launch latency (alpha, µs) and per-MiB transfer time
+    # (beta, µs/MiB) for each link class a plan step can ride: 'ici'
+    # (intra-island fast fabric), 'dcn' (inter-island), 'host' (host-
+    # staged device<->host<->socket hop). Plus a quantize/dequantize
+    # throughput term and a per-dispatch overhead. These order candidate
+    # plans analytically between measurements; tune_plan measures real
+    # candidates and persists the winner per plan-cache key, which
+    # overrides the analytic pick.
+    plan_cost_alpha_ici_us: float = 1.0
+    plan_cost_beta_ici_us_per_mib: float = 10.0
+    plan_cost_alpha_dcn_us: float = 25.0
+    plan_cost_beta_dcn_us_per_mib: float = 120.0
+    plan_cost_alpha_host_us: float = 50.0
+    plan_cost_beta_host_us_per_mib: float = 300.0
+    plan_cost_quantize_us_per_mib: float = 8.0
+    plan_cost_dispatch_us: float = 5.0
+
     # --- coalescing dispatch (latency path; GC3-style fused plans) ---
     # Capacity of the flat fusion buffer: pending same-(op, dtype, comm,
     # wire) async collectives pack into one contiguous buffer and flush

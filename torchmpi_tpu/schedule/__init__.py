@@ -1,0 +1,59 @@
+"""Collective schedule compiler: one plan IR instead of four code paths.
+
+A collective request ``(op, payload, dtype, comm)`` is *compiled* — not
+routed — into a :class:`~.ir.Plan`: a DAG of typed steps (send / recv /
+reduce / quantize / dequantize / pack / unpack / local_reduce) against a
+declared :class:`~.topology.Topology`, picked among candidate schedules
+(flat ring, two-level hierarchical, staged, tree — all expressed as plan
+*generators*) by an analytic alpha-beta cost model, cached per
+``(op, topology fingerprint, payload bucket, wire, generation())``, and
+lowered onto the existing executors (Pallas ring kernels, ppermute
+rings, fused XLA primitives) so numerics and backends are unchanged.
+
+Public surface:
+
+- :func:`compile_collective` / :func:`compile_fused` — the routing
+  authority ``eager.run`` / ``run_fused`` / ``run_async`` /
+  ``precompile`` all flow through.
+- :func:`explain` + ``python -m torchmpi_tpu.schedule --explain`` — the
+  decision dump (chosen plan, cost estimate, rejected candidates).
+- :func:`set_plan_override` / :func:`plan_overrides` — the autotuner's
+  measured-winner persistence surface (``tune_plan``).
+"""
+
+from .compiler import (  # noqa: F401
+    ExecutablePlan,
+    FusedExecutablePlan,
+    apply_plan_overrides,
+    clear_plan_overrides,
+    compile_collective,
+    compile_fused,
+    effective_backend,
+    explain,
+    override_key,
+    payload_bucket,
+    plan_overrides,
+    select_plan,
+    set_plan_override,
+)
+from .cost import cost_breakdown, estimate_us  # noqa: F401
+from .generators import (  # noqa: F401
+    GENERATORS,
+    HIER_OPS,
+    TREE_OPS,
+    Candidate,
+    candidate_plans,
+)
+from .ir import STEP_KINDS, Plan, Step  # noqa: F401
+from .topology import Topology  # noqa: F401
+
+__all__ = [
+    "Plan", "Step", "STEP_KINDS", "Topology",
+    "compile_collective", "compile_fused", "explain",
+    "candidate_plans", "Candidate", "GENERATORS", "HIER_OPS", "TREE_OPS",
+    "estimate_us", "cost_breakdown",
+    "set_plan_override", "apply_plan_overrides", "plan_overrides",
+    "clear_plan_overrides", "override_key", "payload_bucket",
+    "select_plan", "effective_backend",
+    "ExecutablePlan", "FusedExecutablePlan",
+]

@@ -1,0 +1,697 @@
+"""The schedule compiler: requests in, executable plans out.
+
+``compile_collective`` is the single routing authority the legacy
+four-way branch stack collapsed into: a request ``(op, payload, dtype,
+comm)`` is resolved (effective backend, wire format), planned
+(generator candidates against the declared topology, cost-modeled,
+autotuner overrides honored), and bound (lowered onto the existing
+executors, executable-cache keys preserved). Three cache levels:
+
+1. **dispatch memo** (exact call signature → :class:`ExecutablePlan`,
+   generation-stamped): the warm path — one dict hit, zero planning.
+2. **plan cache** (``(op, topology fingerprint, payload bucket, wire,
+   generation())`` → chosen plan + the full candidate list): reused
+   across shapes in the same bucket; the unit ``tune_plan`` overrides.
+3. **executable cache** (exact lowering key → compiled fn): unchanged
+   from the pre-compiler code, including AOT pin semantics.
+
+All three live on the communicator (``_LRUCache``), are pinned by
+``precompile`` and torn down by ``free_collective_resources``."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants, telemetry as _telemetry
+from . import cost as _cost, generators as _generators
+from .ir import Plan
+from .topology import Topology
+
+# ops the compressed wire formats apply to (single-homed in eager as
+# _WIRE_OPS; duplicated name here would drift — import lazily instead)
+
+_MET = None
+
+
+def _plan_metrics():
+    global _MET
+    if _MET is None:
+        m = _telemetry.metrics
+        _MET = (
+            m.counter(
+                "tm_plan_cache_hits_total",
+                "plan-compiler warm hits (dispatch memo or plan cache) "
+                "by op",
+            ),
+            m.counter(
+                "tm_plan_compiles_total",
+                "plan-cache misses (full candidate selection runs) by "
+                "op/generator",
+            ),
+        )
+    return _MET
+
+
+def _count_hit(op: str) -> None:
+    if _telemetry.enabled():
+        _plan_metrics()[0].inc(op=op)
+
+
+def _count_compile(op: str, generator: str) -> None:
+    if _telemetry.enabled():
+        _plan_metrics()[1].inc(op=op, generator=generator)
+
+
+def _eager():
+    from ..collectives import eager
+
+    return eager
+
+
+# ---------------------------------------------------------------------------
+# autotuner plan overrides (the measured winners tune_plan persists)
+# ---------------------------------------------------------------------------
+
+_PLAN_OVERRIDES: Dict[str, str] = {}
+_OVR_EPOCH = 0  # bumped on any override change: plan-cache keys embed it
+
+
+def override_key(op: str, topology_fp: str, bucket: int, wire: str) -> str:
+    """The persistence identity of one plan decision — what tune_plan
+    measures and ``start()`` re-applies, mirroring tuned constants."""
+    return f"{op}|{topology_fp}|b{bucket}|{wire}"
+
+
+def set_plan_override(key: str, generator: str) -> None:
+    global _OVR_EPOCH
+    if generator not in _generators.GENERATORS:
+        raise ValueError(f"unknown plan generator {generator!r}")
+    _PLAN_OVERRIDES[key] = generator
+    _OVR_EPOCH += 1
+
+
+def apply_plan_overrides(entries: Dict[str, str]) -> Dict[str, str]:
+    """Bulk-apply persisted overrides (``load_tuning``); unknown
+    generator names are skipped (forward-compat with newer caches).
+    Returns what was applied."""
+    applied = {}
+    for key, generator in (entries or {}).items():
+        if generator in _generators.GENERATORS:
+            _PLAN_OVERRIDES[key] = generator
+            applied[key] = generator
+    if applied:
+        global _OVR_EPOCH
+        _OVR_EPOCH += 1
+    return applied
+
+
+def plan_overrides() -> Dict[str, str]:
+    return dict(_PLAN_OVERRIDES)
+
+
+def clear_plan_overrides() -> None:
+    global _OVR_EPOCH
+    if _PLAN_OVERRIDES:
+        _PLAN_OVERRIDES.clear()
+        _OVR_EPOCH += 1
+
+
+def payload_bucket(nbytes: int) -> int:
+    """Pow-2 payload bucket for plan-cache keys: plan DECISIONS are
+    shared within a bucket (the schedule family rarely flips inside a
+    2x band); executables stay keyed on exact shapes below."""
+    return max(1, int(nbytes)).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# request resolution (the policy the legacy branch stack applied inline)
+# ---------------------------------------------------------------------------
+
+
+def effective_backend(op: str, nelem: int, dtype, platform: str,
+                      backend: str, route_small: bool) -> str:
+    """Resolve the requested backend: the measured small-message
+    crossover reroutes custom requests to the fused XLA latency path,
+    and the pallas dtype gates fall back to the ppermute ring (REDUCTIONS
+    must preserve the dtype exactly; complex data movers can't byte-view
+    through the RDMA kernels)."""
+    eager = _eager()
+    effective = backend
+    if backend in ("ring", "pallas") and route_small:
+        effective = eager.op_route(op, nelem, platform, backend)
+    if effective == "pallas":
+        import jax.numpy as jnp
+
+        from ..ops import ring_kernels
+
+        if op in ("allreduce", "reduce", "reducescatter"):
+            if not ring_kernels.supports_dtype(dtype):
+                effective = "ring"
+        elif jnp.dtype(dtype).kind == "c":
+            effective = "ring"
+    return effective
+
+
+def _nelem(shape: Tuple[int, ...]) -> int:
+    return int(np.prod((1,) + tuple(shape[1:])))
+
+
+# ---------------------------------------------------------------------------
+# plan selection
+# ---------------------------------------------------------------------------
+
+
+def _plan_cache(comm):
+    cache = getattr(comm, "_plan_cache", None)
+    if cache is None:
+        eager = _eager()
+        cache = eager._LRUCache()
+        comm._plan_cache = cache  # type: ignore[attr-defined]
+    return cache
+
+
+def select_plan(
+    op: str,
+    nelem: int,
+    itemsize: int,
+    topo: Topology,
+    backend: str,
+    wire: str,
+    route_small: bool,
+    comm=None,
+) -> Tuple[Plan, List["_generators.Candidate"]]:
+    """Pick the schedule for an (unpinned) request: plan-cache lookup,
+    else enumerate generator candidates, honor a persisted autotuner
+    override, else take the cost-model minimum."""
+    suffix = constants.platform_suffix(topo.platform)
+    small = (
+        backend in ("ring", "pallas")
+        and route_small
+        and op in _generators._CUTOFF_OPS
+        and nelem <= constants.get(f"small_{op}_size_{suffix}")
+    )
+    bucket = payload_bucket(nelem * itemsize)
+    pkey = (
+        "_planchoice", op, topo.fingerprint(), bucket, wire, backend,
+        route_small, small, _OVR_EPOCH, constants.generation(),
+    )
+    cache = _plan_cache(comm) if comm is not None else None
+    if cache is not None:
+        ent = cache.get(pkey)
+        if ent is not None:
+            return ent
+    cands = _generators.candidate_plans(
+        op, nelem, itemsize, topo, backend, wire=wire,
+        route_small=route_small,
+    )
+    feasible = [c for c in cands if c.feasible]
+    chosen = None
+    override = _PLAN_OVERRIDES.get(
+        override_key(op, topo.fingerprint(), bucket, wire)
+    )
+    if override is not None:
+        chosen = next(
+            (c for c in feasible if c.plan.generator == override), None
+        )
+    if chosen is None and feasible:
+        chosen = min(feasible, key=lambda c: c.cost_us or float("inf"))
+    if chosen is None:
+        # defensive: the gate algebra always leaves one feasible flat
+        # candidate, but a plan must exist even if it ever does not
+        chosen = _generators.Candidate(
+            plan=_generators.gen_flat(op, nelem, itemsize, topo, backend,
+                                      wire),
+            cost_us=None, feasible=True, reason="fallback",
+        )
+        cands = cands + [chosen]
+    chosen.chosen = True
+    ent = (chosen.plan, cands)
+    if cache is not None:
+        cache[pkey] = ent
+    return ent
+
+
+def pinned_plan(generator: str, op: str, nelem: int, itemsize: int,
+                topo: Topology, impl: str, wire: str) -> Plan:
+    """Build the plan a generator-pinning wrapper demanded, bypassing
+    the policy gates (a direct ``run_hierarchical_*`` call runs its
+    composition exactly like the legacy entry point did) but never
+    structural impossibility."""
+    eager = _eager()
+    if generator == "hier":
+        if not (topo.two_level and topo.cartesian):
+            raise eager.CollectiveArgumentError(
+                "hierarchical collectives need a cartesian communicator "
+                "with multiple intra groups of size > 1"
+            )
+        return _generators.gen_hier(op, nelem, itemsize, topo, impl, wire)
+    if generator == "staged":
+        if not (topo.two_level and topo.cartesian):
+            raise eager.CollectiveArgumentError(
+                "staged hierarchical allreduce needs a cartesian "
+                "communicator with multiple intra groups of size > 1"
+            )
+        return _generators.gen_staged(op, nelem, itemsize, topo, impl, wire)
+    if generator == "tree":
+        if not topo.two_level:
+            raise eager.CollectiveArgumentError(
+                "hierarchical allreduce needs a communicator with both "
+                "levels"
+            )
+        return _generators.gen_tree(op, nelem, itemsize, topo, impl, wire)
+    return _generators.gen_flat(op, nelem, itemsize, topo, impl, wire)
+
+
+# ---------------------------------------------------------------------------
+# binding: plan -> executable
+# ---------------------------------------------------------------------------
+
+
+class ExecutablePlan:
+    """A plan bound to a communicator + exact payload: ``execute(x)``
+    replays the lowered executable through the telemetry dispatch
+    wrapper, stamping every flight-recorder entry and span with the
+    plan's stable ``plan_id``."""
+
+    __slots__ = (
+        "plan", "plan_id", "fn", "comm", "op_label", "backend_label",
+        "wire", "nelem", "dtype", "routing", "cache_hit", "records_wire",
+        "place_input",
+    )
+
+    def __init__(self, plan: Plan, fn, comm, op_label: str,
+                 backend_label: str, wire: str, nelem: int, dtype,
+                 routing: str, cache_hit: Optional[bool],
+                 records_wire: bool, place_input: bool = True):
+        self.plan = plan
+        self.plan_id = plan.plan_id
+        self.fn = fn
+        self.comm = comm
+        self.op_label = op_label
+        self.backend_label = backend_label
+        self.wire = wire
+        self.nelem = nelem
+        self.dtype = dtype
+        self.routing = routing
+        self.cache_hit = cache_hit
+        self.records_wire = records_wire
+        self.place_input = place_input
+
+    def execute(self, x):
+        import jax
+
+        eager = _eager()
+        if self.records_wire:
+            eager._record_wire(self.plan.op, self.nelem, self.dtype,
+                               self.wire)
+        if self.place_input:
+            sharding = eager._rank_sharding(self.comm, x.ndim)
+            if getattr(x, "sharding", None) != sharding:
+                x = jax.device_put(x, sharding)
+        hit = self.cache_hit
+        if hit is not None and not hit:
+            # the first replay paid the compile; later ones are warm
+            self.cache_hit = True
+        return eager._dispatch(
+            self.fn, x, self.op_label, self.backend_label, self.wire,
+            self.nelem, hit, comm=self.comm,
+            payload=(tuple(x.shape), x.dtype), routing=self.routing,
+            plan=self.plan_id,
+        )
+
+
+class FusedExecutablePlan:
+    """The coalesced variant: ``execute(flats)`` feeds same-dtype
+    ``[p, n_i]`` slabs through ONE compiled pack+collective plan (flat
+    routing) or a cached single-dispatch concat + the communicator's
+    compiled composition (hierarchical routing — 2 dispatches for k
+    tensors, like the legacy path)."""
+
+    __slots__ = (
+        "plan", "plan_id", "fn", "comm", "backend_label", "wire", "ns",
+        "total", "dtype", "cache_hit", "records_wire", "inner",
+    )
+
+    def __init__(self, plan: Plan, fn, comm, backend_label: str, wire: str,
+                 ns: Tuple[int, ...], total: int, dtype,
+                 cache_hit: Optional[bool], records_wire: bool,
+                 inner=None):
+        self.plan = plan
+        self.plan_id = plan.plan_id
+        self.fn = fn          # fused executable, or the concat fn
+        self.inner = inner    # (backend, route_small, wire_dtype) for the
+        #                       hierarchical delegate path, else None
+        self.comm = comm
+        self.backend_label = backend_label
+        self.wire = wire
+        self.ns = ns
+        self.total = total
+        self.dtype = dtype
+        self.cache_hit = cache_hit
+        self.records_wire = records_wire
+
+    def execute(self, flats):
+        eager = _eager()
+        if self.inner is not None:
+            # concat in one dispatch, then the routed composition (its
+            # own plan + flight entry): 2 dispatches for k tensors
+            backend, route_small, wire_dtype = self.inner
+            cat = self.fn(*[f.astype(self.dtype) for f in flats])
+            return eager.run(
+                self.plan.op, cat, self.comm, backend=backend,
+                route_small=route_small, wire_dtype=wire_dtype,
+            )
+        if self.records_wire:
+            eager._record_wire(self.plan.op, self.total, self.dtype,
+                               self.wire)
+        hit = self.cache_hit
+        if hit is not None and not hit:
+            self.cache_hit = True
+        fn = self.fn
+        return eager._dispatch(
+            lambda args: fn(*args), flats, self.plan.op,
+            self.backend_label, self.wire, self.total, hit,
+            comm=self.comm, payload=(self.ns, self.dtype),
+            routing="fused", plan=self.plan_id,
+        )
+
+
+def _bind(plan: Plan, comm, shape: Tuple[int, ...], dtype, wire: str,
+          root: int, src: int, dst: int) -> ExecutablePlan:
+    from . import lower
+
+    eager = _eager()
+    op = plan.op
+    nelem = _nelem(shape)
+    if plan.generator == "flat":
+        fn, hit = lower.lower_flat(
+            comm, op, plan.backend, shape, dtype, wire, root, src, dst
+        )
+        records = plan.backend in ("ring", "pallas") and op in \
+            eager._WIRE_OPS
+        return ExecutablePlan(
+            plan, fn, comm, op, plan.backend, wire, nelem, dtype, "flat",
+            hit, records,
+        )
+    impl = plan.impl or plan.backend
+    if plan.generator == "hier":
+        # hier/tree executables pick their own device placement inside
+        # the jitted fn (the 2D group-major mesh / flat-mesh constraint);
+        # committing the input to the flat rank sharding here would hand
+        # jit two conflicting device orders and it rejects the mix
+        if op == "allreduce":
+            fn, hit = lower.lower_hier_allreduce(comm, impl, shape, dtype,
+                                                 wire)
+            return ExecutablePlan(
+                plan, fn, comm, "hier_allreduce", impl, wire, nelem,
+                dtype, "hier", hit, impl in ("ring", "pallas"),
+                place_input=False,
+            )
+        fn, hit = lower.lower_hier_collective(comm, op, root, impl, shape,
+                                              dtype)
+        return ExecutablePlan(
+            plan, fn, comm, f"hier_{op}", impl, "full", nelem, dtype,
+            "hier", hit, False, place_input=False,
+        )
+    if plan.generator == "staged":
+        def fn(a):
+            return lower.run_staged_hierarchical_allreduce(
+                a, comm, impl, wire
+            )
+
+        return ExecutablePlan(
+            plan, fn, comm, "staged_allreduce", impl, wire, nelem, dtype,
+            "staged", None, True, place_input=False,
+        )
+    # tree
+    if op == "allreduce":
+        fn, hit = lower.lower_tree_allreduce(comm, shape, dtype, wire)
+        return ExecutablePlan(
+            plan, fn, comm, "tree_hier_allreduce", "ring", wire, nelem,
+            dtype, "tree", hit, True, place_input=False,
+        )
+    fn, hit = lower.lower_tree_broadcast(comm, root, shape, dtype)
+    return ExecutablePlan(
+        plan, fn, comm, "tree_broadcast", impl, "full", nelem, dtype,
+        "tree", hit, False, place_input=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the compile entry points
+# ---------------------------------------------------------------------------
+
+
+def compile_collective(
+    op: str,
+    shape: Tuple[int, ...],
+    dtype,
+    comm,
+    backend: str = "xla",
+    route_small: bool = True,
+    wire_dtype: Optional[str] = None,
+    root: int = 0,
+    src: int = 0,
+    dst: int = 0,
+    generator: Optional[str] = None,
+    impl: Optional[str] = None,
+    wire_override: Optional[str] = None,
+) -> ExecutablePlan:
+    """Compile one eager collective request to an executable plan.
+
+    ``generator``/``impl``/``wire_override`` are the pin surface the
+    thin ``run_hierarchical_*`` wrappers use: a pinned generator
+    bypasses policy gates (cost model, cutoffs, constants) but not
+    structural feasibility, exactly like the legacy direct entry
+    points."""
+    eager = _eager()
+    gen_now = constants.generation()
+    memo = eager._dispatch_memo(comm)
+    dtype_token = str(dtype)
+    sig = (
+        "_plan", op, tuple(shape), dtype_token, backend, route_small,
+        wire_dtype, wire_override, generator, impl, root, src, dst,
+    )
+    ent = memo.get(sig)
+    if ent is not None and ent[0] == gen_now and ent[2] == _OVR_EPOCH:
+        _count_hit(op)
+        return ent[1]
+    import jax.numpy as jnp
+
+    nelem = _nelem(shape)
+    itemsize = jnp.dtype(dtype).itemsize
+    platform = comm._devices[0].platform
+    topo = Topology.from_communicator(comm)
+    if generator is not None:
+        eff = impl or backend
+        if wire_override is not None:
+            wire = wire_override
+        elif eff in ("ring", "pallas") and op in eager._WIRE_OPS:
+            wire = eager.resolve_wire_dtype(op, nelem, dtype, wire_dtype)
+        else:
+            wire = "full"
+        plan = pinned_plan(generator, op, nelem, itemsize, topo,
+                           eff, wire)
+    else:
+        eff = effective_backend(op, nelem, dtype, platform, backend,
+                                route_small)
+        if wire_override is not None:
+            wire = wire_override
+        elif eff in ("ring", "pallas") and op in eager._WIRE_OPS:
+            wire = eager.resolve_wire_dtype(op, nelem, dtype, wire_dtype)
+        else:
+            wire = "full"
+        plan, _cands = select_plan(
+            op, nelem, itemsize, topo, eff, wire, route_small, comm=comm
+        )
+    ep = _bind(plan, comm, tuple(shape), dtype, wire, root, src, dst)
+    memo[sig] = (gen_now, ep, _OVR_EPOCH)
+    _count_compile(op, plan.generator)
+    return ep
+
+
+def compile_fused(
+    op: str,
+    ns: Tuple[int, ...],
+    dtype,
+    comm,
+    backend: str = "xla",
+    route_small: bool = True,
+    wire_dtype: Optional[str] = None,
+) -> FusedExecutablePlan:
+    """Compile a coalesced multi-tensor request (one ``[p, n_i]`` slab
+    per pending tensor). Routing — latency cutoff, wire format,
+    hierarchical delegation — is decided on the TOTAL payload:
+    coalescing is exactly what pushes small tensors past the
+    bandwidth-path and quantization cutoffs."""
+    eager = _eager()
+    gen_now = constants.generation()
+    memo = eager._dispatch_memo(comm)
+    import jax.numpy as jnp
+
+    total = int(sum(ns))
+    sig = ("_planfused", op, tuple(ns), str(dtype), backend, route_small,
+           wire_dtype)
+    ent = memo.get(sig)
+    if ent is not None and ent[0] == gen_now and ent[2] == _OVR_EPOCH:
+        _count_hit(op)
+        return ent[1]
+    itemsize = jnp.dtype(dtype).itemsize
+    platform = comm._devices[0].platform
+    topo = Topology.from_communicator(comm)
+    eff = effective_backend(op, total, dtype, platform, backend,
+                            route_small)
+    wire = "full"
+    if eff in ("ring", "pallas"):
+        wire = eager.resolve_wire_dtype(op, total, dtype, wire_dtype)
+    plan, _cands = select_plan(
+        op, total, itemsize, topo, eff, wire, route_small, comm=comm
+    )
+    from . import lower
+
+    if plan.generator == "flat":
+        fn, hit = lower.lower_fused_flat(comm, op, plan.backend, tuple(ns),
+                                         dtype, wire)
+        ep = FusedExecutablePlan(
+            plan, fn, comm, plan.backend, wire, tuple(ns), total, dtype,
+            hit, plan.backend in ("ring", "pallas"),
+        )
+    else:
+        # hierarchical/staged/tree routing: cached concat + delegate to
+        # the composition through run() (its own compiled plan)
+        cache = eager._resource_cache(comm)
+        ckey = ("_fusecat", tuple(ns), str(jnp.dtype(dtype)))
+        cat = cache.get(ckey)
+        if cat is None:
+            import jax
+
+            cat = jax.jit(lambda *bs: jnp.concatenate(bs, axis=1))
+            cache[ckey] = cat
+        ep = FusedExecutablePlan(
+            plan, cat, comm, plan.backend, wire, tuple(ns), total, dtype,
+            None, False, inner=(backend, route_small, wire_dtype),
+        )
+    memo[sig] = (gen_now, ep, _OVR_EPOCH)
+    _count_compile(op, plan.generator)
+    return ep
+
+
+# ---------------------------------------------------------------------------
+# explain (offline-capable: replaces/extends the selector dump)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_wire_offline(op: str, nelem: int, dtype_name: str,
+                          requested: Optional[str]) -> str:
+    """Jax-free mirror of ``eager.resolve_wire_dtype`` for offline
+    planning (the CLI path, where no backend is imported)."""
+    wire = requested if requested is not None else \
+        constants.get("wire_dtype")
+    if wire in (None, "", "full"):
+        return "full"
+    if wire not in ("int8", "bf16"):
+        raise ValueError(f"unknown wire_dtype {wire!r}")
+    if op not in ("allreduce", "reducescatter"):
+        return "full"
+    if dtype_name != "float32":
+        return "full"
+    if nelem < constants.get("wire_quant_min_elements"):
+        return "full"
+    return wire
+
+
+_DTYPE_SIZES = {
+    "float32": 4, "float64": 8, "bfloat16": 2, "float16": 2,
+    "int32": 4, "int64": 8, "int16": 2, "int8": 1, "uint8": 1,
+}
+
+
+def explain(
+    op: str = "allreduce",
+    nbytes: int = 4 << 20,
+    topo: Optional[Topology] = None,
+    dtype: str = "float32",
+    backend: str = "ring",
+    wire: Optional[str] = None,
+    route_small: bool = True,
+) -> str:
+    """Render the compiler's decision for a request: the chosen plan,
+    its cost-model estimate, and every rejected candidate with its
+    reason — the introspection surface that replaces the selector's
+    static preference dump. Works offline against a declared
+    :class:`Topology` (no jax, no live communicator)."""
+    if topo is None:
+        topo = Topology(platform="tpu", group_sizes=(4,))
+    itemsize = _DTYPE_SIZES.get(dtype, 4)
+    nelem = max(1, nbytes // itemsize)
+    resolved_wire = (
+        _resolve_wire_offline(op, nelem, dtype, wire)
+        if backend in ("ring", "pallas") else "full"
+    )
+    cands = _generators.candidate_plans(
+        op, nelem, itemsize, topo, backend, wire=resolved_wire,
+        route_small=route_small,
+    )
+    feasible = [c for c in cands if c.feasible]
+    bucket = payload_bucket(nelem * itemsize)
+    okey = override_key(op, topo.fingerprint(), bucket, resolved_wire)
+    override = _PLAN_OVERRIDES.get(okey)
+    chosen = None
+    if override is not None:
+        chosen = next(
+            (c for c in feasible if c.plan.generator == override), None
+        )
+    how = "autotuned (tune_plan)" if chosen is not None else "cost model"
+    if chosen is None and feasible:
+        chosen = min(feasible, key=lambda c: c.cost_us or float("inf"))
+    lines = [
+        f"request: {op} {_generators_fmt_bytes(nbytes)} {dtype} "
+        f"backend={backend} wire={resolved_wire}",
+        f"topology: {topo.describe()}",
+        f"  fingerprint {topo.fingerprint()}",
+        f"plan cache key: (op={op}, topo, bucket=2^{bucket}, "
+        f"wire={resolved_wire}, generation={constants.generation()})",
+        f"override key: {okey}"
+        + (f" -> {override} (persisted)" if override else " (no override)"),
+        "",
+    ]
+    if chosen is None:
+        lines.append("no feasible candidate (request cannot dispatch)")
+    else:
+        lines.append(
+            f"CHOSEN [{how}]: {chosen.plan.plan_id}  "
+            f"est {chosen.cost_us:.1f}us"
+        )
+        lines.append(chosen.plan.describe())
+        bd = _cost.cost_breakdown(chosen.plan)
+        if bd:
+            lines.append(
+                "  cost: " + ", ".join(
+                    f"{k}={v:.1f}us" for k, v in sorted(bd.items())
+                )
+            )
+    lines.append("")
+    lines.append("candidates:")
+    order = sorted(
+        cands,
+        key=lambda c: (not c.feasible, c.cost_us or float("inf")),
+    )
+    for c in order:
+        mark = "CHOSEN  " if c is chosen else (
+            "ok      " if c.feasible else "rejected"
+        )
+        est = f"{c.cost_us:9.1f}us" if c.cost_us is not None else \
+            "      --  "
+        reason = f"  ({c.reason})" if c.reason else ""
+        lines.append(
+            f"  {mark} {c.plan.plan_id:<32} {est}{reason}"
+        )
+    return "\n".join(lines)
+
+
+def _generators_fmt_bytes(n: int) -> str:
+    from .ir import _fmt_bytes
+
+    return _fmt_bytes(n)

@@ -1,0 +1,87 @@
+"""Analytic alpha-beta cost model over plan steps.
+
+Each link class (ICI / DCN / host) carries an ``alpha`` (fixed per-hop
+launch latency, µs) and a ``beta`` (per-MiB transfer time, µs/MiB) —
+the classic LogP/alpha-beta collective model the GC3/HiCCL line of work
+costs schedules with (PAPERS.md). Quantize/dequantize steps are priced
+by a throughput term, pack/unpack/local_reduce by a local-bandwidth
+term, and every plan pays a per-dispatch overhead — the Python+XLA
+submit cost the latency path fights.
+
+All terms are ``plan_cost_*`` constants (knob table in the README):
+they start as conservative analytic defaults and are *calibrated by
+measurement* — ``tune_plan`` measures real candidate plans and persists
+the winner per cache key, and the small-message crossover constants
+(``small_*_size_*``, themselves autotuned) feed the latency-path gate.
+The analytic model's job is to ORDER candidates between measurements,
+not to predict wall time to the microsecond.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .. import constants
+from .ir import Plan, Step
+from .topology import LINK_DCN, LINK_HOST, LINK_ICI, LINK_LOCAL
+
+_MIB = float(1 << 20)
+
+# link class -> (alpha constant, beta constant)
+_LINK_KNOBS = {
+    LINK_ICI: ("plan_cost_alpha_ici_us", "plan_cost_beta_ici_us_per_mib"),
+    LINK_DCN: ("plan_cost_alpha_dcn_us", "plan_cost_beta_dcn_us_per_mib"),
+    LINK_HOST: ("plan_cost_alpha_host_us", "plan_cost_beta_host_us_per_mib"),
+}
+
+
+def link_alpha_us(level: str) -> float:
+    if level == LINK_LOCAL:
+        return 0.0
+    return float(constants.get(_LINK_KNOBS[level][0]))
+
+
+def link_beta_us_per_mib(level: str) -> float:
+    if level == LINK_LOCAL:
+        # on-device local work (pack/unpack/accumulate) rides HBM, far
+        # faster than any link: priced as a fraction of the ICI beta
+        return float(constants.get(_LINK_KNOBS[LINK_ICI][1])) / 8.0
+    return float(constants.get(_LINK_KNOBS[level][1]))
+
+
+def step_cost_us(step: Step) -> float:
+    mib = step.bytes / _MIB
+    if step.kind in ("quantize", "dequantize"):
+        rate = float(constants.get("plan_cost_quantize_us_per_mib"))
+        return step.count * mib * rate
+    if step.kind in ("pack", "unpack", "local_reduce"):
+        return step.count * mib * link_beta_us_per_mib(LINK_LOCAL)
+    # send / recv / reduce: alpha-beta on the step's link class
+    return step.count * (
+        link_alpha_us(step.level) + mib * link_beta_us_per_mib(step.level)
+    )
+
+
+def estimate_us(plan: Plan) -> float:
+    """Total analytic cost of a plan in microseconds: per-dispatch
+    overhead (one per compiled executable the plan replays; composed
+    host-staged plans declare more via meta ``dispatches``) plus the
+    alpha-beta sum over its steps."""
+    dispatches = 1
+    for k, v in plan.meta:
+        if k == "dispatches":
+            dispatches = int(v)
+    total = dispatches * float(constants.get("plan_cost_dispatch_us"))
+    for step in plan.steps:
+        total += step_cost_us(step)
+    return total
+
+
+def cost_breakdown(plan: Plan) -> Dict[str, float]:
+    """Per-link-class µs attribution (explain output)."""
+    out: Dict[str, float] = {}
+    for step in plan.steps:
+        key = step.level if step.kind not in ("quantize", "dequantize") \
+            else "codec"
+        out[key] = out.get(key, 0.0) + step_cost_us(step)
+    return out

@@ -1,0 +1,687 @@
+"""Lower compiled plans onto the existing executors.
+
+A :class:`~.ir.Plan` decides *what* schedule runs; this module binds it
+to the machinery that actually runs it — the Pallas ICI-RDMA ring
+kernels, the ppermute rings, and the fused XLA primitives in
+``collectives/primitives.py``. Numerics and backends are byte-identical
+to the pre-compiler code paths: the kernel compositions here are the
+ones that lived inline in ``eager.py``'s branch stack (hierarchical /
+staged / tree), moved behind the plan IR, with their executable-cache
+keys preserved verbatim so warm caches, pin semantics and the tests
+that introspect them are unchanged.
+
+Every lowering returns ``(fn, cache_hit)``: ``fn`` consumes the
+rank-stacked input and ``cache_hit`` labels the dispatch telemetry.
+Lowered executables are memoized in the communicator's resource cache
+(``eager._resource_cache`` — the ``_LRUCache`` with AOT pin
+semantics)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import constants
+from ..collectives import primitives as prim
+from ..runtime.communicator import Communicator
+
+_AXIS = "mpi"
+
+
+def _eager():
+    # late import: eager imports the schedule compiler lazily per call,
+    # and this module is pulled in through it — a module-level import
+    # here would re-enter eager mid-initialization.
+    from ..collectives import eager
+
+    return eager
+
+
+# ---------------------------------------------------------------------------
+# flat terminal path
+# ---------------------------------------------------------------------------
+
+
+def lower_flat(comm: Communicator, op: str, backend: str, shape: Tuple,
+               dtype, wire: str, root: int, src: int, dst: int):
+    """The flat executable: exactly the legacy ``run()`` terminal path —
+    bidir marker, ring tuning, broadcast tree/pipeline decision and the
+    wire key all participate in the executable-cache key as before."""
+    eager = _eager()
+    platform = comm._devices[0].platform
+    nelem = int(np.prod((1,) + tuple(shape[1:])))
+    extra: Tuple = (src, dst) if op == "sendreceive" else ()
+    if (
+        backend == "pallas"
+        and op == "allreduce"
+        and constants.get("ring_implementation") == "pallas_bidir"
+        and wire == "full"
+    ):
+        extra = extra + ("bidir",)
+    tuning: Tuple = ()
+    if backend in ("ring", "pallas"):
+        tuning = eager.ring_tuning(platform)
+    if backend in ("ring", "pallas") and op == "broadcast":
+        tree, k = eager.broadcast_plan(nelem, dtype, platform)
+        extra = extra + (("tree",) if tree else ("pipeline", ("chunks", k)))
+    wire_key = (
+        (wire, constants.get("wire_quant_block_size"))
+        if wire != "full"
+        else ("full",)
+    )
+    aval = (tuple(shape), dtype)
+    static = (root,) + extra + (tuning, wire_key)
+    return eager._compile(
+        comm, op, backend, aval, static,
+        lambda: eager._kernels(op, backend, root, extra, tuning, wire),
+    )
+
+
+def lower_fused_flat(comm: Communicator, op: str, backend: str,
+                     ns: Tuple[int, ...], dtype, wire: str):
+    """The coalesced flat executable: pack-concat + collective compiled
+    as ONE plan per (op, layout, dtype, routing) — legacy ``run_fused``'s
+    terminal path, cache key preserved (``"_fused"``)."""
+    eager = _eager()
+    platform = comm._devices[0].platform
+    extra: Tuple = ()
+    if (
+        backend == "pallas"
+        and constants.get("ring_implementation") == "pallas_bidir"
+        and wire == "full"
+    ):
+        extra = ("bidir",)
+    tuning: Tuple = ()
+    if backend in ("ring", "pallas"):
+        tuning = eager.ring_tuning(platform)
+    wire_key = (
+        (wire, constants.get("wire_quant_block_size"))
+        if wire != "full"
+        else ("full",)
+    )
+    cache = eager._resource_cache(comm)
+    key = (
+        "_fused", op, backend, ns, str(jnp.dtype(dtype)), extra, tuning,
+        wire_key,
+    )
+    fn = cache.get(key)
+    hit = fn is not None
+    if fn is None:
+        inner = eager._kernels(op, backend, 0, extra, tuning, wire)
+
+        def kernel(*blocks):  # each [1, n_i] per-rank slab
+            return inner(jnp.concatenate(blocks, axis=-1))
+
+        mesh = eager._flat_mesh(comm)
+        spec = eager._rank_spec(2)
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=(spec,) * len(ns), out_specs=spec,
+            check_vma=False,
+        )
+        # in_shardings fold the device placement of every slab into this
+        # one dispatch (the flat path's explicit per-array device_put,
+        # amortized k-fold)
+        sharding = eager._rank_sharding(comm, 2)
+        fn = jax.jit(shmapped, in_shardings=(sharding,) * len(ns))
+        cache[key] = fn
+    return fn, hit
+
+
+# ---------------------------------------------------------------------------
+# two-level cartesian compositions
+# ---------------------------------------------------------------------------
+
+
+def _pallas_intra_ring(wire_arg: Optional[str] = None):
+    """(ring_fn, bidir) for the intra (ICI) allreduce phase when the
+    selector routed 'pallas' — uni- or bidirectional per
+    ``ring_implementation``. The ONE selection site shared by the direct
+    and staged hierarchical paths, so their intra transports can never
+    diverge. A compressed ``wire_arg`` pins the unidirectional quantized
+    kernel (the bidir ring has no quant path)."""
+    from ..ops.ring_kernels import (
+        ring_allreduce_bidir_pallas,
+        ring_allreduce_pallas,
+    )
+
+    if wire_arg is not None:
+        def quant_ring(b, axis):
+            return ring_allreduce_pallas(b, axis, wire_dtype=wire_arg)
+
+        return quant_ring, False
+    bidir = constants.get("ring_implementation") == "pallas_bidir"
+    return (
+        ring_allreduce_bidir_pallas if bidir else ring_allreduce_pallas,
+        bidir,
+    )
+
+
+def _hier_compile(comm: Communicator, key, ndim: int, donate: bool, kernel,
+                  post=None):
+    """Shared scaffolding for 2-level (cartesian) compositions: permute the
+    rank-stacked rows into group-major mesh order, shard_map ``kernel`` over
+    the (inter, intra) mesh, permute back (+ optional ``post(out, inv)``),
+    jit with donation, memoize under ``key``. Returns ``(fn, cache_hit)``."""
+    eager = _eager()
+    cache = eager._resource_cache(comm)
+    fn = cache.get(key)
+    if fn is not None:
+        return fn, True
+    perm = np.concatenate(comm._groups).astype(np.int32)
+    inv = np.argsort(perm).astype(np.int32)
+    mesh = comm.mesh  # 2D (inter, intra)
+    spec = P(("inter", "intra"), *([None] * (ndim - 1)))
+    shmapped = jax.shard_map(
+        kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+    perm_j, inv_j = jnp.asarray(perm), jnp.asarray(inv)
+
+    def run_fn(a):
+        out = jnp.take(shmapped(jnp.take(a, perm_j, axis=0)), inv_j, axis=0)
+        return out if post is None else post(out, inv_j)
+
+    fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
+    cache[key] = fn
+    return fn, False
+
+
+def lower_hier_allreduce(comm: Communicator, impl: str, shape: Tuple,
+                         dtype, wire: str):
+    """Two-level allreduce over a cartesian communicator: ring within
+    each intra group, ring across the inter dimension — the reference's
+    ``allreducep2pHierarchicalImpl`` (``collectives_cuda.cpp:501-581``),
+    cartesian shortcut included. Cache key shape preserved
+    (``"hier_allreduce"``)."""
+    eager = _eager()
+    donate = constants.get("donate_eager_buffers")
+    tuning = (
+        eager.ring_tuning(comm._devices[0].platform)
+        if impl in ("ring", "pallas")
+        else ()
+    )
+    # the uni-vs-bidirectional pallas variant participates in the cache
+    # key: the autotuner toggles ring_implementation between measurements
+    bidir = (
+        impl == "pallas"
+        and constants.get("ring_implementation") == "pallas_bidir"
+        and wire == "full"
+    )
+    wire_arg = wire if wire != "full" else None
+    key = (
+        "hier_allreduce", impl, tuple(shape), dtype, donate,
+        tuning, bidir,
+        (wire, constants.get("wire_quant_block_size"))
+        if wire != "full" else ("full",),
+    )
+
+    if impl == "pallas":
+        # intra = ICI: the Pallas RDMA ring (uni- or bidirectional per
+        # ring_implementation); inter = cross-ICI/DCN: the ppermute ring
+        # (XLA schedules it over the slower fabric) — the reference's
+        # intra-IPC-ring x inter-MPI split. The wire format applies to
+        # BOTH levels: the inter hop is the slowest fabric, exactly where
+        # compression pays most.
+        intra_ring, _ = _pallas_intra_ring(wire_arg)
+        minb, maxb, nbuf = tuning
+
+        def kernel(b):
+            b = intra_ring(b, "intra")
+            return prim.ring_allreduce(
+                b, "inter",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf, wire_dtype=wire_arg,
+            )
+    elif impl == "ring":
+        minb, maxb, nbuf = tuning
+
+        def kernel(b):
+            b = prim.ring_allreduce(
+                b, "intra",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf, wire_dtype=wire_arg,
+            )
+            return prim.ring_allreduce(
+                b, "inter",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf, wire_dtype=wire_arg,
+            )
+    else:
+        def kernel(b):
+            return jax.lax.psum(jax.lax.psum(b, "intra"), "inter")
+
+    ndim = len(shape)
+    return _hier_compile(comm, key, ndim, donate, kernel)
+
+
+def lower_hier_collective(comm: Communicator, op: str, root: int,
+                          ring_impl: str, shape: Tuple, dtype):
+    """Two-level composition of broadcast/reduce/allgather on a cartesian
+    communicator (``collectives_cuda.cpp:501-581,1057-1141``):
+
+    - broadcast: inter-level ring/tree broadcast from the root's group
+      within every intra row, then intra broadcast from the root's intra
+      rank (every rank ends with the root's block).
+    - reduce: intra ring-reduce to the root's intra rank, inter ring-reduce
+      to the root's group; non-root ranks keep their input (this API's
+      defined MPI_Reduce behavior).
+    - allgather: intra all-gather then inter all-gather along the last dim,
+      with the concatenation re-ordered from mesh (group-major) order to
+      global rank order.
+
+    ``ring_impl`` selects the INTRA-phase transport: ``'ring'`` (ppermute)
+    or ``'pallas'`` (ICI RDMA kernels) — the level where the custom
+    transport pays. The inter phase always runs the ppermute ring (it
+    rides the slower cross-group fabric)."""
+    eager = _eager()
+    donate = constants.get("donate_eager_buffers")
+    platform = comm._devices[0].platform
+    tuning = eager.ring_tuning(platform)
+    minb, maxb, nbuf = tuning
+    nelem = int(np.prod((1,) + tuple(shape[1:])))
+    tree, chunks = True, 1
+    if op == "broadcast":
+        tree, chunks = eager.broadcast_plan(nelem, dtype, platform)
+    key = (
+        "hier", op, root, tuple(shape), dtype, donate, tuning,
+        (tree, chunks), ring_impl,
+    )
+    g0 = next(gi for gi, g in enumerate(comm._groups) if root in g)
+    i0 = comm.member(root).intra_rank
+    pallas_intra = ring_impl == "pallas"
+
+    def bcast_axis(b, r, axis):
+        if tree:
+            return prim.tree_broadcast(b, r, axis)
+        return prim.ring_broadcast(b, r, axis, num_chunks=chunks)
+
+    def intra_bcast(b):
+        if pallas_intra:
+            from ..ops.ring_kernels import ring_broadcast_pallas
+
+            return ring_broadcast_pallas(b, i0, "intra", num_chunks=chunks)
+        return bcast_axis(b, i0, "intra")
+
+    def intra_reduce(b):
+        if pallas_intra:
+            from ..ops.ring_kernels import ring_reduce_pallas
+
+            return ring_reduce_pallas(b, i0, "intra")
+        return prim.ring_reduce(
+            b, i0, "intra",
+            max_bytes_per_step=maxb, min_bytes_per_step=minb,
+            num_buffers=nbuf,
+        )
+
+    def intra_allgather(b):
+        if pallas_intra:
+            return eager._pallas_allgather_lastdim(b, "intra")
+        return prim.ring_allgather(b, "intra", dim=-1)
+
+    if op == "broadcast":
+        def kernel(b):
+            # inter phase within every intra row, then intra phase
+            b = bcast_axis(b, g0, "inter")
+            return intra_bcast(b)
+        post = None
+    elif op == "reduce":
+        def kernel(b):
+            y = intra_reduce(b)
+            z = prim.ring_reduce(
+                y, g0, "inter",
+                max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                num_buffers=nbuf,
+            )
+            is_root = (lax.axis_index("inter") == g0) & (
+                lax.axis_index("intra") == i0
+            )
+            return jnp.where(is_root, z, b)
+        post = None
+    else:  # allgather
+        def kernel(b):
+            b = intra_allgather(b)
+            return prim.ring_allgather(b, "inter", dim=-1)
+
+        p, d = comm.size, int(shape[-1])
+
+        def post(out, inv_j):
+            # concat blocks arrive in mesh (group-major) order: put them
+            # in global rank order along the gathered dim
+            blocks = out.reshape(out.shape[:-1] + (p, d))
+            return jnp.take(blocks, inv_j, axis=-2).reshape(out.shape)
+
+    return _hier_compile(comm, key, len(shape), donate, kernel, post)
+
+
+# ---------------------------------------------------------------------------
+# host-staged inter allreduce
+# ---------------------------------------------------------------------------
+
+# monotone counters giving every staged exchange a distinct gather tag,
+# one per participating process set (SPMD program order holds within a
+# set, not across overlapping subset communicators)
+_staged_exchange_epochs: dict = {}
+
+
+def run_staged_hierarchical_allreduce(
+    x, comm: Communicator, intra_impl: str = "ring", wire: str = "full"
+):
+    """Host-staged cross-group allreduce — the TPU analog of
+    ``allreducep2pCrossNodesViaCPU`` (staged-via-pinned-CPU,
+    ``detail/collectives_cuda.cpp:390-683``), selected by the topology's
+    host-staged inter declaration (``use_staged_collectives``):
+
+    1. device: ring-allreduce within each intra group (ICI-local) — the
+       ppermute ring, or the Pallas RDMA ring when the selector routed
+       ``intra_impl='pallas'`` (the reference's staged path likewise kept
+       its custom IPC transport inside the node);
+    2. host: fetch one representative group-sum per group, reduce across
+       groups in host memory (the DCN-staged hop);
+    3. device: push the global total back to every rank.
+
+    The staged hop trades device-collective bandwidth for not needing any
+    inter-group device link — exactly the reference's rationale when GDR
+    was unavailable.
+    """
+    eager = _eager()
+    cache = eager._resource_cache(comm)
+    tuning = eager.ring_tuning(comm._devices[0].platform)
+    wire_arg = wire if wire != "full" else None
+    bidir = (
+        intra_impl == "pallas"
+        and constants.get("ring_implementation") == "pallas_bidir"
+        and wire_arg is None
+    )
+    key = (
+        "staged_allreduce", intra_impl, bidir, tuple(x.shape),
+        jnp.result_type(x), tuning,
+        (wire, constants.get("wire_quant_block_size"))
+        if wire_arg else ("full",),
+    )
+    entry = cache.get(key)
+    if entry is None:
+        perm = np.concatenate(comm._groups).astype(np.int32)
+        mesh = comm.mesh
+        spec = P(("inter", "intra"), *([None] * (x.ndim - 1)))
+        minb, maxb, nbuf = tuning
+
+        if intra_impl == "pallas":
+            intra_ring, _ = _pallas_intra_ring(wire_arg)
+
+            def intra_kernel(b):
+                return intra_ring(b, "intra")
+        else:
+            def intra_kernel(b):
+                return prim.ring_allreduce(
+                    b, "intra",
+                    max_bytes_per_step=maxb, min_bytes_per_step=minb,
+                    num_buffers=nbuf, wire_dtype=wire_arg,
+                )
+
+        shmapped = jax.shard_map(
+            intra_kernel, mesh=mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+        perm_j = jnp.asarray(perm)
+        # the output stays in GROUP-MAJOR order, pinned to the SAME
+        # (inter, intra) mesh the shard_map runs on (a rank-order out
+        # sharding would use a different device order and jit rejects
+        # mixed orders). Row k is rank perm[k]'s group sum, one row per
+        # device — so the rep extraction below is partition-exact and
+        # position k maps to a rank through perm.
+        intra_fn = jax.jit(
+            lambda a: shmapped(jnp.take(a, perm_j, axis=0)),
+            out_shardings=NamedSharding(mesh, spec),
+        )
+        # reps (group firsts) sit at the head of each group-major block
+        isz = len(comm._groups[0])
+        rep_pos = np.arange(len(comm._groups), dtype=np.int32) * isz
+        entry = (intra_fn, rep_pos)
+        cache[key] = entry
+    intra_fn, rep_pos = entry
+    reduced = intra_fn(x)  # group-major; every row = its group's sum
+    # host-staged inter reduction (the DCN hop)
+    procs = sorted({d.process_index for d in comm._devices})
+    if len(procs) > 1:
+        # Multi-controller: jax.device_get of the full representative set
+        # would raise — most rep rows are non-addressable here. Instead
+        # each process sums the rep rows it OWNS (partition-exact: one
+        # group-major row per device) and the partials meet over the PS
+        # socket transport: host wires, no inter-group device link — the
+        # point of the staged path (collectives_cuda.cpp:390-683).
+        rep_set = {int(k) for k in rep_pos}
+        rows = {}
+        for shard in reduced.addressable_shards:
+            k = shard.index[0].start or 0
+            if k in rep_set and k not in rows:
+                rows[k] = np.asarray(shard.data)[0]
+        dt = np.dtype(reduced.dtype)
+        per_row = tuple(x.shape[1:])
+        partial = np.zeros(per_row, dt)
+        for row in rows.values():
+            partial = partial + row
+        partial = np.ascontiguousarray(partial, dt)
+        from ..parameterserver import transport as ps_transport
+
+        if ps_transport._transport is None and len(procs) < jax.process_count():
+            # Bootstrapping the transport does a JOB-global address
+            # exchange; entering it from a collective only a subset of
+            # processes runs would hang the subset forever. Bootstrap is
+            # a job-global act — demand it happen at one.
+            raise RuntimeError(
+                "staged hierarchical allreduce on a communicator spanning "
+                f"processes {procs} of {jax.process_count()}: the PS socket "
+                "transport is not bootstrapped, and bootstrapping is "
+                "job-global. Call torchmpi_tpu.parameterserver.transport."
+                "ensure_transport() once on EVERY process (e.g. right "
+                "after start()) before staged collectives on subset "
+                "communicators."
+            )
+        # distinct gather tag per exchange, scoped to the PARTICIPATING
+        # process set: SPMD program order is only guaranteed among the
+        # processes that actually run this collective, so a process-global
+        # counter would desync when subset communicators overlap
+        pkey = tuple(procs)
+        epoch = _staged_exchange_epochs.get(pkey, 0) + 1
+        _staged_exchange_epochs[pkey] = epoch
+        tag = f"staged-allreduce:{','.join(map(str, pkey))}:{epoch}"
+        blobs = ps_transport.ensure_transport().allgather_blob(
+            procs, tag, partial.tobytes(),
+            timeout=constants.get("deadlock_timeout_seconds") or None,
+        )
+        total = np.zeros(per_row, dt)
+        for blob in blobs.values():
+            total = total + np.frombuffer(blob, dt).reshape(per_row)
+        total = total.astype(dt, copy=False)
+    else:
+        host = np.asarray(jax.device_get(reduced[np.asarray(rep_pos)]))
+        total = host.sum(axis=0).astype(host.dtype)
+    stacked = np.broadcast_to(total, (comm.size,) + total.shape)
+    # make_array_from_callback works on single- AND multi-controller
+    # meshes (device_put with a global sharding does not on the latter)
+    return jax.make_array_from_callback(
+        stacked.shape, eager._rank_sharding(comm, x.ndim),
+        lambda idx: stacked[idx]
+    )
+
+
+# ---------------------------------------------------------------------------
+# ragged (non-cartesian) compositions
+# ---------------------------------------------------------------------------
+
+
+def _binomial_reduce_steps(groups, p: int):
+    """Static (perm, recv_mask) schedule per step of a binomial reduction to
+    each group's first member: member j at span s receives from j+span when
+    j % 2span == 0. ``log2(max group)`` steps; every value accumulated
+    exactly once."""
+    steps = []
+    span = 1
+    while True:
+        perm = []
+        mask = np.zeros((p,), bool)
+        for g in groups:
+            for j in range(0, len(g), 2 * span):
+                if j + span < len(g):
+                    perm.append((g[j + span], g[j]))
+                    mask[g[j]] = True
+        if not perm:
+            break
+        steps.append((perm, mask))
+        span *= 2
+    return steps
+
+
+def lower_tree_allreduce(comm: Communicator, shape: Tuple, dtype,
+                         wire: str):
+    """Hierarchical allreduce on a NON-cartesian (ragged/tree)
+    communicator — the reference's non-cartesian path (intra reduce to
+    group root, inter exchange among roots, final intra broadcast,
+    ``collectives_cuda.cpp:546-581``).
+
+    TPU-native expression: statically-scheduled binomial ``ppermute``
+    reductions (ragged groups forbid XLA's ``axis_index_groups``, which
+    requires equal-size groups on TPU): reduce within each group to its
+    root, reduce across the roots to the global root, then a static
+    cross-device gather broadcasts the total — the trailing broadcast of
+    the reference, collapsed to one hop.
+
+    A compressed ``wire`` encodes every binomial exchange hop (partials
+    quantized on send, f32 accumulate — non-target ranks receive zeros,
+    which decode to exact zeros); only the final one-hop gather broadcast
+    ships full precision. Cache key preserved (``"tree_hier_allreduce"``)."""
+    eager = _eager()
+    cache = eager._resource_cache(comm)
+    donate = constants.get("donate_eager_buffers")
+    wire_arg = wire if wire != "full" else None
+    block = constants.get("wire_quant_block_size")
+    key = (
+        "tree_hier_allreduce", tuple(shape), dtype, donate,
+        (wire, block) if wire_arg else ("full",),
+    )
+    fn = cache.get(key)
+    hit = fn is not None
+    if fn is None:
+        p = comm.size
+        groups = [list(map(int, g)) for g in comm._groups]
+        roots = [g[0] for g in groups]
+        schedule = _binomial_reduce_steps(groups, p) + _binomial_reduce_steps(
+            [roots], p
+        )
+        mesh = eager._flat_mesh(comm)
+        spec = eager._rank_spec(len(shape))
+
+        def kernel(b):
+            for perm, mask in schedule:
+                if wire_arg:
+                    # non-targets receive zero q/scales -> decode to 0
+                    recv = prim._wire_send_recv(
+                        b, _AXIS, perm, wire_arg, block
+                    )
+                else:
+                    recv = lax.ppermute(b, _AXIS, perm)  # non-targets: 0
+                receives = jnp.take(
+                    jnp.asarray(mask), lax.axis_index(_AXIS)
+                )
+                b = jnp.where(receives, b + recv, b)
+            return b
+
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        )
+        sharding = eager._rank_sharding(comm, len(shape))
+        # trailing broadcast: everyone reads the global root's total
+        idx = jnp.full((p,), roots[0], jnp.int32)
+
+        def run_fn(a):
+            y = shmapped(a)
+            return jax.lax.with_sharding_constraint(
+                jnp.take(y, idx, axis=0), sharding
+            )
+
+        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
+        cache[key] = fn
+    return fn, hit
+
+
+def _binomial_fanout_steps(root: int, targets, p: int):
+    """Static (perm, recv_mask) ppermute rounds delivering ``root``'s
+    block to every rank in ``targets``: each round, every current holder
+    forwards to ONE pending target (unique sources per round — the
+    ppermute contract), so holders double and the depth is
+    ``ceil(log2(len(targets)+1))``. Every target receives exactly once."""
+    pending = [t for t in targets if t != root]
+    holders = [root]
+    steps = []
+    while pending:
+        perm = []
+        mask = np.zeros((p,), bool)
+        grabbed = []
+        for h in holders:
+            if not pending:
+                break
+            d = pending.pop(0)
+            perm.append((h, d))
+            mask[d] = True
+            grabbed.append(d)
+        holders = holders + grabbed
+        steps.append((perm, mask))
+    return steps
+
+
+def lower_tree_broadcast(comm: Communicator, root: int, shape: Tuple,
+                         dtype):
+    """Topology-aware broadcast on a ragged communicator — NEW
+    capability: the old router ran ragged broadcasts flat, paying the
+    inter fabric on every ring hop. The plan: a binomial inter fan-out
+    of the root's block to every group root (log2(groups) ``ppermute``
+    rounds; each island is crossed exactly once), then a group-root
+    gather within every island delivers it."""
+    eager = _eager()
+    cache = eager._resource_cache(comm)
+    key = ("tree_bcast", root, tuple(shape), dtype)
+    fn = cache.get(key)
+    hit = fn is not None
+    if fn is None:
+        p = comm.size
+        groups = [list(map(int, g)) for g in comm._groups]
+        g_root = next(g for g in groups if root in g)
+        # inter fan-out targets: every OTHER group's root (the root's own
+        # island reads the root directly in the gather hop)
+        targets = [g[0] for g in groups if g is not g_root]
+        schedule = _binomial_fanout_steps(root, targets, p)
+        mesh = eager._flat_mesh(comm)
+        spec = eager._rank_spec(len(shape))
+
+        def kernel(b):
+            for perm, mask in schedule:
+                recv = lax.ppermute(b, _AXIS, perm)
+                receives = jnp.take(jnp.asarray(mask), lax.axis_index(_AXIS))
+                b = jnp.where(receives, recv, b)
+            return b
+
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        )
+        sharding = eager._rank_sharding(comm, len(shape))
+        # gather hop: members read their island's root (now holding the
+        # block); the root's own island reads the root directly
+        src = np.zeros((p,), np.int32)
+        for g in groups:
+            for r in g:
+                src[r] = root if g is g_root else g[0]
+        idx = jnp.asarray(src)
+
+        def run_fn(a):
+            y = shmapped(a)
+            return jax.lax.with_sharding_constraint(
+                jnp.take(y, idx, axis=0), sharding
+            )
+
+        fn = jax.jit(run_fn, donate_argnums=())
+        cache[key] = fn
+    return fn, hit

@@ -187,9 +187,9 @@ def merged_trace(ranks: Dict[int, dict]) -> dict:
                 "dur": max(t1 - t0, 1.0),
                 "pid": rank,
                 "tid": _FLIGHT_TID,
-                "args": {k: e[k] for k in
+                "args": {k: e.get(k, "") for k in
                          ("seq", "comm", "payload", "wire", "backend",
-                          "routing", "status")},
+                          "routing", "plan", "status")},
             })
             all_ts.append(t0)
         per_rank_events[rank] = evs
@@ -232,10 +232,15 @@ def _collective_streams(ranks: Dict[int, dict]) -> Dict[str, Dict[int, dict]]:
 
 
 def detect_desync(ranks: Dict[int, dict]) -> dict:
-    """Diff per-comm (seq, op, payload) streams across ranks. The ring
-    may have dropped old entries, so each comm is compared over the seq
-    window every rank still holds; per-rank high-water mismatches are
-    reported separately (the 'rank stopped early' signal)."""
+    """Diff per-comm (seq, op, payload, plan) streams across ranks. The
+    ring may have dropped old entries, so each comm is compared over the
+    seq window every rank still holds; per-rank high-water mismatches are
+    reported separately (the 'rank stopped early' signal). The plan_id
+    participates in the diff: two ranks can agree on (op, payload) yet
+    compile DIFFERENT schedules (divergent constants, topology or
+    autotuner state) — before plans, that desync was invisible here and
+    hierarchical sub-structure was attributed to the parent op with no
+    routing detail."""
     truncated = {
         rank: data["snapshot"].get("flight_recorder", {}).get("dropped", 0)
         for rank, data in ranks.items()
@@ -253,7 +258,7 @@ def detect_desync(ranks: Dict[int, dict]) -> dict:
             vals = {r: s.get(seq) for r, s in by_rank.items()}
             missing = [r for r, v in vals.items() if v is None]
             kinds = {
-                r: (v["op"], v["payload"])
+                r: (v["op"], v["payload"], v.get("plan", ""))
                 for r, v in vals.items() if v is not None
             }
             if missing or len(set(kinds.values())) > 1:
@@ -262,6 +267,7 @@ def detect_desync(ranks: Dict[int, dict]) -> dict:
                     "seq": seq,
                     "ops": {str(r): v[0] for r, v in kinds.items()},
                     "payloads": {str(r): v[1] for r, v in kinds.items()},
+                    "plans": {str(r): v[2] for r, v in kinds.items()},
                     "ranks_missing_seq": missing,
                 }
                 break
@@ -522,12 +528,20 @@ def _summary_lines(report: dict) -> List[str]:
     if div is None:
         lines.append("desync: none")
     else:
-        ops = ", ".join(
-            f"rank {r}={op}" for r, op in sorted(div["ops"].items())
-        )
+        plans = div.get("plans", {})
+        if len(set(div["ops"].values())) <= 1 and len(set(plans.values())) > 1:
+            # same op, different compiled schedule: name the PLAN — the
+            # divergence the old op-only diff could not see
+            detail = ", ".join(
+                f"rank {r}={p or '(no plan)'}" for r, p in sorted(plans.items())
+            )
+        else:
+            detail = ", ".join(
+                f"rank {r}={op}" for r, op in sorted(div["ops"].items())
+            )
         lines.append(
             f"desync: comm={div['comm']} first divergent seq={div['seq']} "
-            f"({ops or 'missing on ' + str(div['ranks_missing_seq'])})"
+            f"({detail or 'missing on ' + str(div['ranks_missing_seq'])})"
         )
     st = report["stragglers"]
     if st.get("significant"):

@@ -48,11 +48,11 @@ STATUS_COMPLETED = "completed"
 STATUS_FAILED = "failed"
 
 # entry slot layout (a list, mutated in place on completion)
-_SEQ, _COMM, _OP, _PAYLOAD, _WIRE, _BACKEND, _ROUTING = range(7)
-_T_ISSUE, _T_COMPLETE, _STATUS = 7, 8, 9
+_SEQ, _COMM, _OP, _PAYLOAD, _WIRE, _BACKEND, _ROUTING, _PLAN = range(8)
+_T_ISSUE, _T_COMPLETE, _STATUS = 8, 9, 10
 
 ENTRY_KEYS = (
-    "seq", "comm", "op", "payload", "wire", "backend", "routing",
+    "seq", "comm", "op", "payload", "wire", "backend", "routing", "plan",
     "t_issue", "t_complete", "status",
 )
 
@@ -103,17 +103,20 @@ class FlightRecorder:
     # ------------------------------------------------------------------
     def record(self, comm: str, op: str, payload=None, wire: str = "",
                backend: str = "", routing: str = "",
-               seq: Optional[int] = None) -> list:
+               seq: Optional[int] = None, plan: str = "") -> list:
         """Append one ``issued`` entry; returns the (mutable) entry.
         ``seq=None`` draws the next per-``comm`` sequence number;
         an explicit seq (the PS transport's wire seq) advances the
-        high-water mark to match."""
+        high-water mark to match. ``plan`` is the schedule compiler's
+        stable plan_id — the analyzer diffs it alongside (op, payload),
+        so a cross-rank divergence can name the diverging *schedule*
+        (hierarchical sub-structure included), not just the op."""
         t = time.time()
         with self._lock:
             if seq is None:
                 seq = self._seqs.get(comm, -1) + 1
             self._seqs[comm] = seq
-            entry = [seq, comm, op, payload, wire, backend, routing,
+            entry = [seq, comm, op, payload, wire, backend, routing, plan,
                      t, None, STATUS_ISSUED]
             if len(self._buf) == self._buf.maxlen:
                 self.dropped += 1

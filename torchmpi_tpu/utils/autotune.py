@@ -20,6 +20,10 @@ measurement on the *actual* communicator —
 - :func:`tune_wire_dtype`: full vs bf16 vs int8 on-wire encoding for the
   bandwidth-path reductions (EQuARX-style block quantization) — measures
   whether compression wins on THIS fabric and persists the answer.
+- :func:`tune_plan`: measured candidate-plan search for the schedule
+  compiler — every structurally possible schedule family is run on the
+  live topology and the winner persists as a plan override per
+  plan-cache key, overriding the analytic cost model's pick.
 
 :func:`tune_all` runs everything; results persist per
 ``(platform, world size)`` in a JSON cache
@@ -364,6 +368,98 @@ def tune_wire_dtype(
     return best[1], results
 
 
+def tune_plan(
+    comm: Optional[Communicator] = None,
+    op: str = "allreduce",
+    nelem: int = 1 << 20,
+    warmup: int = 2,
+    timed: int = 4,
+    apply: bool = True,
+) -> Tuple[str, List]:
+    """Measured candidate-plan search: run every *structurally possible*
+    schedule family (flat / hier / staged / tree) the compiler generates
+    for a large ``op`` on THIS communicator's declared topology, and
+    persist the winner as a plan override for its plan-cache key.
+
+    This is the autotuner's schedule-compiler face: where the other
+    tuners twiddle threshold constants, this one overrides the analytic
+    cost model's *choice* with a measurement — ``set_plan_override``
+    keyed exactly like the plan cache (op, topology fingerprint,
+    payload bucket, wire), persisted in the tuning cache and re-applied
+    by ``start()`` like ``tune_wire_dtype``'s answer. The analytic
+    model still orders candidates everywhere a measurement has not
+    spoken."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    comm = _comm(comm)
+    from ..collectives import eager
+    from ..collectives.selector import backend_availability
+    from ..schedule import compiler as _sched
+    from ..schedule import generators as _gen
+    from ..schedule.topology import Topology
+
+    backend = (
+        "pallas"
+        if (
+            backend_availability().get("pallas")
+            and constants.get("ring_implementation")
+            in ("pallas", "pallas_bidir")
+        )
+        else "ring"
+    )
+    topo = Topology.from_communicator(comm)
+    wire = eager.resolve_wire_dtype(op, nelem, jnp.float32, None)
+    okey = _sched.override_key(
+        op, topo.fingerprint(), _sched.payload_bucket(nelem * 4), wire
+    )
+    cands = _gen.candidate_plans(
+        op, nelem, 4, topo, backend, wire=wire, route_small=True
+    )
+    p = comm.size
+    x = jnp.ones((p, nelem), jnp.float32)
+    jax.block_until_ready(x)
+    results: List = []
+    best = (float("inf"), None)
+    measured = set()
+    for cand in cands:
+        if not cand.structural:
+            continue
+        gen = cand.plan.generator
+        if gen in measured:
+            continue  # xla + custom flat candidates share one generator
+        measured.add(gen)
+        try:
+            ep = _sched.compile_collective(
+                op, (p, nelem), jnp.float32, comm,
+                generator=gen, impl=backend, wire_override=wire,
+            )
+            laps = []
+            for it in range(warmup + timed):
+                t0 = _time.perf_counter()
+                out = jax.block_until_ready(ep.execute(x))
+                if it >= warmup:
+                    laps.append(_time.perf_counter() - t0)
+            import numpy as _np
+
+            if not _np.allclose(_np.asarray(out), float(p), rtol=1e-4):
+                results.append((gen, None, "incorrect"))
+                continue
+            mean_us = 1e6 * sum(laps) / max(1, len(laps))
+            results.append((gen, mean_us))
+            if mean_us < best[0]:
+                best = (mean_us, gen)
+        except Exception as exc:  # family unrunnable here: skip, keep going
+            results.append((gen, None, f"{type(exc).__name__}"))
+    winner = best[1] or "flat"
+    if apply:
+        _sched.set_plan_override(okey, winner)
+    _audit_decision(f"plan:{okey}", winner, apply, results)
+    return winner, results
+
+
 def tune_fusion_threshold(
     comm: Optional[Communicator] = None,
     leaf_sizes: Optional[Tuple[int, ...]] = None,
@@ -516,6 +612,9 @@ def tune_all(
         comm, nelem=big, apply=apply
     )[0]
     out["wire_dtype"] = tune_wire_dtype(comm, nelem=big, apply=apply)[0]
+    out["plan"] = tune_plan(
+        comm, nelem=big, timed=3 if quick else 5, apply=apply
+    )[0]
     out["fusion_buffer_bytes"] = tune_fusion_threshold(
         comm, timed=3 if quick else 5, apply=apply
     )[0]
@@ -560,6 +659,13 @@ def save_tuning(comm: Optional[Communicator] = None) -> Path:
     suffix = _suffix(comm)
     names = [t.format(s=suffix) for t in _TUNABLE]
     entry = {n: constants.get(n) for n in names}
+    from ..schedule import compiler as _sched
+
+    overrides = _sched.plan_overrides()
+    if overrides:
+        # measured plan winners (tune_plan) persist alongside the tuned
+        # constants and ride the same load path back in at start()
+        entry["plan_overrides"] = overrides
     path.parent.mkdir(parents=True, exist_ok=True)
     data = {}
     if path.exists():
@@ -601,6 +707,13 @@ def load_tuning(
                     applied[name] = value
                 except Exception:
                     pass  # type drift in an old cache: keep the default
+        overrides = entry.get("plan_overrides")
+        if isinstance(overrides, dict):
+            from ..schedule import compiler as _sched
+
+            applied_plans = _sched.apply_plan_overrides(overrides)
+            if applied_plans:
+                applied["plan_overrides"] = applied_plans
         telemetry.audit(
             "autotune_load", key=_cache_key(comm), applied=applied
         )
