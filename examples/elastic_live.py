@@ -1,0 +1,119 @@
+"""Live-elastic training worker: survive rank death and world resize
+WITHOUT relaunching anyone.
+
+Run under the elastic launcher:
+
+    python -m torchmpi_tpu.launch --nproc 2 --elastic \
+        examples/elastic_live.py -- --steps 20 --grow-at-step 6 \
+        --shrink-at-step 12
+
+Each worker is an :class:`~torchmpi_tpu.reshard.elastic.ElasticMember`
+training a deterministic least-squares problem with the host-zero1
+elastic trainer (params replicated, momentum sharded + ring-replicated).
+On a membership change — an injected death (``--die-at-step`` /
+``--die-rank``), an operator ``grow`` (a fresh worker joins the running
+job and receives the state), or a ``shrink`` (the newest member is
+evicted) — survivors pass the resize barrier, redistribute the sharded
+state through the reshard plan, and the loss curve CONTINUES: no
+relaunch, no checkpoint restore. Compare ``examples/elastic_training.py``,
+the old ``--max-restarts`` cold-restart model this supersedes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from torchmpi_tpu.reshard import elastic  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--dim", type=int, default=257)
+    ap.add_argument("--samples", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--initial-world", type=int, default=2,
+                    help="wait for this many members before training")
+    ap.add_argument("--die-at-step", type=int, default=-1,
+                    help="this worker hard-dies (os._exit) at this step")
+    ap.add_argument("--die-rank", type=int, default=-1,
+                    help="only the worker launched with this elastic "
+                    "rank dies (TORCHMPI_TPU_ELASTIC_RANK)")
+    ap.add_argument("--grow-at-step", type=int, default=-1,
+                    help="launch rank 0 requests an operator grow here")
+    ap.add_argument("--shrink-at-step", type=int, default=-1,
+                    help="launch rank 0 requests an operator shrink here")
+    args = ap.parse_args()
+
+    my_launch_rank = int(os.environ.get("TORCHMPI_TPU_ELASTIC_RANK", "0"))
+    rs = np.random.RandomState(7)
+    data = rs.randn(args.samples, args.dim).astype(np.float32)
+
+    state = elastic.ElasticState()
+    member = elastic.from_env(state)
+    trainer = elastic.ElasticZero1(
+        member, np.zeros(args.dim, np.float32),
+        lr=args.lr, momentum=args.momentum,
+    )
+    # joiners (operator grow) must NOT wait for the initial world — they
+    # attach to whatever membership exists and receive the live state
+    if "TORCHMPI_TPU_ELASTIC_JOINER" not in os.environ:
+        member.wait_world(args.initial_world)
+
+    def grad_fn(params, rank, world):
+        # rank-strided data sharding: summed over members (and divided
+        # by world in the trainer) this IS the full-batch gradient, for
+        # every world size — so the trajectory survives resizes exactly
+        mine = data[rank::world]
+        diff = params[None, :] - mine
+        loss = float(((data - params[None, :]) ** 2).mean())
+        grad = world * 2.0 * diff.sum(axis=0) / data.shape[0]
+        return loss, grad
+
+    done = False
+    try:
+        while trainer.step_idx < args.steps:
+            step = trainer.step_idx
+            if step == args.die_at_step and my_launch_rank == args.die_rank:
+                print(f"[elastic {my_launch_rank}] dying at step {step}",
+                      flush=True)
+                os._exit(1)  # hard death: no goodbye to anyone
+            if my_launch_rank == 0 and step == args.grow_at_step:
+                elastic.operator_request(member.coord, "grow")
+                member.wait_world(len(member._view.members) + 1)
+            if my_launch_rank == 0 and step == args.shrink_at_step:
+                before = len(member._view.members)
+                elastic.operator_request(member.coord, "shrink")
+                # hold this rank until the eviction epoch lands, so the
+                # resize happens mid-run (peers block on our collective
+                # and pick the epoch up through the barrier)
+                import time as _time
+
+                while len(member._fetch_view().members) >= before:
+                    _time.sleep(0.02)
+            loss = trainer.step(grad_fn)
+            print(f"[elastic {my_launch_rank}] step {trainer.step_idx - 1} "
+                  f"world={len(member._view.members)} "
+                  f"loss={loss:.6f}", flush=True)
+        done = True
+        print(f"[elastic {my_launch_rank}] done steps={trainer.step_idx} "
+              f"final_loss={loss:.6f}", flush=True)
+    except elastic.Evicted:
+        print(f"[elastic {my_launch_rank}] evicted at step "
+              f"{trainer.step_idx} (operator shrink) — exiting cleanly",
+              flush=True)
+        member.close()
+        return 0
+    member.leave()
+    return 0 if done else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,12 @@ run_perf_smoke() {
     # `desync: none` analyzer report.
     echo "=== telemetry smoke (2-proc flight recorder + analyzer) ==="
     python scripts/telemetry_smoke.py
+    # resize smoke: a 2-proc live-elastic run must survive an operator
+    # grow (2->3) and shrink (3->2) through the launcher without any
+    # relaunch, with `desync: none` and every live rank inside every
+    # resize.* epoch barrier per telemetry.analyze.
+    echo "=== resize smoke (2-proc live-elastic grow/shrink) ==="
+    python scripts/elastic_smoke.py
 }
 
 run_slow_a() {

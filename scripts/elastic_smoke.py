@@ -1,0 +1,72 @@
+"""Resize smoke for `scripts/ci.sh fast`: a 2-proc live-elastic run
+grows to 3, shrinks back to 2, and finishes — no relaunch, no restore —
+then the telemetry analyzer must report `desync: none` and every live
+rank inside every resize barrier.
+
+Exit 0 on success; nonzero (with the evidence printed) otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    tel = Path(tempfile.mkdtemp(prefix="tm-elastic-smoke-")) / "tel"
+    run = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--elastic",
+            "--telemetry-dir", str(tel),
+            "--set-constant", "elastic_heartbeat_seconds=0.1",
+            str(REPO / "examples" / "elastic_live.py"), "--",
+            "--steps", "12", "--grow-at-step", "4", "--shrink-at-step", "8",
+        ],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    if run.returncode != 0:
+        print(run.stdout[-4000:])
+        print(f"elastic smoke: launcher failed rc={run.returncode}")
+        return 1
+    for marker in ("world=3", "world=2", "evicted", "done steps=12"):
+        if marker not in run.stdout:
+            print(run.stdout[-4000:])
+            print(f"elastic smoke: expected {marker!r} in the run output")
+            return 1
+    analyze = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.telemetry.analyze",
+         str(tel), "--strict"],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    print(analyze.stdout.strip())
+    if analyze.returncode != 0:
+        print(f"elastic smoke: analyzer strict rc={analyze.returncode}")
+        return 1
+    if "desync: none" not in analyze.stdout:
+        print("elastic smoke: analyzer did not report `desync: none`")
+        return 1
+    report = json.loads((tel / "analysis.json").read_text())
+    rz = report.get("resize", {})
+    if rz.get("status") != "ok" or not rz.get("epochs"):
+        print(f"elastic smoke: resize report not clean: {rz}")
+        return 1
+    if any(info["never_entered"] for info in rz["epochs"].values()):
+        print(f"elastic smoke: a rank missed a resize barrier: {rz}")
+        return 1
+    print(
+        f"elastic smoke OK: {len(rz['epochs'])} resize epoch(s), "
+        "grow 2->3 and shrink 3->2 survived live, desync: none"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

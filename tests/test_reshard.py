@@ -1,0 +1,1041 @@
+"""Live elastic resharding (ISSUE 10): planner minimality + bounded
+memory, checkpoint reshaping + atomic saves, in-place engine resize,
+elastic membership fault injection, analyzer resize diagnosis, TPL007.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+_REPO = Path(__file__).resolve().parent.parent
+
+from torchmpi_tpu import constants  # noqa: E402
+from torchmpi_tpu.reshard import (  # noqa: E402
+    Layout,
+    Redistributor,
+    build_plan,
+    chunk_transfers,
+    compile_reshard,
+    plan_transfers,
+    redistribute_arrays,
+    wire_elements,
+)
+
+
+# ---------------------------------------------------------------------------
+# planner / core
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,src,dst", [
+    (100, 1, 4), (100, 4, 1), (37, 2, 3), (37, 3, 2), (64, 4, 8),
+    (13, 5, 2), (8, 8, 3),
+])
+def test_plan_transfers_minimal_and_complete(n, src, dst):
+    """Every target element is received exactly once from a rank that
+    holds it; owner-stable elements never touch a wire."""
+    sl, dl = Layout(src), Layout(dst)
+    transfers = plan_transfers(n, sl, dl)
+    covered = np.zeros(n, bool)
+    for t in transfers:
+        ss, se = sl.interval(n, t.src)
+        ds, de = dl.interval(n, t.dst)
+        span = np.arange(t.global_start, t.global_start + t.n)
+        assert (span >= ss).all() and (span < se).all(), "source holds it"
+        assert (span >= ds).all() and (span < de).all(), "target wants it"
+        assert not covered[span].any(), "element received twice"
+        covered[span] = True
+    assert covered.all(), "every target element received"
+    # minimality: wire elements == elements whose owning rank changed
+    stable = 0
+    for r in range(min(src, dst)):
+        ss, se = sl.interval(n, r)
+        ds, de = dl.interval(n, r)
+        stable += max(0, min(se, de) - max(ss, ds))
+    assert wire_elements(transfers) == n - stable
+
+
+def test_plan_replicated_source_spreads_and_target_fans_out():
+    n = 24
+    # replicated source: co-located rank serves when it exists
+    ts = plan_transfers(n, Layout(2, "replicated"), Layout(4))
+    assert all(t.src == t.dst or t.dst >= 2 for t in ts)
+    assert {t.dst for t in ts} == {0, 1, 2, 3}
+    # replicated target: every rank receives the full array
+    tr = plan_transfers(n, Layout(3), Layout(2, "replicated"))
+    got = {d: sum(t.n for t in tr if t.dst == d) for d in range(2)}
+    assert got == {0: n, 1: n}
+
+
+def test_chunk_transfers_bound_piece_size():
+    ts = plan_transfers(1000, Layout(1), Layout(3))
+    pieces = list(chunk_transfers(ts, 64))
+    assert max(p.n for p in pieces) <= 64
+    assert sum(p.n for p in pieces) == sum(t.n for t in ts)
+
+
+@pytest.mark.parametrize("src,dst", [(1, 4), (4, 1), (2, 3), (3, 2), (4, 8)])
+def test_redistribute_bitwise_matches_fresh_scatter(src, dst):
+    """THE core contract: redistribution lands bitwise-identical to a
+    fresh dst-way scatter of the assembled array, through a scratch
+    bounded under 2x the largest single shard."""
+    n = 1003  # odd: remainder shards on both sides
+    full = np.random.RandomState(0).randn(n).astype(np.float32)
+    sl, dl = Layout(src), Layout(dst)
+    shards = {r: full[s:e].copy() for r, (s, e) in enumerate(sl.intervals(n))}
+    prev = constants.get("reshard_chunk_bytes")
+    constants.set("reshard_chunk_bytes", 256)  # force many chunks
+    try:
+        out, rd = redistribute_arrays(shards, n, sl, dl)
+    finally:
+        constants.set("reshard_chunk_bytes", prev)
+    for r, (s, e) in enumerate(dl.intervals(n)):
+        np.testing.assert_array_equal(out[r], full[s:e])
+    largest = max(
+        (e - s) * 4
+        for lay in (sl, dl) for s, e in lay.intervals(n)
+    )
+    assert 0 < rd.peak_scratch_bytes < 2 * largest
+    assert rd.peak_scratch_bytes <= 256  # the chunk knob bound
+
+
+def test_compile_reshard_cache_keys_on_generation():
+    a = compile_reshard(64, 4, Layout(2), Layout(4))
+    b = compile_reshard(64, 4, Layout(2), Layout(4))
+    assert a is b, "same request, same generation: cached"
+    constants.set("resize_epoch", constants.get("resize_epoch") + 1)
+    c = compile_reshard(64, 4, Layout(2), Layout(4))
+    assert c is not a, "generation bump invalidates the compiled plan"
+
+
+def test_build_plan_is_schedule_ir():
+    from torchmpi_tpu.reshard import estimate_us
+
+    plan = build_plan(1 << 16, 4, Layout(4), Layout(2))
+    assert plan.op == "reshard" and plan.steps
+    assert estimate_us(plan) > 0
+    assert plan.plan_id == build_plan(1 << 16, 4, Layout(4), Layout(2)).plan_id
+    meta = dict(plan.meta)
+    assert meta["n"] == 1 << 16 and meta["chunks"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: portable sharded format + atomicity + mismatch naming
+# ---------------------------------------------------------------------------
+
+
+def _quad_engine(param_sharding, devices=None, width=8):
+    import jax
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.engine import AllReduceSGDEngine
+    from torchmpi_tpu.runtime.communicator import Communicator
+
+    if not mpi.runtime_state.started():
+        mpi.start()
+    devs = list(devices if devices is not None else jax.devices()[:4])
+    rs = np.random.RandomState(0)
+    params = {
+        "w": rs.randn(width, 4).astype(np.float32),
+        "b": np.zeros(4, np.float32),
+    }
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return (((x @ p["w"] + p["b"]) - y) ** 2).mean()
+
+    return AllReduceSGDEngine(
+        loss_fn, params, optimizer=optax.sgd(0.05, momentum=0.9),
+        param_sharding=param_sharding,
+        comm=Communicator(devs, name="reshard-test"),
+    )
+
+
+def _train_data(width=8):
+    rs = np.random.RandomState(1)
+    return (
+        rs.randn(64, width).astype(np.float32),
+        rs.randn(64, 4).astype(np.float32),
+    )
+
+
+def test_sharded_checkpoint_roundtrip_and_reshape(tmp_path):
+    import jax
+
+    from torchmpi_tpu.utils import checkpoint as ck
+
+    eng = _quad_engine("zero1")
+    X, Y = _train_data()
+    eng.train_resident(X, Y, 8, max_epochs=1, shuffle=False)
+    ck.save_engine_sharded(tmp_path / "ck4", eng, step=3)
+    meta = ck.read_sharded_meta(tmp_path / "ck4")
+    assert meta["world"] == 4 and meta["sharding"] == "zero1"
+    assert meta["step"] == 3 and meta["fingerprint"]
+
+    # same-world restore: bitwise
+    eng2 = _quad_engine("zero1")
+    got = ck.restore_engine_sharded(tmp_path / "ck4", eng2)
+    assert got["step"] == 3
+    for a, b in zip(
+        jax.tree_util.tree_leaves((eng.params, eng.opt_state)),
+        jax.tree_util.tree_leaves((eng2.params, eng2.opt_state)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # offline reshape 4 -> 2 -> 4: bitwise roundtrip, bounded scratch
+    stats = ck.reshape_sharded(tmp_path / "ck4", tmp_path / "ck2", 2)
+    assert ck.read_sharded_meta(tmp_path / "ck2")["world"] == 2
+    assert stats["peak_scratch_bytes"] < 2 * max(
+        1, stats["largest_shard_bytes"]
+    )
+    ck.reshape_sharded(tmp_path / "ck2", tmp_path / "ck4b", 4)
+    d4 = ck.current_data_dir(tmp_path / "ck4")
+    d4b = ck.current_data_dir(tmp_path / "ck4b")
+    for f in sorted(d4.glob("leaf*.npy")):
+        np.testing.assert_array_equal(
+            np.load(f), np.load(d4b / f.name), err_msg=f.name
+        )
+
+    # cross-world transparent restore (2-way ckpt onto the 4-way engine)
+    eng3 = _quad_engine("zero1")
+    ck.restore_engine_sharded(tmp_path / "ck2", eng3)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(eng.opt_state),
+        jax.tree_util.tree_leaves(eng3.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_reshard_cli_reshapes_and_explains(tmp_path):
+    from torchmpi_tpu.utils import checkpoint as ck
+
+    eng = _quad_engine("fsdp")
+    ck.save_engine_sharded(tmp_path / "ck", eng, step=0)
+    out = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.reshard",
+         "--from", "4", "--to", "2", str(tmp_path / "ck"),
+         str(tmp_path / "ck2"), "--json"],
+        cwd=str(_REPO), capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    stats = json.loads(out.stdout)
+    assert stats["from"] == 4 and stats["to"] == 2
+    assert ck.read_sharded_meta(tmp_path / "ck2")["world"] == 2
+    # --from validation fails loudly on a header mismatch
+    bad = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.reshard",
+         "--from", "8", "--to", "2", str(tmp_path / "ck"),
+         str(tmp_path / "ck3")],
+        cwd=str(_REPO), capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert bad.returncode == 2 and "4-way world" in bad.stderr
+    # --explain prints the compiled plan, writes nothing
+    ex = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.reshard",
+         "--to", "2", "--explain", str(tmp_path / "ck")],
+        cwd=str(_REPO), capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert ex.returncode == 0 and "op=reshard" in ex.stdout
+
+
+def test_sharded_save_is_atomic_against_kill(tmp_path):
+    """A save killed at ANY point leaves the previous checkpoint
+    readable: the payload lands in a temp dir and only the CURRENT
+    pointer swing publishes it."""
+    from torchmpi_tpu.utils import checkpoint as ck
+
+    eng = _quad_engine("zero1")
+    ck.save_engine_sharded(tmp_path / "ck", eng, step=1)
+    before = ck.read_sharded_meta(tmp_path / "ck")
+
+    # simulate a save killed mid-write: a half-written temp dir exists,
+    # CURRENT untouched
+    tmp_dir = tmp_path / "ck" / ".tmp-deadbeef"
+    tmp_dir.mkdir()
+    (tmp_dir / "leaf0.rank0.npy").write_bytes(b"torn")
+    after = ck.read_sharded_meta(tmp_path / "ck")
+    assert after == before, "killed save must not be visible"
+    eng2 = _quad_engine("zero1")
+    ck.restore_engine_sharded(tmp_path / "ck", eng2)  # still loads
+
+    # the next successful save garbage-collects the orphan + old payload
+    old_dir = ck.current_data_dir(tmp_path / "ck")
+    ck.save_engine_sharded(tmp_path / "ck", eng, step=2)
+    assert not tmp_dir.exists() and not old_dir.exists()
+    assert ck.read_sharded_meta(tmp_path / "ck")["step"] == 2
+
+
+def test_restore_mismatch_is_named_not_shape_errored(tmp_path):
+    from torchmpi_tpu.utils import checkpoint as ck
+
+    eng = _quad_engine("zero1")
+    ck.save_engine_sharded(tmp_path / "ck", eng, step=1)
+    # sharding-mode mismatch: named
+    fs = _quad_engine("fsdp")
+    with pytest.raises(ck.CheckpointMismatchError, match="param_sharding"):
+        ck.restore_engine_sharded(tmp_path / "ck", fs)
+    # structure mismatch (different model width): fingerprint named
+    wide = _quad_engine("zero1", width=12)
+    with pytest.raises(ck.CheckpointMismatchError, match="fingerprint"):
+        ck.restore_engine_sharded(tmp_path / "ck", wide)
+
+
+def test_orbax_meta_world_mismatch_points_at_reshaper(tmp_path):
+    import jax
+
+    from torchmpi_tpu.utils import checkpoint as ck
+
+    eng4 = _quad_engine("fsdp")
+    ck.save_engine(tmp_path / "ck", eng4, step=5)
+    meta = json.loads((tmp_path / "ck" / "meta.json").read_text())
+    assert meta["world"] == 4 and meta["sharding"] == "fsdp"
+    eng2 = _quad_engine("fsdp", devices=jax.devices()[:2])
+    with pytest.raises(ck.CheckpointMismatchError,
+                       match="torchmpi_tpu.reshard"):
+        ck.restore_engine(tmp_path / "ck", eng2)
+    # same-world restore still round-trips (and returns the meta)
+    eng4b = _quad_engine("fsdp")
+    got = ck.restore_engine(tmp_path / "ck", eng4b)
+    assert got["step"] == 5
+
+
+# ---------------------------------------------------------------------------
+# engine resize
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sharding", ["fsdp", "zero1"])
+def test_engine_resize_bitwise_bounded_and_continues(sharding):
+    import jax
+
+    from torchmpi_tpu.telemetry import flightrecorder as flight
+
+    eng = _quad_engine(sharding)
+    X, Y = _train_data()
+    eng.train_resident(X, Y, 8, max_epochs=1, shuffle=False)
+    gathered = jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)),
+        (eng.params, eng.opt_state),
+    )
+    epoch0 = constants.get("resize_epoch")
+    flight.enable()
+    try:
+        stats = eng.resize(jax.devices()[:2])  # shrink 4 -> 2
+    finally:
+        flight.disable()
+    assert stats["old_world"] == 4 and stats["new_world"] == 2
+    # bitwise: the resized leaves == a fresh 2-way scatter of the
+    # gathered state (scatter == the host values themselves)
+    for a, b in zip(
+        jax.tree_util.tree_leaves((eng.params, eng.opt_state)),
+        jax.tree_util.tree_leaves(gathered),
+    ):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)), b)
+    # the asserted memory bound: scratch < 2x the largest single shard
+    assert stats["peak_scratch_bytes"] < 2 * max(
+        1, stats["largest_shard_bytes"]
+    )
+    # epoch bumped -> generation advanced -> caches invalidate
+    assert constants.get("resize_epoch") == epoch0 + 1 == stats["epoch"]
+    assert not eng._aot_steps and not eng._epoch_fns
+    # resize.* flight entries with seq == epoch
+    entries = [e for e in flight.recorder.entries() if e["comm"] == "resize"]
+    assert any(
+        e["op"] == "resize.enter" and e["seq"] == stats["epoch"]
+        for e in entries
+    )
+    assert any(e["op"] == "resize.commit" for e in entries)
+    # training CONTINUES on the new world, matching an engine that was
+    # 2-way from the start fed the same post-resize state (f32 tol)
+    cont = eng.train_resident(X, Y, 8, max_epochs=1, shuffle=False)
+    import jax as _jax
+
+    fresh = _quad_engine(sharding, devices=_jax.devices()[:2])
+    fresh.params = jax.tree_util.tree_map(
+        lambda a, cur: _jax.device_put(a, cur.sharding),
+        gathered[0], fresh.params,
+    )
+    fresh.opt_state = jax.tree_util.tree_map(
+        lambda a, cur: _jax.device_put(a, cur.sharding),
+        gathered[1], fresh.opt_state,
+    )
+    ref = fresh.train_resident(X, Y, 8, max_epochs=1, shuffle=False)
+    np.testing.assert_allclose(cont["losses"], ref["losses"], rtol=1e-5)
+    # grow back 2 -> 8 and take a step: no stale-cache explosions
+    eng.resize(jax.devices())
+    eng.train_resident(X, Y, 8, max_epochs=1, shuffle=False)
+
+
+# ---------------------------------------------------------------------------
+# elastic membership: fault-injected, in-process (threads = members)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_ctx():
+    from torchmpi_tpu.reshard import elastic as E
+
+    prev_hb = constants.get("elastic_heartbeat_seconds")
+    constants.set("elastic_heartbeat_seconds", 0.05)
+    return E, prev_hb
+
+
+def test_elastic_death_shrink_bitwise_and_continues():
+    """Kill a member mid-job: the survivor resumes within the resize
+    epoch with bitwise-correct redistributed shards (== a fresh 1-way
+    scatter of the gathered state, the dead rank's half reconstructed
+    from its ring replica), and the loss curve continues."""
+    E, prev_hb = _elastic_ctx()
+    coord = E.ElasticCoordinator()
+    N = 37
+    rs = np.random.RandomState(3)
+    data = rs.randn(8, N).astype(np.float32)
+    gates = {"a": threading.Event(), "b": threading.Event()}
+    paused = {"a": threading.Event(), "b": threading.Event()}
+    results = {}
+
+    def grad_for(tag):
+        def grad_fn(params, rank, world):
+            paused[tag].set()
+            assert gates[tag].wait(60)
+            gates[tag].clear()
+            mine = data[rank::world]
+            diff = params[None, :] - mine
+            loss = float(((data - params[None, :]) ** 2).mean())
+            return loss, world * 2.0 * diff.sum(axis=0) / data.shape[0]
+        return grad_fn
+
+    def worker(tag, steps):
+        st = E.ElasticState()
+        m = E.ElasticMember(coord.address, st)
+        tr = E.ElasticZero1(m, np.zeros(N, np.float32), lr=0.1, momentum=0.9)
+        m.wait_world(2)
+        results[tag + ":member"] = m
+        losses = []
+        try:
+            while tr.step_idx < steps:
+                losses.append(tr.step(grad_for(tag)))
+            results[tag] = ("done", losses)
+            m.close()
+        except Exception as e:  # noqa: BLE001 - surfaced by asserts
+            results[tag] = ("error", losses, repr(e))
+
+    ta = threading.Thread(target=worker, args=("a", 8), daemon=True)
+    tb = threading.Thread(target=worker, args=("b", 8), daemon=True)
+    ta.start()
+    tb.start()
+    try:
+        # release 4 full steps on both members, in lockstep
+        for step in range(4):
+            for tag in ("a", "b"):
+                assert paused[tag].wait(60), (tag, step)
+                paused[tag].clear()
+            for tag in ("a", "b"):
+                gates[tag].set()
+        # both now blocked ENTERING step 4's grad (momentum is post-step
+        # 3 everywhere): snapshot the logical momentum, then kill b
+        for tag in ("a", "b"):
+            assert paused[tag].wait(60)
+        ma = results["a:member"]
+        mb = results["b:member"]
+        # rank = JOIN order, which the thread start only biases: a's
+        # shard is the half of its rank, its replica the OTHER half
+        # (== b's shard, refreshed) — concatenate in layout order
+        halves = [
+            ma.state.entries["momentum"].shard,
+            ma.state.entries["momentum"].replica,
+        ]
+        if ma._view.rank_of(ma.mid) != 0:
+            halves.reverse()
+        logical_mom = np.concatenate(halves)
+        np.testing.assert_array_equal(
+            ma.state.entries["momentum"].replica,
+            mb.state.entries["momentum"].shard,
+        )
+        mb.close()  # hard death: heartbeats stop, no goodbye
+        paused["a"].clear()
+        gates["a"].set()  # a proceeds into the torn step, retries, resizes
+        gates["b"].set()
+        for _ in range(8):  # release a's remaining steps
+            if results.get("a"):
+                break
+            if paused["a"].wait(2):
+                paused["a"].clear()
+                gates["a"].set()
+        ta.join(60)
+        assert results["a"][0] == "done", results["a"]
+        losses = results["a"][1]
+        assert len(losses) == 8 and losses[-1] < losses[0]
+        # bitwise: survivor's world-1 momentum == the exact replay of
+        # steps 0-3 at world 2 + steps 4-7 at world 1 (the dead rank's
+        # half reconstructed from the ring replica at the resize)
+        np.testing.assert_array_equal(
+            results["a:member"].state.entries["momentum"].shard,
+            _post_death_expected(logical_mom, data, N),
+        )
+        np.testing.assert_array_equal(logical_mom, _post_death_partial(data, N))
+    finally:
+        coord.close()
+        constants.set("elastic_heartbeat_seconds", prev_hb)
+
+
+def _replay_momentum(data, N, schedule):
+    """Exact f32 replay of the ElasticZero1 arithmetic (same op order,
+    including the reduce-scatter's own-slice-first accumulation) under
+    a ``[(world, nsteps), ...]`` schedule."""
+    params = np.zeros(N, np.float32)
+    mom = np.zeros(N, np.float32)
+    lr, mu = 0.1, 0.9
+    for world, nsteps in schedule:
+        for _ in range(nsteps):
+            partials = []
+            for rank in range(world):
+                mine = data[rank::world]
+                diff = params[None, :] - mine
+                partials.append(
+                    np.asarray(
+                        world * 2.0 * diff.sum(axis=0) / data.shape[0],
+                        np.float32,
+                    )
+                )
+            lay = Layout(world)
+            gs = np.empty(N, np.float32)
+            for rank in range(world):
+                s, e = lay.interval(N, rank)
+                acc = partials[rank][s:e].copy()
+                for other in range(world):
+                    if other != rank:
+                        acc += partials[other][s:e]
+                gs[s:e] = acc
+            mom = mu * mom + gs / world
+            params = params - lr * mom
+    return mom.astype(np.float32)
+
+
+def _post_death_expected(logical_mom, data, N):
+    """Steps 0-3 ran at world 2, the death redistributes (ring replica
+    covering the lost half), steps 4-7 run at world 1."""
+    return _replay_momentum(data, N, [(2, 4), (1, 4)])
+
+
+def _post_death_partial(data, N):
+    return _replay_momentum(data, N, [(2, 4)])
+
+
+def test_elastic_torn_step_reconciles_missed_apply():
+    """The missed-apply dual of the no-double-apply rule: member C's
+    death drops exactly its allgather frame to A at step 3, so the
+    anchor H commits step 3 while A aborts it mid-allgather. The resize
+    agreement (agreed step = 4 = A's + 1) must make A commit its STAGED
+    step-3 momentum before redistribution — otherwise A's shard (and
+    everything redistributed from it) permanently misses one update.
+
+    Arithmetic is integer-exact (dyadic lr/mu, integer gradients), so
+    the final momentum is bitwise-comparable to a replay regardless of
+    reduce-scatter arrival order at world 3."""
+    E, prev_hb = _elastic_ctx()
+    coord = E.ElasticCoordinator()
+    N = 23
+    v = np.arange(1, N + 1, dtype=np.float32)
+    STEPS = 7
+    tags = ("h", "a", "c")
+    gates = {t: threading.Event() for t in tags}
+    paused = {t: threading.Event() for t in tags}
+    results = {}
+
+    def worker(tag):
+        st = E.ElasticState()
+        m = E.ElasticMember(coord.address, st)
+        tr = E.ElasticZero1(m, np.zeros(N, np.float32),
+                            lr=0.25, momentum=0.5)
+        results[tag + ":member"] = m
+        results[tag + ":trainer"] = tr
+
+        def grad_fn(params, rank, world):
+            paused[tag].set()
+            assert gates[tag].wait(60)
+            gates[tag].clear()
+            # integer gradient, world-independent logical sum is NOT
+            # needed — the replay mirrors the same (step, rank) formula
+            g = (tr.step_idx + 1) * (rank + 1) * v
+            return 0.0, g
+
+        m.wait_world(3)
+        try:
+            while tr.step_idx < STEPS:
+                tr.step(grad_fn)
+            results[tag] = "done"
+        except Exception as e:  # noqa: BLE001 - dead member's exit path
+            results[tag] = f"out:{type(e).__name__}"
+
+    threads = []
+    try:
+        # sequential joins pin mids/ranks: h=0, a=1, c=2
+        for tag in tags:
+            t = threading.Thread(target=worker, args=(tag,), daemon=True)
+            t.start()
+            threads.append(t)
+            deadline = time.monotonic() + 30
+            while len(coord.members()) < len(threads):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+        # steps 0-2 in lockstep
+        for step in range(3):
+            for tag in tags:
+                assert paused[tag].wait(60), (tag, step)
+                paused[tag].clear()
+            for tag in tags:
+                gates[tag].set()
+        for tag in tags:
+            assert paused[tag].wait(60), tag
+            paused[tag].clear()
+        mh = results["h:member"]
+        ma = results["a:member"]
+        mc = results["c:member"]
+        assert [mh.mid, ma.mid, mc.mid] == [0, 1, 2]
+        # C "dies mid-broadcast" at step 3: its allgather frame to A is
+        # lost, everything else (incl. its replica exchange to H, its
+        # ring successor) lands — H can commit step 3, A cannot
+        orig_send = mc._send
+
+        def send_drop(mid, kind, epoch, aid, tag_, off, payload):
+            if kind == E.K_AG and mid == ma.mid and tag_ == 3:
+                return
+            orig_send(mid, kind, epoch, aid, tag_, off, payload)
+
+        mc._send = send_drop
+        for tag in tags:
+            gates[tag].set()
+        # H commits step 3 and pauses entering step 4; A is stuck in
+        # step 3's allgather; C is stuck in its replica exchange
+        assert paused["h"].wait(60)
+        paused["h"].clear()
+        assert results["a:trainer"].step_idx == 3
+        mc.close()  # now C actually dies: heartbeats stop
+        gates["h"].set()
+        for _ in range(16):
+            if results.get("h") and results.get("a"):
+                break
+            for tag in ("h", "a"):
+                if paused[tag].wait(1):
+                    paused[tag].clear()
+                    gates[tag].set()
+        for t in threads[:2]:
+            t.join(60)
+        assert results.get("h") == "done" and results.get("a") == "done", (
+            results.get("h"), results.get("a")
+        )
+        th, ta = results["h:trainer"], results["a:trainer"]
+        assert th.step_idx == STEPS and ta.step_idx == STEPS
+        # exact replay: steps 0-3 at world 3 (step 3 reconciled through
+        # A's stash + H's commit + H's replica of C), steps 4-6 at
+        # world 2 — integer-exact, so bitwise
+        mom = np.zeros(N, np.float32)
+        for step, world in [(s, 3) for s in range(4)] + [
+            (s, 2) for s in range(4, STEPS)
+        ]:
+            gsum = sum(
+                (step + 1) * (r + 1) * v for r in range(world)
+            ).astype(np.float32)
+            mom = (np.float32(0.5) * mom + gsum / world).astype(np.float32)
+        lay = Layout(2)
+        s0, e0 = lay.interval(N, 0)
+        logical = np.concatenate([
+            results["h:member"].state.entries["momentum"].shard,
+            results["a:member"].state.entries["momentum"].shard,
+        ])
+        assert results["h:member"].state.entries["momentum"].shard.shape[0] \
+            == e0 - s0
+        np.testing.assert_array_equal(logical, mom)
+        # the re-formed ring replicas mirror the new shards
+        np.testing.assert_array_equal(
+            results["h:member"].state.entries["momentum"].replica,
+            results["a:member"].state.entries["momentum"].shard,
+        )
+    finally:
+        coord.close()
+        constants.set("elastic_heartbeat_seconds", prev_hb)
+
+
+def test_elastic_grow_transfers_state_bitwise():
+    """An operator grow admits a fresh member into the RUNNING job: it
+    receives the replicated params and the momentum re-scatters so that
+    reassembling the new shards reproduces the old logical state
+    bitwise."""
+    E, prev_hb = _elastic_ctx()
+
+    spawned = []
+
+    def on_grow():
+        t = threading.Thread(target=worker, args=("c", 10, True),
+                             daemon=True)
+        spawned.append(t)
+        t.start()
+
+    coord = E.ElasticCoordinator(on_grow=on_grow)
+    N = 41
+    rs = np.random.RandomState(5)
+    data = rs.randn(6, N).astype(np.float32)
+    results = {}
+    grow_fired = threading.Event()
+    snapshot = {}
+
+    def grad_fn(params, rank, world):
+        mine = data[rank::world]
+        diff = params[None, :] - mine
+        loss = float(((data - params[None, :]) ** 2).mean())
+        return loss, world * 2.0 * diff.sum(axis=0) / data.shape[0]
+
+    def worker(tag, steps, joiner=False):
+        st = E.ElasticState()
+        m = E.ElasticMember(coord.address, st)
+        tr = E.ElasticZero1(m, np.zeros(N, np.float32), lr=0.1, momentum=0.9)
+        if not joiner:
+            m.wait_world(2)
+        results[tag + ":member"] = m
+        losses = []
+        try:
+            while tr.step_idx < steps:
+                if (
+                    tag == "a" and tr.step_idx == 5
+                    and not grow_fired.is_set()
+                ):
+                    grow_fired.set()
+                    # freeze the logical momentum pre-grow (replica is
+                    # bitwise-fresh after step 4's refresh)
+                    snapshot["mom"] = np.concatenate([
+                        m.state.entries["momentum"].shard,
+                        m.state.entries["momentum"].replica,
+                    ])
+                    snapshot["params"] = m.state.entries[
+                        "params"
+                    ].full.copy()
+                    E.operator_request(coord.address, "grow")
+                    m.wait_world(3)
+                losses.append(tr.step(grad_fn))
+            results[tag] = ("done", losses, tr.params.copy())
+            m.leave()
+        except Exception as e:  # noqa: BLE001
+            results[tag] = ("error", losses, repr(e))
+
+    threads = [
+        threading.Thread(target=worker, args=("a", 10), daemon=True),
+        threading.Thread(target=worker, args=("b", 10), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            done = [k for k in ("a", "b", "c") if k in results]
+            if len(done) == 3:
+                break
+            time.sleep(0.1)
+        for tag in ("a", "b", "c"):
+            assert results.get(tag, ("missing",))[0] == "done", (
+                tag, results.get(tag)
+            )
+        # all members ended with identical params
+        np.testing.assert_array_equal(results["a"][2], results["b"][2])
+        np.testing.assert_array_equal(results["a"][2], results["c"][2])
+        # the joiner's FIRST resize redistributed the snapshot exactly:
+        # its agreed step was 5, so replaying from the snapshot at
+        # world 3 must land every member on the same trajectory — the
+        # identity of the three final params vectors above is that
+        # evidence; additionally the grow resize stats show a real
+        # transfer with bounded chunks
+        mc = results["c:member"]
+        st = mc.last_resize_stats
+        assert st["cold"] is False and st["new_world"] == 3
+        assert st["wire_bytes"] > 0
+        assert st["peak_chunk_bytes"] <= constants.get(
+            "reshard_chunk_bytes"
+        )
+    finally:
+        coord.close()
+        constants.set("elastic_heartbeat_seconds", prev_hb)
+
+
+def test_elastic_operator_shrink_evicts_cleanly():
+    E, prev_hb = _elastic_ctx()
+    coord = E.ElasticCoordinator()
+    results = {}
+
+    def grad_fn(params, rank, world):
+        return float((params ** 2).sum()), 2 * params
+
+    def worker(tag, steps):
+        st = E.ElasticState()
+        m = E.ElasticMember(coord.address, st)
+        tr = E.ElasticZero1(m, np.zeros(9, np.float32), lr=0.05)
+        m.wait_world(2)
+        try:
+            while tr.step_idx < steps:
+                if tag == "a" and tr.step_idx == 3:
+                    E.operator_request(coord.address, "shrink")
+                    while len(m._fetch_view().members) >= 2:
+                        time.sleep(0.02)
+                tr.step(grad_fn)
+            results[tag] = "done"
+            m.leave()
+        except E.Evicted:
+            results[tag] = "evicted"
+            m.close()
+
+    threads = [
+        threading.Thread(target=worker, args=("a", 8), daemon=True),
+        threading.Thread(target=worker, args=("b", 8), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    try:
+        # highest member id (b joined second) is evicted; a finishes
+        assert sorted(results.values()) == ["done", "evicted"], results
+        assert results["a"] == "done"
+    finally:
+        coord.close()
+        constants.set("elastic_heartbeat_seconds", prev_hb)
+
+
+# ---------------------------------------------------------------------------
+# analyzer: resize-barrier diagnosis
+# ---------------------------------------------------------------------------
+
+
+def _fake_rank(entries):
+    return {
+        "restart": 0, "path": "x",
+        "snapshot": {"flight_recorder": {"entries": entries,
+                                         "dropped": 0,
+                                         "seq_high_water": {}}},
+        "trace_events": [],
+    }
+
+
+def test_analyzer_names_rank_that_never_entered_resize_barrier():
+    from torchmpi_tpu.telemetry.analyze import analyze_resizes
+
+    def resize_entry(epoch, t):
+        return {"comm": "resize", "op": "resize.enter", "seq": epoch,
+                "payload": "2->3", "t_issue": t, "t_complete": t + 0.1,
+                "status": "completed", "wire": "", "backend": "elastic",
+                "routing": "", "plan": ""}
+
+    def work_entry(t):
+        return {"comm": "global[2]", "op": "allreduce", "seq": 0,
+                "payload": "", "t_issue": t, "t_complete": t + 0.01,
+                "status": "completed", "wire": "", "backend": "",
+                "routing": "", "plan": ""}
+
+    run = {
+        "ranks": {
+            0: _fake_rank([work_entry(1.0), resize_entry(7, 10.0)]),
+            1: _fake_rank([work_entry(1.0), resize_entry(7, 10.2)]),
+            # rank 2 was alive before AND after epoch 7 but never
+            # entered its barrier: the stuck rank the rule must name
+            2: _fake_rank([work_entry(1.0), work_entry(20.0)]),
+            # rank 3 only EXISTS after the epoch (a joiner): not named
+            3: _fake_rank([work_entry(30.0)]),
+        },
+        "hangs": [], "heartbeats": {},
+    }
+    rz = analyze_resizes(run)
+    assert rz["status"] == "incomplete"
+    assert rz["epochs"]["7"]["never_entered"] == [2]
+    assert rz["epochs"]["7"]["entered"] == [0, 1]
+
+    # all-entered run is clean
+    run["ranks"][2] = _fake_rank([work_entry(1.0), resize_entry(7, 10.1)])
+    del run["ranks"][3]
+    rz = analyze_resizes(run)
+    assert rz["status"] == "ok"
+    assert rz["epochs"]["7"]["never_entered"] == []
+
+
+# ---------------------------------------------------------------------------
+# tpu-lint TPL007
+# ---------------------------------------------------------------------------
+
+
+def _lint(tmp_path, source):
+    from torchmpi_tpu.analysis import epoch as epoch_mod
+    from torchmpi_tpu.analysis.core import load_source
+
+    f = tmp_path / "mod.py"
+    f.write_text(source)
+    sf = load_source(f, root=tmp_path)
+    return epoch_mod.check_file(sf)
+
+
+def test_tpl007_flags_world_keyed_cache_without_generation(tmp_path):
+    findings = _lint(tmp_path, (
+        "_plan_cache = {}\n"
+        "def lookup(comm, nelem):\n"
+        "    key = (comm.size, nelem)\n"
+        "    return _plan_cache.get(key)\n"
+    ))
+    assert [f.rule for f in findings] == ["TPL007"]
+    assert "generation" in findings[0].message or "generation" in (
+        findings[0].hint or ""
+    )
+
+
+def test_tpl007_clean_with_generation_or_epoch_in_key(tmp_path):
+    assert _lint(tmp_path, (
+        "from torchmpi_tpu import constants\n"
+        "_plan_cache = {}\n"
+        "def lookup(comm, nelem):\n"
+        "    key = (comm.size, nelem, constants.generation())\n"
+        "    return _plan_cache.get(key)\n"
+    )) == []
+    assert _lint(tmp_path, (
+        "from torchmpi_tpu import constants\n"
+        "_memo = {}\n"
+        "def lookup(world, nelem):\n"
+        "    _memo[(world, nelem, constants.get('resize_epoch'))] = 1\n"
+    )) == []
+    # non-cache-named containers and world-free keys are out of scope
+    assert _lint(tmp_path, (
+        "_registry = {}\n"
+        "def store(comm):\n"
+        "    _registry[comm.size] = comm\n"
+    )) == []
+    assert _lint(tmp_path, (
+        "_cache = {}\n"
+        "def store(nelem, dtype):\n"
+        "    _cache[(nelem, dtype)] = 1\n"
+    )) == []
+
+
+def test_tpl007_in_rule_table_and_cli():
+    from torchmpi_tpu.analysis.core import RULES
+
+    assert RULES["TPL007"][0] == "stale-world-cache"
+
+
+# ---------------------------------------------------------------------------
+# PS chain re-formation (the fabric consumer)
+# ---------------------------------------------------------------------------
+
+
+def test_ps_chain_reformation_restores_replication_exactly_once():
+    """After a head death + failover, reform() rebuilds the chain onto
+    a fresh process, streams the exactly-once state over chunked
+    copy_at updates, and the restored chain forwards like day one."""
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _Instance
+    from torchmpi_tpu.reshard.core import chunk_spans
+
+    prev_rep = constants.get("ps_replication")
+    prev_native = constants.get("use_native_runtime")
+    constants.set("ps_replication", 2)
+    constants.set("use_native_runtime", False)
+    insts, listeners, pools = {}, {}, {}
+    stop = threading.Event()
+    try:
+        full = np.zeros(8, np.float32)
+        for p in (0, 1, 2):
+            insts[p] = _Instance(9, full, 2, owners=[0, 1], my_proc=p)
+            listeners[p] = T._Listener(
+                lambda i, p=p: insts[p] if i == 9 else None
+            )
+        assert insts[0].chains == [[0, 1], [1, 0]]
+
+        def serve():
+            while not stop.is_set():
+                if not any(insts[p].serve_once() for p in insts):
+                    time.sleep(0.0005)
+
+        threading.Thread(target=serve, daemon=True).start()
+        # proc 1 applies the exactly-once history for the shards it
+        # stores (rank 0 as replica, rank 1 as head): oseq 1..10
+        for oseq in range(1, 11):
+            for r in (0, 1):
+                s, e = insts[1].ranges[r]
+                insts[1].apply_rule(
+                    r, "add", np.full(e - s, float(oseq), np.float32)
+                )
+        expected = float(sum(range(1, 11)))
+        # the head (proc 0) dies; traffic failed over to proc 1
+        listeners[0].close()
+
+        # re-formation on the live set {1, 2}: proc 2 is the fresh one
+        sends1 = insts[1].reform([1, 2])
+        sends2 = insts[2].reform([1, 2])
+        assert insts[1].owners == [1, 1] and insts[2].owners == [1, 1]
+        assert insts[1].chains == [[1, 2], [1, 2]] == insts[2].chains
+        assert insts[1].replication == 2 == insts[2].replication
+        assert insts[1].fingerprint == insts[2].fingerprint
+        assert sends2 == {} and sorted(sends1) == [0, 1]
+        # the new head streams its shards via chunked copy_at updates
+        pool = T._PeerPool({2: ("127.0.0.1", listeners[2].port)})
+        pools[2] = pool
+        for r, targets in sorted(sends1.items()):
+            shard = insts[1].read_shard(r)
+            for proc in targets:
+                for s, e in chunk_spans(shard.shape[0], 3):
+                    pool.request(
+                        proc, T._KIND_UPDATE, 9, r, 0,
+                        rule=f"copy_at:{s}", payload_arr=shard[s:e],
+                    )
+        time.sleep(0.2)
+        for r in (0, 1):
+            np.testing.assert_array_equal(
+                insts[2].read_shard(r), np.full(
+                    np.diff(insts[1].ranges[r])[0], expected, np.float32
+                )
+            )
+        # the restored chain forwards: an update applied at the new
+        # head reaches the fresh replica exactly once (oseq dedup)
+        fwd_calls = []
+
+        def forward(succ, r, msg):
+            fwd_calls.append((succ, r, msg.oseq))
+            pool.request(
+                succ, T._KIND_UPDATE, 9, r, msg.client, rule=msg.rule,
+                payload_arr=np.asarray(msg.payload), oseq=msg.oseq,
+            )
+
+        insts[1].attach_replication(forward)
+        ch = T._PeerChannel({1: ("127.0.0.1", listeners[1].port)}, 1)
+        ch.request(
+            T._KIND_UPDATE, 9, 0, 0, rule="add",
+            payload_arr=np.full(4, 100.0, np.float32), oseq=11,
+        )
+        # duplicate re-issue straight to the replica: deduped
+        ch2 = T._PeerChannel({2: ("127.0.0.1", listeners[2].port)}, 2)
+        ch2.request(
+            T._KIND_UPDATE, 9, 0, 0, rule="add",
+            payload_arr=np.full(4, 100.0, np.float32), oseq=11,
+        )
+        time.sleep(0.2)
+        np.testing.assert_array_equal(
+            insts[2].read_shard(0),
+            np.full(4, expected + 100.0, np.float32),
+        )
+        assert fwd_calls and fwd_calls[0][0] == 2
+        ch.close()
+        ch2.close()
+    finally:
+        stop.set()
+        for pool in pools.values():
+            pool.close()
+        for p, lst in listeners.items():
+            try:
+                lst.close()
+            except Exception:  # noqa: BLE001
+                pass
+        constants.set("ps_replication", prev_rep)
+        constants.set("use_native_runtime", prev_native)

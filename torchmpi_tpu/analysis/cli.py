@@ -21,7 +21,7 @@ import sys
 from pathlib import Path
 from typing import List, Optional, Sequence
 
-from . import contracts, knobs as knobs_mod, locks
+from . import contracts, epoch as epoch_mod, knobs as knobs_mod, locks
 from .core import (
     Finding,
     RULES,
@@ -62,6 +62,7 @@ def run_analysis(
         per_file[sf] = []
         per_file[sf].extend(contracts.check_file(sf))
         per_file[sf].extend(locks.check_file(sf))
+        per_file[sf].extend(epoch_mod.check_file(sf))
 
     # repo-level knob rules: keyed off a scanned constants.py that
     # defines _Constants

@@ -43,6 +43,11 @@ RULES: Dict[str, Tuple[str, str]] = {
         "literal routing kwarg (impl=/staged_intra=/ring_impl=) outside "
         "schedule/ bypasses the schedule compiler",
     ),
+    "TPL007": (
+        "stale-world-cache",
+        "cache keyed on world-size-derived state without a generation()/"
+        "resize_epoch re-read — stale across a live resize epoch",
+    ),
     "TPL101": (
         "lock-order-cycle",
         "cycle in the static lock acquisition graph",

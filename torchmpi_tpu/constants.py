@@ -217,6 +217,27 @@ class _Constants:
     plan_cost_quantize_us_per_mib: float = 8.0
     plan_cost_dispatch_us: float = 5.0
 
+    # --- live elastic resharding (reshard/ subsystem) ---
+    # Chunk size (BYTES) for redistribution transfers: the reshard
+    # executor moves state between (world size, sharding) layouts
+    # through one reusable scratch buffer of at most this many bytes,
+    # so redistribution peak memory is bounded regardless of array size
+    # (the "memory-efficient array redistribution" contract; asserted
+    # < 2x the largest single shard in tests). 0 disables chunking
+    # (one piece per transfer).
+    reshard_chunk_bytes: int = 1 << 20
+    # Monotone resize-epoch marker: bumped (via constants.set, which
+    # advances generation()) every time the world is resized — engine
+    # in-place resize, elastic membership change, PS chain re-formation.
+    # Caches keyed on world-size-derived state must embed generation()
+    # (or re-read this knob) so a resize invalidates them coherently;
+    # tpu-lint TPL007 flags caches that do not.
+    resize_epoch: int = 0
+    # Elastic membership heartbeat period, seconds: members report to
+    # the resize coordinator at this cadence, and a member silent for
+    # 5 heartbeats is declared dead (epoch bump -> survivors reshard).
+    elastic_heartbeat_seconds: float = 0.5
+
     # --- coalescing dispatch (latency path; GC3-style fused plans) ---
     # Capacity of the flat fusion buffer: pending same-(op, dtype, comm,
     # wire) async collectives pack into one contiguous buffer and flush

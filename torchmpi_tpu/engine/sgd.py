@@ -770,6 +770,270 @@ class AllReduceSGDEngine:
         self.params = jax.block_until_ready(self._bcast_fn(self.params))
 
     # ------------------------------------------------------------------
+    # live world resize: redistribute fsdp/zero1 shards in place
+    # ------------------------------------------------------------------
+    def _leaf_shard_axis(self, leaf) -> Optional[int]:
+        """The mesh-sharded axis of a live leaf (None = replicated)."""
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if not spec:
+            return None
+        for i, s in enumerate(spec):
+            if s == _AXIS:
+                return i
+        return None
+
+    def _resize_leaf(self, leaf, shard_tree: bool, new_comm, new_mesh,
+                     stats: Dict[str, Any]):
+        """Move one leaf onto the resized mesh through the reshard
+        planner. Same-axis shard moves run the minimal chunked transfer
+        schedule (owner-stable bytes never copied twice, scratch bounded
+        by ``reshard_chunk_bytes``); axis changes and replicated targets
+        assemble the full leaf (a replicated target *is* the full leaf on
+        every rank)."""
+        from .. import constants as _c
+        from ..reshard import Layout, Redistributor
+
+        p_new = new_comm.size
+        shape = tuple(np.shape(leaf))
+        dt = np.dtype(leaf.dtype)
+        replicated_new = NamedSharding(new_mesh, P())
+
+        def _new_leaf_sharding() -> NamedSharding:
+            if not shard_tree:
+                return replicated_new
+            for i, dim in enumerate(shape):
+                if dim >= p_new and dim % p_new == 0:
+                    return NamedSharding(new_mesh, P(*([None] * i), _AXIS))
+            return replicated_new
+
+        dst_sharding = _new_leaf_sharding()
+        src_ax = self._leaf_shard_axis(leaf)
+        dst_ax = None
+        for i, s in enumerate(dst_sharding.spec):
+            if s == _AXIS:
+                dst_ax = i
+        largest = max(
+            (int(np.prod(np.asarray(s.data).shape)) * dt.itemsize
+             for s in leaf.addressable_shards),
+            default=0,
+        )
+        stats["largest_shard_bytes"] = max(
+            stats["largest_shard_bytes"], largest
+        )
+
+        if src_ax is None and dst_ax is None:
+            # replicated -> replicated: same bytes, new mesh
+            return jax.device_put(np.asarray(jax.device_get(leaf)),
+                                  dst_sharding)
+        if src_ax is not None and dst_ax is not None and src_ax != dst_ax:
+            # axis migration (the divisible axis moved under the new
+            # world): no contiguous flat mapping exists — assemble once
+            stats["axis_fallbacks"] += 1
+            return jax.device_put(np.asarray(jax.device_get(leaf)),
+                                  dst_sharding)
+
+        ax = src_ax if src_ax is not None else dst_ax
+        n = int(np.prod(shape, dtype=np.int64))
+        p_old = self.comm.size
+        src_layout = Layout(p_old, "sharded" if src_ax is not None
+                            else "replicated")
+        # a replicated destination only needs ONE host assembly (jax
+        # replicates it across the mesh at device_put): a Layout(p_new,
+        # 'replicated') target would transfer the full leaf to p_new
+        # buffers of which only outs[0] is read — p_new x the memory and
+        # copy work on exactly the bounded-memory path
+        dst_layout = (Layout(p_new) if dst_ax is not None else Layout(1))
+        # moveaxis space: rank blocks along `ax` become contiguous flat
+        # intervals, and divisibility (the engine's sharding rule) makes
+        # the element-space Layout boundaries land exactly on row edges
+        moved_shape = (shape[ax],) + tuple(
+            d for i, d in enumerate(shape) if i != ax
+        )
+        if src_ax is None:
+            full = np.moveaxis(np.asarray(jax.device_get(leaf)), ax, 0)
+            flat_src = full.reshape(-1)
+
+            def read(rank, off, view):
+                # replicated source transfers carry GLOBAL offsets
+                view[:] = flat_src[off:off + view.shape[0]]
+        else:
+            blocks: Dict[int, np.ndarray] = {}
+            bs = shape[ax] // p_old
+            for s in leaf.addressable_shards:
+                r = (s.index[ax].start or 0) // bs
+                blocks[r] = np.moveaxis(
+                    np.asarray(s.data), ax, 0
+                ).reshape(-1)
+
+            def read(rank, off, view):
+                view[:] = blocks[rank][off:off + view.shape[0]]
+
+        rd = Redistributor(n, dt, src_layout, dst_layout)
+        outs = {
+            r: np.empty(max(0, e - s), dt)
+            for r, (s, e) in enumerate(dst_layout.intervals(n))
+        }
+
+        def write(rank, off, values):
+            outs[rank][off:off + values.shape[0]] = values
+
+        rd.run(read, write)
+        stats["peak_scratch_bytes"] = max(
+            stats["peak_scratch_bytes"], rd.peak_scratch_bytes
+        )
+        stats["wire_elements"] += sum(
+            t.n for t in rd.transfers if t.src != t.dst
+        )
+        stats["plans"].append(rd.plan.plan_id)
+
+        if dst_ax is None:
+            full = outs[0].reshape(moved_shape)
+            return jax.device_put(np.moveaxis(full, 0, ax), dst_sharding)
+        nbs = shape[ax] // p_new
+        host_blocks = {}
+        for r, buf in outs.items():
+            blk = buf.reshape((nbs,) + moved_shape[1:])
+            host_blocks[r] = np.ascontiguousarray(np.moveaxis(blk, 0, ax))
+
+        def cb(index):
+            return host_blocks[(index[ax].start or 0) // nbs]
+
+        return jax.make_array_from_callback(shape, dst_sharding, cb)
+
+    def resize(self, devices) -> Dict[str, Any]:
+        """Resize the engine's world IN PLACE: redistribute the sharded
+        param/optimizer state onto ``devices`` (grow or shrink) and
+        rebuild the compiled step — training continues on the next
+        ``step()`` call with no checkpoint restore.
+
+        Every sharded leaf is moved through the reshard planner's minimal
+        transfer schedule (owner-stable elements never copied through the
+        scratch, chunked to ``reshard_chunk_bytes``) and lands bitwise
+        equal to a fresh ``len(devices)``-way scatter of the gathered
+        state. The ``resize_epoch`` constant is bumped (advancing
+        ``constants.generation()``) so every generation-stamped cache —
+        dispatch memos, plan cache, compiled reshard schedules —
+        invalidates coherently; the engine's own epoch/eval/AOT caches
+        are dropped here.
+
+        Returns a stats dict: ``epoch``, ``old_world``, ``new_world``,
+        ``peak_scratch_bytes`` (the asserted < 2x largest-shard memory
+        bound), ``largest_shard_bytes``, ``wire_elements``,
+        ``axis_fallbacks``, ``seconds``, ``plans``.
+        """
+        from .. import constants as _constants
+        from ..runtime.communicator import Communicator
+
+        devices = list(devices)
+        if not devices:
+            raise ValueError("resize() needs at least one device")
+        old_world = self.comm.size
+        new_comm = Communicator(
+            devices, name=f"{getattr(self.comm, 'name', 'resized')}"
+        )
+        new_mesh = new_comm.flat_mesh(_AXIS)
+        epoch = int(_constants.get("resize_epoch")) + 1
+        t0 = time.perf_counter()
+        entry = None
+        if _flight.enabled():
+            # the resize-epoch flight entry: comm "resize", seq = epoch.
+            # Every rank records the identical (op, payload) stream, so a
+            # rank that never entered the barrier is visible to the
+            # analyzer as a missing seq (telemetry/analyze.py `resize`)
+            entry = _flight.recorder.record(
+                "resize", "resize.enter",
+                payload=f"{old_world}->{new_comm.size}",
+                backend="engine", routing=self.param_sharding, seq=epoch,
+            )
+        stats: Dict[str, Any] = {
+            "epoch": epoch,
+            "old_world": old_world,
+            "new_world": new_comm.size,
+            "peak_scratch_bytes": 0,
+            "largest_shard_bytes": 0,
+            "wire_elements": 0,
+            "axis_fallbacks": 0,
+            "plans": [],
+        }
+        shard_params = self.param_sharding == "fsdp"
+        shard_opt = self.param_sharding in ("fsdp", "zero1")
+
+        def _move(tree, shard: bool):
+            return jax.tree_util.tree_map(
+                lambda a: self._resize_leaf(
+                    a, shard, new_comm, new_mesh, stats
+                ),
+                tree,
+            )
+
+        jax.block_until_ready(
+            (self.params, self.opt_state, self.model_state)
+        )
+        new_params = _move(self.params, shard_params)
+        new_opt = _move(self.opt_state, shard_opt)
+        new_model_state = (
+            _move(self.model_state, shard_params)
+            if self.model_state is not None
+            else None
+        )
+        # commit: swap world-derived state wholesale and rebuild the
+        # compiled surface — nothing below this line can fail cheaply,
+        # so the redistribution above ran to completion first
+        self.comm = new_comm
+        self.mesh = new_mesh
+        self.batch_sharding = NamedSharding(new_mesh, P(_AXIS))
+        self.replicated = NamedSharding(new_mesh, P())
+        self.params, self.opt_state = new_params, new_opt
+        self.model_state = new_model_state
+
+        def _shardings_of(tree):
+            return jax.tree_util.tree_map(lambda a: a.sharding, tree)
+
+        self._out_shardings = (
+            _shardings_of(self.params),
+            _shardings_of(self.opt_state),
+            (
+                _shardings_of(self.model_state)
+                if self.model_state is not None
+                else None
+            ),
+            self.replicated,
+        )
+        self._step_fn = self._build_step()
+        self._bcast_fn = self._build_broadcast()
+        # world-size-keyed caches die with the old world (TPL007's whole
+        # point): compiled epoch fns bake nb/p, AOT steps bake shardings
+        self._epoch_fns.clear()
+        self._eval_fns.clear()
+        self._eval_data.clear()
+        self._aot_steps.clear()
+        try:
+            # one knob write = one generation() bump: every cache that
+            # embeds generation() (dispatch memos, plan cache, compiled
+            # reshard schedules) invalidates with this single mutation
+            _constants.set("resize_epoch", epoch)
+        except _constants.FrozenConstantsError:
+            pass  # frozen table: caches key on the new comm identity
+        stats["seconds"] = time.perf_counter() - t0
+        if entry is not None:
+            _flight.FlightRecorder.complete(entry)
+            wall_t1 = time.time()  # record_complete takes wall stamps
+            # seq MUST be the epoch: an auto-drawn seq would fabricate a
+            # phantom resize epoch in analyze_resizes and collide with
+            # the next real epoch's enter entry
+            _flight.recorder.record_complete(
+                "resize", "resize.commit", wall_t1 - stats["seconds"],
+                wall_t1, payload=f"{old_world}->{new_comm.size}",
+                backend="engine", routing=self.param_sharding, seq=epoch,
+            )
+        if self._telemetry:
+            _telemetry.spans.record(
+                "engine.resize", t0 * 1e6, stats["seconds"] * 1e6,
+                {"old": old_world, "new": new_comm.size, "epoch": epoch},
+            )
+        return stats
+
+    # ------------------------------------------------------------------
     # device-resident epoch training: the whole dataset is staged into HBM
     # once and batches are gathered on-device inside a lax.scan, so a full
     # epoch is ONE dispatch — no per-step host->device transfer at all.

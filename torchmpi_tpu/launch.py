@@ -91,8 +91,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="elastic full-job restarts: when a rank dies, kill "
                     "the survivors and relaunch ALL ranks up to this many "
                     "times (scripts see TORCHMPI_TPU_RESTART_COUNT and "
-                    "should resume from their last checkpoint). Single-node "
-                    "jobs only.")
+                    "should resume from their last checkpoint). Multi-node "
+                    "jobs (--nnodes > 1) negotiate the per-attempt "
+                    "coordinator WITHOUT communication: attempt k uses "
+                    "--coordinator's port + k, so reserve max-restarts "
+                    "consecutive ports above it on the coordinator host.")
+    ap.add_argument("--elastic", action="store_true",
+                    help="LIVE elasticity instead of relaunch: run an "
+                    "elastic membership coordinator in the launcher, export "
+                    "TORCHMPI_TPU_ELASTIC=host:port to every worker, and "
+                    "keep the job alive across rank deaths — survivors "
+                    "redistribute state through torchmpi_tpu.reshard and "
+                    "training continues (no world relaunch). An operator "
+                    "`python -m torchmpi_tpu.reshard.elastic grow <addr>` "
+                    "spawns one more worker; `shrink` evicts one. The "
+                    "launcher exits when every worker has; the exit code is "
+                    "the LAST worker's. Single-node only.")
+    ap.add_argument("--elastic-addr-file", default=None,
+                    help="write the elastic coordinator's host:port here "
+                    "(atomic), for operators and tests")
     ap.add_argument("-m", "--module", default=None,
                     help="run a module (python -m) instead of a script")
     ap.add_argument("script", nargs="?", default=None,
@@ -116,10 +133,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error(f"--node-rank {args.node_rank} outside [0, {args.nnodes})")
     if args.max_restarts < 0:
         ap.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
-    if args.max_restarts and args.nnodes > 1:
-        # a restart needs a fresh coordinator port and a synchronized
-        # world relaunch; across hosts that coordination does not exist
-        ap.error("--max-restarts requires a single-node job (nnodes == 1)")
+    if args.elastic and args.nnodes > 1:
+        ap.error("--elastic requires a single-node job (nnodes == 1)")
+    if args.elastic and args.max_restarts:
+        ap.error("--elastic and --max-restarts are alternative recovery "
+                 "models; pick one")
     if args.watchdog_timeout < 0:
         ap.error(
             f"--watchdog-timeout must be >= 0, got {args.watchdog_timeout}"
@@ -138,13 +156,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if extra and extra[0] == "--":
         extra = extra[1:]
 
-    # Elastic recovery = full-job restart from the last checkpoint: the
-    # practical TPU model (a controller process cannot rejoin a running
+    if args.elastic:
+        return _run_elastic(args, target, extra)
+
+    # Restart-style recovery = full-job relaunch from the last
+    # checkpoint (a controller process cannot rejoin a running
     # jax.distributed job; the reference had no recovery at all — a dead
     # rank meant manual pkill, dependencies/README.md:46-49). Each
-    # attempt gets a FRESH auto-chosen coordinator port (the old
-    # service's socket may linger); scripts read
-    # TORCHMPI_TPU_RESTART_COUNT to resume instead of cold-start.
+    # single-node attempt gets a FRESH auto-chosen coordinator port (the
+    # old service's socket may linger); multi-node attempts derive it
+    # with ZERO cross-host coordination — attempt k binds --coordinator's
+    # port + k on every node, so the hosts re-agree by arithmetic.
+    # Scripts read TORCHMPI_TPU_RESTART_COUNT to resume, not cold-start.
     for restart in range(args.max_restarts + 1):
         rc = _run_world(args, target, extra, restart)
         if rc == 0 or rc == 130 or restart == args.max_restarts:
@@ -158,15 +181,171 @@ def main(argv: Optional[List[str]] = None) -> int:
     return rc
 
 
+def _worker_env(args, rank: int, restart: int = 0) -> dict:
+    """Per-rank environment (shared by the static and elastic paths)."""
+    env = dict(
+        os.environ,
+        TORCHMPI_TPU_PROCESS_ID=str(rank),
+        TORCHMPI_TPU_RESTART_COUNT=str(restart),
+    )
+    if args.set_constant:
+        env["TORCHMPI_TPU_CONSTANTS"] = ";".join(args.set_constant)
+    if args.watchdog_timeout:
+        env["TORCHMPI_TPU_WATCHDOG"] = str(args.watchdog_timeout)
+    if args.cpu_devices:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_devices}"
+        ).strip()
+        env["TORCHMPI_TPU_FORCE_CPU"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_elastic(args, target, extra) -> int:
+    """Live-elastic supervision: one membership coordinator in THIS
+    process, workers that survive each other's deaths, and an operator
+    grow surface that spawns additional workers into the running job.
+    Exits when every worker has; returns the last worker's exit code
+    (survivors of tolerated deaths exit last, so a recovered job is 0)."""
+    from .analysis import lockmon as _lockmon
+    from .reshard.elastic import ElasticCoordinator
+
+    lock = _lockmon.make_lock("launch.py:_run_elastic")
+    procs: dict = {}
+    readers: List[threading.Thread] = []
+    logs = []
+    next_rank = [0]
+    log_dir = Path(args.log_dir) if args.log_dir else None
+    if log_dir is not None:
+        log_dir.mkdir(parents=True, exist_ok=True)
+    telemetry_dir = Path(args.telemetry_dir) if args.telemetry_dir else None
+    if telemetry_dir is not None:
+        telemetry_dir.mkdir(parents=True, exist_ok=True)
+        for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json"):
+            for stale in telemetry_dir.glob(pattern):
+                try:
+                    stale.unlink()
+                except OSError:
+                    pass
+
+    def spawn_locked(addr: str) -> None:
+        rank = next_rank[0]
+        next_rank[0] += 1
+        env = _worker_env(args, rank)
+        env["TORCHMPI_TPU_ELASTIC"] = addr
+        env["TORCHMPI_TPU_ELASTIC_RANK"] = str(rank)
+        if rank >= args.nproc:
+            # spawned by an operator grow INTO a running job: the worker
+            # must attach to the live membership, not wait for formation
+            env["TORCHMPI_TPU_ELASTIC_JOINER"] = "1"
+        if telemetry_dir is not None:
+            env["TORCHMPI_TPU_TELEMETRY"] = "1"
+            env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(
+                telemetry_dir / f"telemetry_rank_{rank}.json"
+            )
+        if log_dir is not None:
+            out = open(log_dir / f"rank_{rank}.log", "w")
+            logs.append(out)
+            proc = subprocess.Popen(
+                target + extra, env=env, stdout=out,
+                stderr=subprocess.STDOUT,
+            )
+        else:
+            proc = subprocess.Popen(
+                target + extra, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            reader = threading.Thread(
+                target=_stream, args=(proc, rank), daemon=True
+            )
+            reader.start()
+            readers.append(reader)
+        procs[rank] = proc
+
+    coord_box = {}
+
+    def on_grow():
+        with lock:
+            print("[launch] elastic grow: spawning one more worker",
+                  file=sys.stderr)
+            spawn_locked(coord_box["addr"])
+
+    coord = ElasticCoordinator(on_grow=on_grow)
+    coord_box["addr"] = f"{coord.address[0]}:{coord.address[1]}"
+    print(f"[launch] elastic coordinator at {coord_box['addr']}",
+          file=sys.stderr)
+    if args.elastic_addr_file:
+        tmp = Path(args.elastic_addr_file).with_suffix(".tmp")
+        tmp.parent.mkdir(parents=True, exist_ok=True)
+        tmp.write_text(coord_box["addr"])
+        os.replace(tmp, args.elastic_addr_file)
+
+    with lock:
+        for _ in range(args.nproc):
+            spawn_locked(coord_box["addr"])
+
+    rc = 0
+    last_code = 0
+    try:
+        while True:
+            with lock:
+                live = {r: p for r, p in procs.items() if p.poll() is None}
+                done = {r: p for r, p in procs.items() if p.poll() is not None}
+                for r in done:
+                    procs.pop(r, None)
+            for r, p in sorted(done.items()):
+                code = p.returncode
+                last_code = 128 - code if code < 0 else code
+                level = "exited" if code == 0 else "DIED"
+                print(
+                    f"[launch] elastic rank {r} {level} with {code}; "
+                    f"{len(live)} worker(s) remain — continuing "
+                    "(live elasticity: survivors reshard)",
+                    file=sys.stderr,
+                )
+            if not live:
+                rc = last_code
+                break
+            try:
+                next(iter(live.values())).wait(timeout=0.2)
+            except subprocess.TimeoutExpired:
+                pass
+    except KeyboardInterrupt:
+        rc = 130
+        with lock:
+            remaining = list(procs.values())
+        for p in remaining:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in remaining:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    finally:
+        coord.close()
+        for reader in readers:
+            reader.join(timeout=5)
+        for f in logs:
+            f.close()
+    return rc
+
+
 def _run_world(args, target, extra, restart: int) -> int:
-    """Spawn the full world once and wait for it (one elastic attempt)."""
-    # restart attempts ignore an explicit --coordinator port: the failed
-    # attempt's service socket can linger, and the fresh-port choice is
-    # what the relaunch depends on (single-node only, so auto-choice is
-    # always valid here)
-    coordinator = (
-        args.coordinator if restart == 0 and args.coordinator else None
-    ) or f"localhost:{_free_port()}"
+    """Spawn the full world once and wait for it (one restart attempt)."""
+    # Restart attempts need a coordinator port the failed attempt's
+    # lingering socket cannot shadow. Single-node relaunches pick a
+    # fresh free port; multi-node relaunches cannot communicate a fresh
+    # choice, so every node derives the SAME next port by arithmetic:
+    # attempt k = --coordinator's port + k (reserve the range).
+    if args.coordinator and args.nnodes > 1 and restart:
+        host, _, port = args.coordinator.rpartition(":")
+        coordinator = f"{host}:{int(port) + restart}"
+    else:
+        coordinator = (
+            args.coordinator if restart == 0 and args.coordinator else None
+        ) or f"localhost:{_free_port()}"
     world = args.nnodes * args.nproc
     base = args.node_rank * args.nproc
     procs: List[subprocess.Popen] = []
@@ -189,13 +368,12 @@ def _run_world(args, target, extra, restart: int) -> int:
                     pass
     for i in range(args.nproc):
         rank = base + i
-        env = dict(
-            os.environ,
-            TORCHMPI_TPU_COORDINATOR=coordinator,
-            TORCHMPI_TPU_NUM_PROCESSES=str(world),
-            TORCHMPI_TPU_PROCESS_ID=str(rank),
-            TORCHMPI_TPU_RESTART_COUNT=str(restart),
-        )
+        # _worker_env: PROCESS_ID/RESTART_COUNT, --set-constant knob
+        # overrides (applied by start() pre-bootstrap), watchdog arming,
+        # and the virtual-CPU-mesh flags
+        env = _worker_env(args, rank, restart)
+        env["TORCHMPI_TPU_COORDINATOR"] = coordinator
+        env["TORCHMPI_TPU_NUM_PROCESSES"] = str(world)
         if telemetry_dir is not None:
             # the env var both enables telemetry in the rank and registers
             # its atexit dump (torchmpi_tpu.telemetry import-time hook);
@@ -206,21 +384,6 @@ def _run_world(args, target, extra, restart: int) -> int:
             )
             env["TORCHMPI_TPU_TELEMETRY"] = "1"
             env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(telemetry_dir / tname)
-        if args.watchdog_timeout:
-            # armed at telemetry import in the rank (pre-start coverage);
-            # heartbeats + hang reports land beside the telemetry dumps
-            env["TORCHMPI_TPU_WATCHDOG"] = str(args.watchdog_timeout)
-        if args.set_constant:
-            # applied by runtime_state.start() in the rank, before any
-            # runtime state exists; explicit start(**overrides) still win
-            env["TORCHMPI_TPU_CONSTANTS"] = ";".join(args.set_constant)
-        if args.cpu_devices:
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={args.cpu_devices}"
-            ).strip()
-            env["TORCHMPI_TPU_FORCE_CPU"] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
         if log_dir is not None:
             # restart attempts keep distinct logs: the failed attempt's
             # tail is the evidence worth reading

@@ -83,6 +83,18 @@ def _submit_bounded(fn) -> Future:
     return f
 
 
+# Reserved client id for chain re-formation copy streams (u32 max —
+# outside any real client's id space). Reform copies carry oseq=0 and so
+# dedup by their CHANNEL seq; under a real client id that channel seq
+# would share the (inst, rank, client) applied high-water with the
+# client's chain-forwarded origin seqs — a different sequence space —
+# silently dropping whichever side's numbers run lower. The reserved id
+# gives the copy stream its own dedup space, and the serve loop uses it
+# to keep reform copies out of the replica pump (the head already
+# streams to EVERY chain member directly).
+REFORM_CLIENT = 0xFFFFFFFF
+
+
 def shard_range(
     n: int, size: int, rank: int, rotation: int = 0
 ) -> Tuple[int, int]:
@@ -377,6 +389,112 @@ class _Instance:
         if any(v is not None for v in self._next_chain.values()):
             self._pump = _ReplicaPump(forward)
 
+    def reform(self, live: Sequence[int],
+               replication: Optional[int] = None) -> Dict[int, List[int]]:
+        """Chain RE-formation after a death: recompute owners + chains
+        over the ``live`` processes, restoring the replication factor a
+        failover degraded. Deterministic from ``(owners, chains, live,
+        knob)``, so every live process computes the identical layout
+        without coordination beyond agreeing on ``live``.
+
+        - a rank whose head died promotes its first live chain member
+          (the member that has been serving failover traffic — its
+          shard already holds the exactly-once applied state);
+        - chains are rebuilt as [head + next k-1 live pool members in
+          ring order]; the pool prefers the original owner processes
+          and widens to ANY live process when they cannot restore k —
+          the "re-replicate onto a fresh process" path;
+        - this process allocates zeroed storage for ranks it newly
+          joins (filled by the head's chunked ``copy_at`` stream);
+          a native-store instance migrates to the numpy store first
+          (the native allocation is construction-sized).
+
+        Returns ``{rank: [processes needing a state copy]}`` for ranks
+        HEADED here — the copies the caller must stream."""
+        rep = replication or max(1, int(constants.get("ps_replication")))
+        live_set = set(int(p) for p in live)
+        new_owners: List[int] = []
+        for r, owner in enumerate(self.owners):
+            if owner in live_set:
+                new_owners.append(owner)
+            else:
+                promoted = next(
+                    (p for p in self.chains[r] if p in live_set), None
+                )
+                if promoted is None:
+                    raise RuntimeError(
+                        f"shard {r}: no live member in chain "
+                        f"{self.chains[r]} (live={sorted(live_set)}) — "
+                        "state is unrecoverable, restore from checkpoint"
+                    )
+                new_owners.append(promoted)
+        pool = sorted(live_set & set(self.owners))
+        if len(pool) < min(rep, len(live_set)):
+            pool = sorted(live_set)  # widen onto fresh processes
+        had_storage = {r: self.has_storage(r) for r in range(self.size)}
+        if rep > 1 and len(pool) > 1:
+            k = min(rep, len(pool))
+            pos = {p: i for i, p in enumerate(pool)}
+            new_chains = []
+            for r, o in enumerate(new_owners):
+                if o in pos:
+                    new_chains.append(
+                        [pool[(pos[o] + j) % len(pool)] for j in range(k)]
+                    )
+                else:  # head outside the pool (promoted client proc)
+                    new_chains.append(
+                        [o] + [p for p in pool if p != o][:k - 1]
+                    )
+        else:
+            new_chains = [[o] for o in new_owners]
+        if self.native is not None:
+            # native storage is sized at construction; migrate the live
+            # shards to the numpy store so membership can change
+            self._shards = [
+                self.native.read(r) if had_storage[r] else None
+                for r in range(self.size)
+            ]
+            self.native.free()
+            self.native = None
+        elif not hasattr(self, "_shards"):
+            self._shards = [None] * self.size
+        self.owners = new_owners
+        self.chains = new_chains
+        self.replication = max(len(c) for c in new_chains)
+        self._next_chain = {}
+        sends: Dict[int, List[int]] = {}
+        for r, chain in enumerate(new_chains):
+            nxt = None
+            if self.my_proc in chain:
+                i = chain.index(self.my_proc)
+                if i + 1 < len(chain):
+                    nxt = chain[i + 1]
+            self._next_chain[r] = nxt
+            stored_now = self.my_proc in chain
+            if stored_now and not had_storage[r]:
+                s, e = self.ranges[r]
+                self._shards[r] = np.zeros(e - s, self.dtype)
+            if not stored_now and had_storage[r]:
+                self._shards[r] = None  # shed storage we no longer hold
+            if new_owners[r] == self.my_proc:
+                fresh = [p for p in chain if p != self.my_proc]
+                # every non-head member gets a copy: a surviving replica
+                # may hold pre-failover state the head advanced past
+                if fresh:
+                    sends[r] = fresh
+        from .transport import instance_fingerprint
+
+        self.fingerprint = instance_fingerprint(
+            self.shape, self.dtype, self.size, self.owners,
+            self.shard_rotation, self.replication,
+        )
+        # delta snapshots predate the reform; clients self-heal with a
+        # full fetch against the bumped versions
+        self._delta_snaps.clear()
+        for r in range(self.size):
+            self.versions[r] += 1
+        return sends
+
     # --- storage backend dispatch ---
     def apply_rule(self, r: int, rule: str, payload) -> None:
         if not self.has_storage(r):
@@ -385,6 +503,20 @@ class _Instance:
                 f"{self.chains[r]}), not stored on this process "
                 f"({self.my_proc})"
             )
+        if rule.startswith("copy_at:"):
+            # offset-ranged write: the chain re-formation state copy
+            # (reshard chunk schedule — one bounded chunk per update, so
+            # a shard of any size re-replicates without a shard-sized
+            # frame). Idempotent by construction.
+            off = int(rule.split(":", 1)[1])
+            payload = np.asarray(payload)
+            if self.native is not None:
+                buf = self.native.read(r)
+                buf[off:off + payload.shape[0]] = payload
+                self.native.apply(r, "copy", buf)
+            else:
+                self._shards[r][off:off + payload.shape[0]] = payload
+            return
         if self.native is not None:
             from ..runtime.native import NativeShardStore
 
@@ -452,7 +584,9 @@ class _Instance:
                     continue
                 if msg.kind == "update":
                     try:
-                        if msg.rule not in UPDATE_RULES:
+                        if msg.rule not in UPDATE_RULES and not (
+                            msg.rule.startswith("copy_at:")
+                        ):
                             raise KeyError(f"unknown update rule {msg.rule!r}")
                         self.apply_rule(r, msg.rule, msg.payload)
                         # version vector for delta-encoded fetches: every
@@ -473,6 +607,7 @@ class _Instance:
                                 msg.error is None
                                 and succ is not None
                                 and self._pump is not None
+                                and msg.client != REFORM_CLIENT
                             ):
                                 # chain replication: the done event (the
                                 # client's ack) completes only after the
@@ -737,20 +872,7 @@ class ParameterServer:
             self._transport = _t.ensure_transport()
             self._inst = _server.register(full, comm.size, owners, my_proc)
             if any(len(c) > 1 for c in self._inst.chains):
-                # arm the replica pump: forwarded frames keep the
-                # original (client, oseq) dedup identity so a failover
-                # re-issue to the successor is answered from its applied
-                # high-water instead of double-applying
-                tr, inst = self._transport, self._inst
-
-                def _fwd(proc, r, msg):
-                    tr.forward_update(
-                        proc, inst.id, r, msg.client, msg.rule,
-                        np.asarray(msg.payload), fp=inst.fingerprint,
-                        oseq=msg.oseq,
-                    )
-
-                self._inst.attach_replication(_fwd)
+                self._attach_chain_pump()
             self._transport.barrier(
                 set(owners), f"ps-init-{self._inst.id}-{self._inst.fingerprint}"
             )
@@ -766,6 +888,123 @@ class ParameterServer:
             "server.py:ParameterServer._prefetch_lock"
         )
         self._prefetch_q: Dict[int, deque] = {}
+
+    def _attach_chain_pump(self) -> None:
+        """Arm the replica pump: forwarded frames keep the original
+        (client, oseq) dedup identity so a failover re-issue to the
+        successor is answered from its applied high-water instead of
+        double-applying. Fingerprint is read per forward, so a reform
+        that changed it keeps forwarding valid."""
+        tr, inst = self._transport, self._inst
+
+        def _fwd(proc, r, msg):
+            tr.forward_update(
+                proc, inst.id, r, msg.client, msg.rule,
+                np.asarray(msg.payload), fp=inst.fingerprint,
+                oseq=msg.oseq,
+            )
+
+        self._inst.attach_replication(_fwd)
+
+    def reform(self, live: Optional[Sequence[int]] = None,
+               quiesce_barrier: bool = True) -> Dict[str, int]:
+        """Chain RE-formation: restore ``ps_replication=k`` after a
+        failover degraded a chain (PR 8 left this as future work — the
+        split-brain window closed for good). A collective among the
+        ``live`` processes holding this instance (default: the owner
+        processes minus the transport's dead-marks, plus this one):
+
+        1. barrier (all live processes enter reform together — call at
+           a quiet point; updates racing the copy may be overwritten on
+           the fresh replica until step 3's barrier);
+        2. every process recomputes the same new owners/chains
+           (:meth:`_Instance.reform`); dead heads are promoted to their
+           serving replica, fresh processes join chains to restore k;
+        3. each NEW head streams its shard state to every other chain
+           member as chunked ``copy_at`` updates (the reshard chunk
+           schedule — bounded memory both ends), then a closing
+           barrier;
+        4. dead-marks for live processes clear and ``resize_epoch``
+           bumps (one ``generation()`` tick invalidates every
+           world-derived cache coherently).
+
+        Single-process instances are a no-op. Returns stats
+        (``replication``, ``copied_bytes``, ``epoch``).
+        """
+        from .. import constants as _c
+        from ..reshard.core import chunk_elems_for, chunk_spans
+        from ..telemetry import flightrecorder as _flight
+
+        inst, tr = self._inst, self._transport
+        if tr is None:
+            return {"replication": inst.replication, "copied_bytes": 0,
+                    "epoch": int(_c.get("resize_epoch"))}
+        if live is None:
+            dead = set(getattr(tr, "_dead_procs", {}))
+            live = sorted(
+                (set(inst.owners) | {inst.my_proc}) - dead
+            )
+        live = sorted(set(int(p) for p in live))
+        old_fp = inst.fingerprint
+        epoch = int(_c.get("resize_epoch")) + 1
+        entry = None
+        if _flight.enabled():
+            entry = _flight.recorder.record(
+                "resize", "resize.enter",
+                payload=f"ps{inst.id}:{inst.replication}->k",
+                backend="ps", routing=f"live={live}", seq=epoch,
+            )
+        # the live set is IN the barrier tag: reform is deterministic
+        # only from an AGREED live set, and the default (local
+        # dead-marks) can differ between processes — a disagreement must
+        # strand both sides' barriers (loud timeout) rather than let
+        # them reform divergent chain layouts
+        live_tag = ".".join(str(p) for p in live)
+        if quiesce_barrier:
+            tr.barrier(
+                set(live),
+                f"ps-reform-{inst.id}-{old_fp}-{live_tag}-enter",
+            )
+        sends = inst.reform(live)
+        if inst._pump is None and any(
+            v is not None for v in inst._next_chain.values()
+        ):
+            self._attach_chain_pump()
+        copied = 0
+        celems_cache: Dict[int, int] = {}
+        for r, targets in sorted(sends.items()):
+            shard = inst.read_shard(r)
+            celems = celems_cache.setdefault(
+                shard.dtype.itemsize, chunk_elems_for(shard.dtype.itemsize)
+            )
+            for proc in targets:
+                for s, e in chunk_spans(shard.shape[0], celems):
+                    # fp=0: the copy stream spans the fingerprint
+                    # transition, so it travels unpinned (operator
+                    # path); REFORM_CLIENT keeps its channel-seq dedup
+                    # out of real clients' oseq high-waters and out of
+                    # the replica pump
+                    tr.update(
+                        proc, inst.id, r, REFORM_CLIENT,
+                        f"copy_at:{s}", shard[s:e], fp=0,
+                    )
+                    copied += int(shard[s:e].nbytes)
+        if quiesce_barrier:
+            tr.barrier(
+                set(live),
+                f"ps-reform-{inst.id}-{old_fp}-{live_tag}-exit",
+            )
+        for p in live:
+            getattr(tr, "_dead_procs", {}).pop(p, None)
+        try:
+            if epoch > int(_c.get("resize_epoch")):
+                _c.set("resize_epoch", epoch)
+        except _c.FrozenConstantsError:
+            pass
+        if entry is not None:
+            _flight.FlightRecorder.complete(entry)
+        return {"replication": inst.replication, "copied_bytes": copied,
+                "epoch": epoch}
 
     # ------------------------------------------------------------------
     def send(

@@ -1,0 +1,44 @@
+"""Live elastic resharding: move sharded state between any two
+``(world size, sharding)`` layouts with bounded memory.
+
+One primitive, three consumers:
+
+- **live world resize** — :meth:`~..engine.AllReduceSGDEngine.resize`
+  (in-place fsdp/zero1 shard redistribution over a resized device
+  world) and :mod:`.elastic` (cross-process membership: survive rank
+  death / operator grow-shrink without relaunching training);
+- **checkpoint reshaping** — restore an N-way checkpoint onto an M-way
+  world (:mod:`..utils.checkpoint`), also offline via
+  ``python -m torchmpi_tpu.reshard --from N --to M``;
+- **PS chain re-formation** — re-replicate a surviving shard onto a
+  fresh process after a PR 8 failover
+  (:meth:`~..parameterserver.ParameterServer.reform`).
+"""
+
+from .core import (
+    Layout,
+    Redistributor,
+    Transfer,
+    build_plan,
+    chunk_spans,
+    chunk_transfers,
+    compile_reshard,
+    estimate_us,
+    plan_transfers,
+    redistribute_arrays,
+    wire_elements,
+)
+
+__all__ = [
+    "Layout",
+    "Redistributor",
+    "Transfer",
+    "build_plan",
+    "chunk_spans",
+    "chunk_transfers",
+    "compile_reshard",
+    "estimate_us",
+    "plan_transfers",
+    "redistribute_arrays",
+    "wire_elements",
+]

@@ -1,0 +1,373 @@
+"""Portable array redistribution: the minimal-transfer reshard planner.
+
+The missing elasticity primitive (ROADMAP item 3): move sharded state
+between ANY two ``(world size, sharding)`` layouts with bounded memory —
+the "Memory-efficient array redistribution through portable collective
+communication" framing (PAPERS.md). A redistribution is *compiled*, not
+hand-routed:
+
+1. :func:`plan_transfers` computes the **minimal** transfer schedule
+   between a source and target :class:`Layout` of the same flat array:
+   every target element is received exactly once, from the unique source
+   rank that holds it, and elements whose owner does not change never
+   touch a wire (they appear as ``src_rank == dst_rank`` local copies).
+2. :func:`build_plan` expresses that schedule as a PR 9
+   :class:`~..schedule.ir.Plan` DAG — aggregated send/recv steps on the
+   ``host`` link class, chunk counts in ``meta`` — so redistribution is
+   cost-modeled, cached, and introspectable (``--explain``) through the
+   same machinery as every other collective. Ragged worlds (a 3-survivor
+   shrink of a 4-rank world) are just layouts; nothing special-cases
+   them.
+3. :class:`Redistributor` executes the schedule with **bounded peak
+   memory**: transfers are cut into ``reshard_chunk_bytes`` chunks and
+   copied through one reusable scratch buffer — the full array is never
+   materialized on any rank, and :attr:`Redistributor.peak_scratch_bytes`
+   makes the bound assertable (< 2x the largest single shard, tested).
+
+Everything here is numpy/stdlib only — plans are buildable offline (the
+``python -m torchmpi_tpu.reshard`` CLI) and the same schedule drives the
+in-process engine resize, the cross-process elastic exchange
+(:mod:`.elastic`), the checkpoint reshaper (:mod:`..utils.checkpoint`)
+and the PS chain re-formation's shard copy chunking.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants
+from ..schedule import cost as _cost
+from ..schedule.ir import Plan, Step
+
+
+@dataclass(frozen=True)
+class Layout:
+    """One ``(world size, sharding)`` placement of a flat n-element array.
+
+    ``kind``:
+
+    - ``'sharded'`` — contiguous uniform partition over ``world`` ranks
+      (the engine's fsdp/zero1 leaf layout, the PS ``shard_range``
+      layout, the elastic host-zero1 optimizer layout). ``rotation``
+      places the ``n % world`` remainder on the cyclic rank interval
+      ``[rotation, rotation + extra)`` (PS byte-aware placement).
+    - ``'replicated'`` — every rank holds the full array (engine
+      replicated params). A replicated *source* serves each target
+      interval from the co-located rank when possible (zero wire
+      bytes); a replicated *target* receives the full array on every
+      rank.
+    """
+
+    world: int
+    kind: str = "sharded"
+    rotation: int = 0
+
+    def __post_init__(self):
+        if self.world < 1:
+            raise ValueError(f"layout world must be >= 1, got {self.world}")
+        if self.kind not in ("sharded", "replicated"):
+            raise ValueError(
+                f"layout kind must be 'sharded'|'replicated', got "
+                f"{self.kind!r}"
+            )
+
+    def interval(self, n: int, rank: int) -> Tuple[int, int]:
+        """[start, end) of ``rank``'s elements in the flat array."""
+        if self.kind == "replicated":
+            return 0, n
+        from ..parameterserver.server import shard_range
+
+        return shard_range(n, self.world, rank, self.rotation)
+
+    def intervals(self, n: int) -> List[Tuple[int, int]]:
+        return [self.interval(n, r) for r in range(self.world)]
+
+    def token(self) -> str:
+        tail = f"@rot{self.rotation}" if self.rotation else ""
+        return f"{self.kind[:4]}{self.world}{tail}"
+
+
+@dataclass(frozen=True)
+class Transfer:
+    """One contiguous span moving from a source rank to a target rank.
+
+    Offsets are into the *local* shard buffers of each side (the flat
+    global span is ``[global_start, global_start + n)``); a transfer
+    with ``src == dst`` is a local copy and never touches a wire."""
+
+    src: int
+    dst: int
+    src_off: int
+    dst_off: int
+    n: int
+    global_start: int
+
+
+def plan_transfers(n: int, src: Layout, dst: Layout) -> List[Transfer]:
+    """The minimal transfer schedule from ``src`` to ``dst`` layout.
+
+    Minimality: each target element appears in exactly ONE transfer
+    (received once), sourced from a rank that holds it — and when the
+    holding source rank IS the target rank the element moves locally
+    (zero wire bytes). A replicated source always serves a target rank
+    from itself when the target rank also exists in the source world,
+    else from ``dst_rank % src.world`` (spreads the load of a grow from
+    a replicated checkpoint over all sources)."""
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    out: List[Transfer] = []
+    if n == 0:
+        return out
+    if src.kind == "replicated":
+        for d in range(dst.world):
+            ds, de = dst.interval(n, d)
+            if de <= ds:
+                continue
+            s = d if d < src.world else d % src.world
+            out.append(Transfer(s, d, ds, 0, de - ds, ds))
+        return out
+    src_ivs = src.intervals(n)
+    for d in range(dst.world):
+        ds, de = dst.interval(n, d)
+        if de <= ds:
+            continue
+        for s, (ss, se) in enumerate(src_ivs):
+            lo, hi = max(ds, ss), min(de, se)
+            if hi <= lo:
+                continue
+            out.append(Transfer(s, d, lo - ss, lo - ds, hi - lo, lo))
+    return out
+
+
+def wire_elements(transfers: List[Transfer]) -> int:
+    """Elements that actually cross ranks (the minimality metric)."""
+    return sum(t.n for t in transfers if t.src != t.dst)
+
+
+def chunk_spans(n: int, chunk: int) -> Iterator[Tuple[int, int]]:
+    """Cut ``[0, n)`` into spans of at most ``chunk`` elements. The one
+    chunking rule everywhere reshard bytes move — the elastic exchange,
+    the checkpoint reshaper and the PS re-formation copy all bound their
+    peak memory with it."""
+    if n <= 0:
+        return
+    chunk = max(1, int(chunk))
+    for off in range(0, n, chunk):
+        yield off, min(off + chunk, n)
+
+
+def chunk_transfers(
+    transfers: List[Transfer], chunk_elems: int
+) -> Iterator[Transfer]:
+    """Split every transfer into <= ``chunk_elems``-element pieces (the
+    bounded-memory execution unit)."""
+    for t in transfers:
+        for lo, hi in chunk_spans(t.n, chunk_elems):
+            yield Transfer(
+                t.src, t.dst, t.src_off + lo, t.dst_off + lo, hi - lo,
+                t.global_start + lo,
+            )
+
+
+def chunk_elems_for(itemsize: int, chunk_bytes: Optional[int] = None) -> int:
+    """Elements per chunk from the ``reshard_chunk_bytes`` knob."""
+    if chunk_bytes is None:
+        chunk_bytes = int(constants.get("reshard_chunk_bytes"))
+    if chunk_bytes <= 0:
+        return 1 << 62  # chunking disabled: one piece per transfer
+    return max(1, chunk_bytes // max(1, int(itemsize)))
+
+
+# ---------------------------------------------------------------------------
+# plan IR: a redistribution as a schedule-compiler plan DAG
+# ---------------------------------------------------------------------------
+
+
+def build_plan(
+    n: int,
+    itemsize: int,
+    src: Layout,
+    dst: Layout,
+    chunk_bytes: Optional[int] = None,
+    platform: str = "cpu",
+) -> Plan:
+    """Express the minimal schedule as a PR 9 plan: aggregated per-rank
+    send/recv steps on the ``host`` link class (redistribution rides the
+    host blob fabric — the staged-DCN hop of the topology model), local
+    copies as ``local_reduce``-priced moves, chunk counts in ``meta``.
+    The plan's ``plan_id`` is the stable identity flight-recorder resize
+    entries and the reshard cache share."""
+    transfers = plan_transfers(n, src, dst)
+    celems = chunk_elems_for(itemsize, chunk_bytes)
+    wire_by_src: Dict[int, int] = {}
+    local_elems = 0
+    nchunks = 0
+    for t in transfers:
+        if t.src == t.dst:
+            local_elems += t.n
+        else:
+            wire_by_src[t.src] = wire_by_src.get(t.src, 0) + t.n
+            nchunks += (t.n + celems - 1) // celems
+    steps: List[Step] = []
+    if wire_by_src:
+        worst = max(wire_by_src.values())
+        senders = len(wire_by_src)
+        steps.append(Step(
+            "send", "host", worst * itemsize, count=senders,
+            note="per-rank worst-case wire bytes",
+        ))
+        steps.append(Step(
+            "recv", "host", worst * itemsize, count=senders,
+        ))
+    if local_elems:
+        steps.append(Step(
+            "local_reduce", "local", local_elems * itemsize,
+            note="owner-stable elements (never on a wire)",
+        ))
+    return Plan(
+        op="reshard",
+        generator="reshard",
+        backend="host",
+        wire="full",
+        topology_fp=f"{platform}:reshard:{src.token()}->{dst.token()}",
+        steps=tuple(steps),
+        meta=(
+            ("chunks", nchunks),
+            ("chunk_elems", min(celems, n) if n else 0),
+            ("n", n),
+            ("wire_elems", sum(wire_by_src.values())),
+        ),
+    )
+
+
+# compiled-reshard cache: (n, itemsize, src, dst, chunk, generation()) ->
+# (plan, transfers). generation() in the key is the coherence contract —
+# a resize bumps `resize_epoch`, every cached schedule (this one AND the
+# collective dispatch memos) invalidates together.
+_plan_cache: Dict[tuple, Tuple[Plan, List[Transfer]]] = {}
+_PLAN_CACHE_CAP = 128
+
+
+def compile_reshard(
+    n: int,
+    itemsize: int,
+    src: Layout,
+    dst: Layout,
+    chunk_bytes: Optional[int] = None,
+) -> Tuple[Plan, List[Transfer]]:
+    """Cached plan + transfer list for one redistribution request."""
+    key = (n, itemsize, src, dst, chunk_bytes, constants.generation())
+    ent = _plan_cache.get(key)
+    if ent is None:
+        ent = (
+            build_plan(n, itemsize, src, dst, chunk_bytes),
+            plan_transfers(n, src, dst),
+        )
+        while len(_plan_cache) >= _PLAN_CACHE_CAP:
+            _plan_cache.pop(next(iter(_plan_cache)))
+        _plan_cache[key] = ent
+    return ent
+
+
+def estimate_us(plan: Plan) -> float:
+    """Cost-model estimate (the ordering signal ``--explain`` prints)."""
+    return _cost.estimate_us(plan)
+
+
+# ---------------------------------------------------------------------------
+# bounded-memory executor
+# ---------------------------------------------------------------------------
+
+
+class Redistributor:
+    """Execute a reshard schedule chunk-by-chunk with bounded scratch.
+
+    ``read(rank, off, out_view)`` must fill ``out_view`` with elements
+    ``[off, off + len)`` of source rank ``rank``'s shard;
+    ``write(rank, off, values)`` stores into target rank ``rank``'s
+    shard. The executor never allocates more than one chunk of scratch
+    at a time; ``peak_scratch_bytes`` is the asserted memory bound.
+
+    This one class serves every consumer: in-process (reads/writes are
+    numpy copies), cross-process (read fills from a received blob,
+    write lands in the local target shard — see :mod:`.elastic`), and
+    offline (reads are mmap'd checkpoint shard files)."""
+
+    def __init__(
+        self,
+        n: int,
+        dtype,
+        src: Layout,
+        dst: Layout,
+        chunk_bytes: Optional[int] = None,
+    ):
+        self.n = int(n)
+        self.dtype = np.dtype(dtype)
+        self.src = src
+        self.dst = dst
+        self.plan, self.transfers = compile_reshard(
+            self.n, self.dtype.itemsize, src, dst, chunk_bytes
+        )
+        self.chunk_elems = chunk_elems_for(self.dtype.itemsize, chunk_bytes)
+        self.peak_scratch_bytes = 0
+        self._scratch: Optional[np.ndarray] = None
+
+    def _scratch_for(self, nelem: int) -> np.ndarray:
+        if self._scratch is None or self._scratch.shape[0] < nelem:
+            self._scratch = np.empty(nelem, self.dtype)
+            self.peak_scratch_bytes = max(
+                self.peak_scratch_bytes, self._scratch.nbytes
+            )
+        return self._scratch[:nelem]
+
+    def run(
+        self,
+        read: Callable[[int, int, np.ndarray], None],
+        write: Callable[[int, int, np.ndarray], None],
+        ranks: Optional[set] = None,
+    ) -> None:
+        """Run every (chunked) transfer; ``ranks`` restricts execution to
+        transfers whose source AND target live in the given rank set (the
+        in-process case passes None = all)."""
+        for t in chunk_transfers(self.transfers, self.chunk_elems):
+            if ranks is not None and (t.src not in ranks or t.dst not in ranks):
+                continue
+            buf = self._scratch_for(t.n)
+            read(t.src, t.src_off, buf)
+            write(t.dst, t.dst_off, buf)
+
+
+def redistribute_arrays(
+    shards: Dict[int, np.ndarray],
+    n: int,
+    src: Layout,
+    dst: Layout,
+    chunk_bytes: Optional[int] = None,
+) -> Tuple[Dict[int, np.ndarray], Redistributor]:
+    """In-process reference executor: source shards in, freshly-allocated
+    target shards out (bitwise-equal to a fresh ``dst`` scatter of the
+    assembled array — the equivalence the tests pin). Returns the
+    executor too so callers can assert its memory bound."""
+    dt = None
+    for a in shards.values():
+        dt = np.asarray(a).dtype
+        break
+    if dt is None:
+        raise ValueError("no source shards given")
+    rd = Redistributor(n, dt, src, dst, chunk_bytes)
+    out = {
+        r: np.empty(max(0, e - s), dt)
+        for r, (s, e) in enumerate(dst.intervals(n))
+    }
+
+    def read(rank: int, off: int, view: np.ndarray) -> None:
+        view[:] = np.asarray(shards[rank]).reshape(-1)[off:off + view.shape[0]]
+
+    def write(rank: int, off: int, values: np.ndarray) -> None:
+        out[rank][off:off + values.shape[0]] = values
+
+    rd.run(read, write)
+    return out, rd

@@ -1,0 +1,1158 @@
+"""Elastic membership + live cross-process resharding.
+
+The cross-process half of the reshard subsystem: a job whose world can
+GROW and SHRINK — rank death included — without relaunching anyone.
+
+Three pieces:
+
+- :class:`ElasticCoordinator` — the membership service (runs inside
+  ``launch --elastic``): members join, heartbeat, and barrier through
+  it; a member silent for 5 heartbeats is declared dead, an operator
+  ``grow``/``shrink`` request adds or evicts a member — every
+  membership change publishes a new **epoch** (monotone int) with the
+  member list.
+- :class:`ElasticMember` — one training process's handle: a control
+  connection to the coordinator plus a peer **data plane** (chunked
+  binary frames, one listener per member). :meth:`ElasticMember.sync`
+  is the *resize barrier*: on an epoch change, survivors agree on the
+  new world through the coordinator, then redistribute every registered
+  array from the old layout to the new one using the
+  :func:`~.core.plan_transfers` schedule — chunked to
+  ``reshard_chunk_bytes``, so the transfer memory is one chunk, never a
+  full array. Sharded arrays keep a **ring replica** (rank ``r``'s
+  shard is mirrored on rank ``r+1``, refreshed every step), which is
+  what makes a shard survive its owner's death: the plan's transfer
+  sources fall back to the replica holder when the primary is gone.
+- :class:`ElasticZero1` — a host-level ZeRO-1 data-parallel SGD
+  trainer over the data plane: params replicated, momentum sharded;
+  per step a gradient reduce-scatter, a sharded optimizer update, a
+  parameter allgather, and the replica refresh. A mid-step membership
+  change raises :class:`EpochChanged`; the step is retried against the
+  new world after the resize barrier (at most one partially-applied
+  step is superseded by the post-resize state agreement — the
+  parameters re-sync from the most-advanced survivor, so the loss
+  curve continues instead of cold-restoring).
+
+Everything here is numpy + stdlib sockets/threads — no jax, so the
+elastic layer works identically on a TPU VM host and in CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants
+from ..analysis import lockmon as _lockmon
+from ..telemetry import flightrecorder as _flight
+from .core import Layout, chunk_spans, chunk_elems_for, plan_transfers
+
+# data-plane frame kinds
+K_SHARD = 1   # resize: a chunk of a target rank's new primary shard
+K_FULL = 2    # resize: a chunk of a replicated array (anchor -> all)
+K_REPL = 3    # replica: a chunk of a predecessor's primary shard
+K_RS = 4      # step: a reduce-scatter contribution chunk
+K_AG = 5      # step: an allgather slice chunk
+
+# kind(u8) epoch(u32) src_mid(u32) aid(u16) tag(u32) off(u64) nbytes(u64)
+_HDR = struct.Struct("!BIIHIQQ")
+
+_DEAD_BEATS = 5  # heartbeats of silence before a member is declared dead
+
+
+class EpochChanged(Exception):
+    """The world changed under a collective: retry after the resize
+    barrier. Carries the newest epoch this member has heard of."""
+
+    def __init__(self, epoch: int):
+        super().__init__(f"membership epoch advanced to {epoch}")
+        self.epoch = epoch
+
+
+class Evicted(Exception):
+    """This member is no longer part of the world (operator shrink):
+    exit the training loop gracefully."""
+
+
+class DataLoss(RuntimeError):
+    """A shard's primary AND its ring replica died in one epoch — the
+    single-fault contract is exhausted; restore from checkpoint."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    while view:
+        got = sock.recv_into(view)
+        if got == 0:
+            raise ConnectionError("elastic peer closed")
+        view = view[got:]
+    return bytes(buf)
+
+
+def _json_roundtrip(addr: Tuple[str, int], req: dict,
+                    timeout: float = 60.0) -> dict:
+    """One JSON request/reply on a short-lived control connection."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.settimeout(timeout)
+        payload = json.dumps(req).encode()
+        s.sendall(struct.pack("!I", len(payload)) + payload)
+        n = struct.unpack("!I", _recv_exact(s, 4))[0]
+        return json.loads(_recv_exact(s, n))
+
+
+def operator_request(addr, op: str, timeout: float = 60.0) -> dict:
+    """Operator surface: ``grow`` (spawn + admit one member) or
+    ``shrink`` (evict the highest-id member). ``addr`` is
+    ``(host, port)`` or ``"host:port"`` (what ``launch --elastic``
+    prints / writes to ``--elastic-addr-file``)."""
+    if isinstance(addr, str):
+        h, _, p = addr.rpartition(":")
+        addr = (h, int(p))
+    return _json_roundtrip(addr, {"op": op}, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+
+
+class ElasticCoordinator:
+    """Membership + epoch service (one per job; lives in the launcher).
+
+    Thread-per-control-connection (connections are short-lived and the
+    member count is small); all state under one lock + condition. Every
+    membership change — join, heartbeat death, operator shrink — bumps
+    ``epoch`` and re-publishes the sorted member list; the previous
+    epoch's list rides along so joiners can compute the redistribution
+    plan they are the target of."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 on_grow: Optional[Callable[[], None]] = None):
+        self._on_grow = on_grow
+        self._lock = _lockmon.make_lock("elastic.py:Coordinator._lock")
+        self._cv = threading.Condition(self._lock)
+        self._members: Dict[int, dict] = {}
+        self._next_mid = 0
+        self.epoch = 0
+        self._epoch_members: List[int] = []
+        self._prev_members: List[int] = []
+        self._history: Dict[int, List[int]] = {}
+        # (epoch) -> {mid: value} barrier arrivals
+        self._barriers: Dict[int, Dict[int, Any]] = {}
+        self._closed = False
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.address = self._srv.getsockname()[:2]
+        threading.Thread(
+            target=self._accept_loop, name="tm-elastic-coord", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._monitor_loop, name="tm-elastic-mon", daemon=True
+        ).start()
+
+    # -- internals ---------------------------------------------------------
+    def _bump_epoch_locked(self) -> None:
+        self._prev_members = self._epoch_members
+        self.epoch += 1
+        self._epoch_members = sorted(self._members)
+        self._barriers.pop(self.epoch - 1, None)
+        # bounded epoch->members history: a resize aborted by a SECOND
+        # membership change leaves survivors laid out per the epoch they
+        # last COMMITTED ("was" in the barrier value) — which may be
+        # older than epoch-1, so `prev` alone cannot name their layout
+        self._history[self.epoch] = self._epoch_members
+        while len(self._history) > 16:
+            del self._history[min(self._history)]
+        self._cv.notify_all()
+
+    def _view_locked(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "members": [
+                [m, self._members[m]["host"], self._members[m]["data_port"]]
+                for m in self._epoch_members
+            ],
+            "prev": list(self._prev_members),
+            "history": {str(e): list(m) for e, m in self._history.items()},
+        }
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(600)
+                n = struct.unpack("!I", _recv_exact(conn, 4))[0]
+                req = json.loads(_recv_exact(conn, n))
+                reply = self._handle(req)
+                payload = json.dumps(reply).encode()
+                conn.sendall(struct.pack("!I", len(payload)) + payload)
+        except (OSError, ValueError, struct.error):
+            pass
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        with self._cv:
+            if op == "join":
+                mid = self._next_mid
+                self._next_mid += 1
+                self._members[mid] = {
+                    "host": req["host"],
+                    "data_port": int(req["data_port"]),
+                    "beat": time.monotonic(),
+                }
+                self._bump_epoch_locked()
+                return {"mid": mid, **self._view_locked()}
+            if op == "beat":
+                m = self._members.get(req["mid"])
+                if m is not None:
+                    m["beat"] = time.monotonic()
+                return {"epoch": self.epoch,
+                        "member": req["mid"] in self._members}
+            if op == "view":
+                return self._view_locked()
+            if op == "leave":
+                if self._members.pop(req["mid"], None) is not None:
+                    self._bump_epoch_locked()
+                return {"ok": True}
+            if op == "shrink":
+                if len(self._members) <= 1:
+                    return {"ok": False, "error": "cannot shrink below 1"}
+                victim = max(self._members)
+                del self._members[victim]
+                self._bump_epoch_locked()
+                return {"ok": True, "evicted": victim,
+                        "epoch": self.epoch}
+            if op == "barrier":
+                return self._barrier_locked(req)
+        if op == "grow":
+            if self._on_grow is None:
+                return {"ok": False, "error": "no grow hook"}
+            self._on_grow()  # the new member's join bumps the epoch
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _barrier_locked(self, req: dict) -> dict:
+        mid, epoch = int(req["mid"]), int(req["epoch"])
+        deadline = time.monotonic() + float(req.get("timeout", 300))
+        if epoch != self.epoch or mid not in self._members:
+            return {"stale": True, "epoch": self.epoch}
+        arrived = self._barriers.setdefault(epoch, {})
+        arrived[mid] = req.get("value")
+        self._cv.notify_all()
+        while True:
+            if self.epoch != epoch:
+                return {"stale": True, "epoch": self.epoch}
+            if set(arrived) >= set(self._epoch_members):
+                return {"ok": True,
+                        "vals": {str(m): v for m, v in arrived.items()}}
+            if not self._cv.wait(min(1.0, deadline - time.monotonic())):
+                if time.monotonic() >= deadline:
+                    return {"stale": True, "epoch": self.epoch,
+                            "timeout": True}
+
+    def _monitor_loop(self) -> None:
+        while not self._closed:
+            hb = float(constants.get("elastic_heartbeat_seconds"))
+            time.sleep(hb)
+            cutoff = time.monotonic() - _DEAD_BEATS * hb
+            with self._cv:
+                dead = [m for m, info in self._members.items()
+                        if info["beat"] < cutoff]
+                for m in dead:
+                    del self._members[m]
+                if dead:
+                    self._bump_epoch_locked()
+
+    def members(self) -> List[int]:
+        with self._cv:
+            return list(self._epoch_members)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# elastic state: the arrays a member carries across resizes
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("name", "kind", "init", "n", "dtype",
+                 "full", "shard", "replica")
+
+    def __init__(self, name: str, kind: str, init: np.ndarray):
+        self.name = name
+        self.kind = kind
+        self.init = np.ascontiguousarray(init).reshape(-1)
+        self.n = int(self.init.shape[0])
+        self.dtype = self.init.dtype
+        self.full: Optional[np.ndarray] = None      # replicated arrays
+        self.shard: Optional[np.ndarray] = None     # my primary shard
+        self.replica: Optional[np.ndarray] = None   # predecessor's mirror
+
+
+class ElasticState:
+    """The named arrays that survive resizes. ``kind``:
+
+    - ``'replicated'`` — every member holds the full array (params);
+      on resize, re-synced from the agreed anchor member.
+    - ``'sharded'`` — contiguous :class:`~.core.Layout` shard per
+      member (optimizer state), plus the ring replica of the
+      predecessor's shard (refreshed each step) that makes one death
+      survivable.
+
+    ``init`` arrays must be identical on every member (deterministic
+    init) — the cold-attach path scatters them without any traffic."""
+
+    def __init__(self):
+        self.entries: Dict[str, _Entry] = {}
+        self.initialized = False
+
+    def add(self, name: str, init, kind: str = "sharded") -> None:
+        if kind not in ("sharded", "replicated"):
+            raise ValueError(f"kind must be sharded|replicated, got {kind!r}")
+        self.entries[name] = _Entry(name, kind, np.asarray(init))
+
+    def names(self) -> List[str]:
+        return sorted(self.entries)
+
+    def aid(self, name: str) -> int:
+        return self.names().index(name)
+
+
+# ---------------------------------------------------------------------------
+# member
+# ---------------------------------------------------------------------------
+
+
+class _View:
+    __slots__ = ("epoch", "members", "prev", "history")
+
+    def __init__(self, d: dict):
+        self.epoch = int(d["epoch"])
+        self.members = [(int(m), h, int(p)) for m, h, p in d["members"]]
+        self.prev = [int(m) for m in d.get("prev", [])]
+        self.history = {
+            int(e): [int(m) for m in ms]
+            for e, ms in d.get("history", {}).items()
+        }
+
+    def mids(self) -> List[int]:
+        return [m for m, _, _ in self.members]
+
+    def rank_of(self, mid: int) -> int:
+        return self.mids().index(mid)
+
+    def addr_of(self, mid: int) -> Tuple[str, int]:
+        for m, h, p in self.members:
+            if m == mid:
+                return (h, p)
+        raise KeyError(mid)
+
+
+class ElasticMember:
+    """One process's elastic handle: control plane + peer data plane.
+
+    The data plane is a tiny framed protocol: each frame carries
+    ``(kind, epoch, src_mid, array id, tag, offset, bytes)`` and lands
+    in an inbox the reader threads always drain — so a peer's send can
+    never deadlock against ours. Frames below the epoch being resized
+    to are dropped on arrival (stale world); frames ahead of us are
+    buffered (a peer may enter the next epoch first)."""
+
+    def __init__(self, coordinator, state: ElasticState,
+                 host: str = "127.0.0.1"):
+        if isinstance(coordinator, ElasticCoordinator):
+            coordinator = coordinator.address
+        if isinstance(coordinator, str):
+            h, _, p = coordinator.rpartition(":")
+            coordinator = (h, int(p))
+        self.coord = (coordinator[0], int(coordinator[1]))
+        self.state = state
+        self._cv = threading.Condition(
+            _lockmon.make_lock("elastic.py:Member._cv")
+        )
+        self._inbox: List[tuple] = []
+        self._accept_epoch = 0
+        self._known_epoch = 0
+        self._evicted = False
+        self._closed = False
+        self._view: Optional[_View] = None
+        self._addrs: Dict[int, Tuple[str, int]] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_guard = _lockmon.make_lock("elastic.py:Member._conns")
+        self.last_resize_stats: Dict[str, Any] = {}
+        # called with the agreed resume step AFTER the resize barrier
+        # but BEFORE redistribution: a trainer uses it to reconcile a
+        # torn step the anchor committed but this member did not (the
+        # missed-apply counterpart of the staged-commit no-double-apply
+        # rule — see ElasticZero1._apply_stash)
+        self.on_agreed_step: Optional[Callable[[int], None]] = None
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, 0))
+        self._srv.listen(64)
+        self.data_port = self._srv.getsockname()[1]
+        threading.Thread(
+            target=self._accept_loop, name="tm-elastic-data", daemon=True
+        ).start()
+        rep = _json_roundtrip(
+            self.coord,
+            {"op": "join", "host": host, "data_port": self.data_port},
+        )
+        self.mid = int(rep["mid"])
+        self._note_epoch(int(rep["epoch"]))
+        threading.Thread(
+            target=self._beat_loop, name="tm-elastic-beat", daemon=True
+        ).start()
+
+    # -- data plane --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while not self._closed:
+                    hdr = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                    payload = _recv_exact(conn, hdr[6]) if hdr[6] else b""
+                    with self._cv:
+                        if hdr[1] >= self._accept_epoch:
+                            self._inbox.append((hdr, payload))
+                            self._cv.notify_all()
+        except (ConnectionError, OSError, struct.error):
+            pass
+
+    def _send(self, mid: int, kind: int, epoch: int, aid: int, tag: int,
+              off: int, payload) -> None:
+        """One frame to a peer; reconnects once on a broken pipe. A peer
+        that stays unreachable raises — the caller's epoch poll turns
+        that into an EpochChanged retry once the coordinator notices."""
+        if self._closed:
+            # a closed member is DEAD to the world: it must go silent,
+            # not keep half-feeding peers frames that let them partially
+            # complete a step the resize is about to supersede
+            raise ConnectionError("elastic member is closed")
+        data = bytes(payload)
+        with self._conn_guard:
+            lock = self._send_locks.setdefault(
+                mid, _lockmon.make_lock("elastic.py:Member._send_locks[]")
+            )
+        for attempt in (0, 1):
+            with lock:
+                try:
+                    with self._conn_guard:
+                        sock = self._conns.get(mid)
+                    if sock is None:
+                        sock = socket.create_connection(
+                            self._addrs[mid], timeout=30
+                        )
+                        with self._conn_guard:
+                            self._conns[mid] = sock
+                    sock.sendall(
+                        _HDR.pack(kind, epoch, self.mid, aid, tag, off,
+                                  len(data)) + data
+                    )
+                    return
+                except (OSError, KeyError) as e:
+                    with self._conn_guard:
+                        dead = self._conns.pop(mid, None)
+                    if dead is not None:
+                        try:
+                            dead.close()
+                        except OSError:
+                            pass
+                    if attempt:
+                        raise ConnectionError(
+                            f"elastic peer {mid} unreachable: {e}"
+                        ) from e
+
+    def _send_chunked(self, mid: int, kind: int, epoch: int, aid: int,
+                      tag: int, base_off: int, arr: np.ndarray) -> int:
+        """Chunk ``arr`` by ``reshard_chunk_bytes`` — the one bounded-
+        memory rule every elastic byte obeys. Returns the peak chunk
+        size in bytes (the caller's scratch-bound evidence)."""
+        celems = chunk_elems_for(arr.dtype.itemsize)
+        peak = 0
+        for s, e in chunk_spans(arr.shape[0], celems):
+            chunk = np.ascontiguousarray(arr[s:e])
+            peak = max(peak, chunk.nbytes)
+            self._send(mid, kind, epoch, aid, tag, base_off + s,
+                       chunk.tobytes())
+        return peak
+
+    def _take(self, epoch: int, pred, deadline: float) -> tuple:
+        """Pop the first inbox frame matching ``pred``; while waiting,
+        an epoch advance raises EpochChanged (the mid-collective escape
+        that turns a peer death into a retry instead of a hang)."""
+        with self._cv:
+            while True:
+                for i, (hdr, payload) in enumerate(self._inbox):
+                    if hdr[1] == epoch and pred(hdr):
+                        del self._inbox[i]
+                        return hdr, payload
+                if self._known_epoch > epoch:
+                    raise EpochChanged(self._known_epoch)
+                if self._evicted:
+                    raise Evicted()
+                if not self._cv.wait(timeout=0.25):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"elastic collective starved at epoch {epoch}"
+                        )
+
+    # -- control plane -----------------------------------------------------
+    def _note_epoch(self, epoch: int) -> None:
+        with self._cv:
+            if epoch > self._known_epoch:
+                self._known_epoch = epoch
+                self._cv.notify_all()
+
+    def _beat_loop(self) -> None:
+        while not self._closed:
+            time.sleep(float(constants.get("elastic_heartbeat_seconds")))
+            try:
+                rep = _json_roundtrip(
+                    self.coord, {"op": "beat", "mid": self.mid}, timeout=10
+                )
+            except (OSError, ValueError):
+                continue
+            self._note_epoch(int(rep["epoch"]))
+            if not rep.get("member", True):
+                with self._cv:
+                    self._evicted = True
+                    self._cv.notify_all()
+
+    def _fetch_view(self) -> _View:
+        view = _View(_json_roundtrip(self.coord, {"op": "view"}))
+        self._note_epoch(view.epoch)
+        return view
+
+    @property
+    def epoch(self) -> int:
+        return self._view.epoch if self._view is not None else 0
+
+    def epoch_changed(self) -> bool:
+        return self._known_epoch > self.epoch or self._evicted
+
+    def wait_world(self, n: int, timeout: float = 120.0) -> None:
+        """Block until the membership holds >= n members (initial
+        formation; call before the first :meth:`sync`)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            view = self._fetch_view()
+            if len(view.members) >= n:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic world never reached {n} members "
+                    f"(have {len(view.members)})"
+                )
+            time.sleep(0.05)
+
+    # -- the resize barrier ------------------------------------------------
+    def sync(self, step: int = 0) -> dict:
+        """The resize barrier: cheap no-op while the epoch is unchanged;
+        on a membership change, agree on the new world via the
+        coordinator barrier and redistribute every registered array.
+        Returns ``{"epoch", "rank", "world", "step", "resized"}`` —
+        ``step`` is the agreed resume step (the max completed step any
+        stateful survivor reported) after a resize, else the caller's.
+
+        Raises :class:`Evicted` when this member was shrunk away."""
+        if self._evicted:
+            raise Evicted()
+        if (
+            self.state.initialized
+            and self._view is not None
+            and self._known_epoch == self._view.epoch
+        ):
+            return {
+                "epoch": self._view.epoch,
+                "rank": self._view.rank_of(self.mid),
+                "world": len(self._view.members),
+                "step": step,
+                "resized": False,
+            }
+        while True:
+            try:
+                return self._resize(step)
+            except EpochChanged:
+                continue
+            except ConnectionError:
+                # a peer died mid-resize: wait for the coordinator to
+                # publish the post-death epoch, then redo the barrier
+                target = self._known_epoch
+                deadline = time.monotonic() + 60
+                while self._known_epoch <= target:
+                    if self._evicted:
+                        raise Evicted() from None
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+
+    def _resize(self, step: int) -> dict:
+        view = self._fetch_view()
+        if self.mid not in view.mids():
+            with self._cv:
+                self._evicted = True
+            raise Evicted()
+        epoch = view.epoch
+        with self._cv:
+            # accept the new epoch's frames from now on; drop stale ones
+            self._accept_epoch = epoch
+            self._inbox = [f for f in self._inbox if f[0][1] >= epoch]
+        # address book of the world being resized TO (joiners are not in
+        # the old view); stale per-mid sockets reconnect lazily
+        self._addrs.update({m: (h, p) for m, h, p in view.members})
+        entry = None
+        if _flight.enabled():
+            entry = _flight.recorder.record(
+                "resize", "resize.enter",
+                payload=f"{len(view.prev)}->{len(view.members)}",
+                backend="elastic", routing=f"mid={self.mid}", seq=epoch,
+            )
+        t0 = time.monotonic()
+        rep = _json_roundtrip(self.coord, {
+            "op": "barrier", "mid": self.mid, "epoch": epoch,
+            "value": {"step": int(step),
+                      "stateful": bool(self.state.initialized),
+                      "was": self._view.epoch if self._view else -1},
+        }, timeout=330)
+        if rep.get("stale"):
+            self._note_epoch(int(rep["epoch"]))
+            if entry is not None:
+                _flight.FlightRecorder.fail(entry)
+            raise EpochChanged(int(rep["epoch"]))
+        vals = {int(m): v for m, v in rep["vals"].items()}
+        stateful = {m for m, v in vals.items() if v.get("stateful")}
+        stats: Dict[str, Any] = {
+            "epoch": epoch, "old_world": len(view.prev),
+            "new_world": len(view.members), "peak_chunk_bytes": 0,
+            "largest_shard_bytes": 0, "wire_bytes": 0, "cold": False,
+        }
+        if not stateful:
+            self._cold_attach(view)
+            stats["cold"] = True
+            agreed = 0
+        else:
+            agreed = self._redistribute(view, vals, stateful, stats)
+        self._view = view
+        self.state.initialized = True
+        stats["seconds"] = time.monotonic() - t0
+        self.last_resize_stats = stats
+        try:
+            if epoch > int(constants.get("resize_epoch")):
+                # one set() = one generation() bump: every generation-
+                # stamped cache in this process invalidates coherently
+                constants.set("resize_epoch", epoch)
+        except constants.FrozenConstantsError:
+            pass
+        if entry is not None:
+            _flight.FlightRecorder.complete(entry)
+        return {
+            "epoch": epoch,
+            "rank": view.rank_of(self.mid),
+            "world": len(view.members),
+            "step": agreed,
+            "resized": True,
+        }
+
+    def _cold_attach(self, view: _View) -> None:
+        """First stable epoch: scatter the deterministic init arrays —
+        identical on every member, so zero bytes move."""
+        k, r = len(view.members), view.rank_of(self.mid)
+        for e in self.state.entries.values():
+            if e.kind == "replicated":
+                e.full = e.init.copy()
+            else:
+                lay = Layout(k)
+                s, en = lay.interval(e.n, r)
+                e.shard = e.init[s:en].copy()
+                ps, pe = lay.interval(e.n, (r - 1) % k)
+                e.replica = e.init[ps:pe].copy() if k > 1 else None
+
+    def _redistribute(self, view: _View, vals: Dict[int, dict],
+                      stateful: set, stats: Dict[str, Any]) -> int:
+        """Move every array from the previous epoch's layout to the new
+        one. Transfer sources resolve to the primary holder when it
+        survived, else to its ring-replica holder (the single-death
+        contract); the joiningest member is a pure receiver. Replicated
+        arrays re-sync from the anchor — the stateful survivor with the
+        highest completed step — which also defines the agreed resume
+        step, superseding any step the death tore mid-collective."""
+        epoch = view.epoch
+        mids = view.mids()
+        # the SOURCE layout is the world the survivors last COMMITTED —
+        # normally epoch-1 (== view.prev), but a resize aborted by a
+        # second membership change leaves them on an older epoch, whose
+        # member list only the coordinator's history knows. Mixed
+        # commit epochs (some members finished the aborted resize)
+        # cannot be redistributed coherently: fail loudly.
+        was = {int(vals[m].get("was", -1)) for m in stateful}
+        if len(was) > 1:
+            raise DataLoss(
+                f"epoch {epoch}: survivors hold mixed resize layouts "
+                f"(committed epochs {sorted(was)}) after an aborted "
+                "resize — restore from checkpoint"
+            )
+        prev = view.history.get(next(iter(was)), view.prev) or view.prev
+        k_old, k_new = len(prev), len(mids)
+        r_new = view.rank_of(self.mid)
+        deadline = time.monotonic() + 300
+        survivors = [m for m in prev if m in mids and m in stateful]
+        if not survivors:
+            raise DataLoss(
+                f"epoch {epoch}: no stateful survivor from {prev}"
+            )
+        anchor = max(
+            survivors, key=lambda m: (vals[m].get("step", 0), -m)
+        )
+        agreed = int(vals[anchor].get("step", 0))
+        if self.on_agreed_step is not None:
+            # reconcile BEFORE any transfer reads this member's shards:
+            # if the anchor committed the step this member tore, the
+            # staged update commits now, so every redistribution source
+            # is on the agreed step
+            self.on_agreed_step(agreed)
+
+        def live_src(old_rank: int) -> Tuple[int, bool]:
+            """(member, from_replica) serving old shard ``old_rank``."""
+            m = prev[old_rank]
+            if m in mids and m in stateful:
+                return m, False
+            holder = prev[(old_rank + 1) % k_old]
+            if holder in mids and holder in stateful and k_old > 1:
+                return holder, True
+            raise DataLoss(
+                f"shard {old_rank}: primary {m} and replica holder "
+                f"{prev[(old_rank + 1) % k_old]} both gone in epoch {epoch}"
+            )
+
+        # STAGED commit: nothing overwrites a source buffer until every
+        # array landed — a resize attempt aborted by a second membership
+        # change (EpochChanged/ConnectionError mid-transfer) must leave
+        # the old-layout shards intact for the retry's plan to read
+        staged: Dict[str, tuple] = {}
+        for aid, name in enumerate(self.state.names()):
+            e = self.state.entries[name]
+            itemsize = e.dtype.itemsize
+            if e.kind == "replicated":
+                if self.mid == anchor:
+                    for m in mids:
+                        if m != self.mid:
+                            stats["peak_chunk_bytes"] = max(
+                                stats["peak_chunk_bytes"],
+                                self._send_chunked(
+                                    m, K_FULL, epoch, aid, 0, 0, e.full
+                                ),
+                            )
+                            stats["wire_bytes"] += e.full.nbytes
+                else:
+                    buf = np.empty(e.n, e.dtype)
+                    got = 0
+                    while got < buf.nbytes:
+                        hdr, payload = self._take(
+                            epoch,
+                            lambda h, a=aid: h[0] == K_FULL and h[3] == a,
+                            deadline,
+                        )
+                        off = hdr[5]
+                        part = np.frombuffer(payload, e.dtype)
+                        buf[off:off + part.shape[0]] = part
+                        got += len(payload)
+                        stats["peak_chunk_bytes"] = max(
+                            stats["peak_chunk_bytes"], len(payload)
+                        )
+                    stats["wire_bytes"] += got
+                    staged[name] = ("full", buf)
+                continue
+
+            lay_old, lay_new = Layout(k_old), Layout(k_new)
+            transfers = plan_transfers(e.n, lay_old, lay_new)
+            my_s, my_e = lay_new.interval(e.n, r_new)
+            new_shard = np.empty(max(0, my_e - my_s), e.dtype)
+            stats["largest_shard_bytes"] = max(
+                stats["largest_shard_bytes"],
+                max(
+                    (en - s) * itemsize
+                    for lay, kk in ((lay_old, k_old), (lay_new, k_new))
+                    for s, en in lay.intervals(e.n)
+                ),
+            )
+            expect = 0
+            for t in transfers:
+                src_m, from_replica = live_src(t.src)
+                dst_m = mids[t.dst]
+                if src_m == self.mid:
+                    src_buf = e.replica if from_replica else e.shard
+                    view_src = src_buf[t.src_off:t.src_off + t.n]
+                    if dst_m == self.mid:
+                        new_shard[t.dst_off:t.dst_off + t.n] = view_src
+                    else:
+                        stats["peak_chunk_bytes"] = max(
+                            stats["peak_chunk_bytes"],
+                            self._send_chunked(
+                                dst_m, K_SHARD, epoch, aid, 0, t.dst_off,
+                                view_src,
+                            ),
+                        )
+                        stats["wire_bytes"] += t.n * itemsize
+                elif dst_m == self.mid:
+                    expect += t.n * itemsize
+            got = 0
+            while got < expect:
+                hdr, payload = self._take(
+                    epoch, lambda h, a=aid: h[0] == K_SHARD and h[3] == a,
+                    deadline,
+                )
+                off = hdr[5]
+                part = np.frombuffer(payload, e.dtype)
+                new_shard[off:off + part.shape[0]] = part
+                got += len(payload)
+                stats["peak_chunk_bytes"] = max(
+                    stats["peak_chunk_bytes"], len(payload)
+                )
+            stats["wire_bytes"] += got
+            # ring-replica re-formation on the NEW world: my fresh shard
+            # mirrors to my successor; my predecessor's mirrors here
+            rep_buf = None
+            if k_new > 1:
+                succ = mids[(r_new + 1) % k_new]
+                self._send_chunked(
+                    succ, K_REPL, epoch, aid, 0, 0, new_shard
+                )
+                ps, pe = lay_new.interval(e.n, (r_new - 1) % k_new)
+                rep_buf = np.empty(max(0, pe - ps), e.dtype)
+                got = 0
+                while got < rep_buf.nbytes:
+                    hdr, payload = self._take(
+                        epoch,
+                        lambda h, a=aid: h[0] == K_REPL and h[3] == a
+                        and h[4] == 0,
+                        deadline,
+                    )
+                    off = hdr[5]
+                    part = np.frombuffer(payload, e.dtype)
+                    rep_buf[off:off + part.shape[0]] = part
+                    got += len(payload)
+            staged[name] = ("shard", new_shard, rep_buf)
+        for name, ent in staged.items():
+            e = self.state.entries[name]
+            if ent[0] == "full":
+                e.full = ent[1]
+            else:
+                e.shard, e.replica = ent[1], ent[2]
+        return agreed
+
+    # -- step collectives (the host-zero1 data plane) ----------------------
+    def reduce_scatter_sum(self, vec: np.ndarray, step: int,
+                           timeout: float = 120.0) -> np.ndarray:
+        """Sum ``vec`` across members, returning MY Layout slice of the
+        sum. Chunked sends to every peer's slice; contributions
+        accumulate as they arrive."""
+        view = self._view
+        epoch, k = view.epoch, len(view.members)
+        r = view.rank_of(self.mid)
+        lay = Layout(k)
+        vec = np.ascontiguousarray(vec)
+        s, e = lay.interval(vec.shape[0], r)
+        acc = vec[s:e].astype(vec.dtype, copy=True)
+        deadline = time.monotonic() + timeout
+        for dst, (ds, de) in enumerate(lay.intervals(vec.shape[0])):
+            if dst == r or de <= ds:
+                continue
+            self._send_chunked(
+                view.members[dst][0], K_RS, epoch, 0, step, 0,
+                vec[ds:de],
+            )
+        expect = (k - 1) * acc.nbytes
+        got = 0
+        while got < expect:
+            hdr, payload = self._take(
+                epoch, lambda h: h[0] == K_RS and h[4] == step, deadline
+            )
+            part = np.frombuffer(payload, vec.dtype)
+            off = hdr[5]
+            acc[off:off + part.shape[0]] += part
+            got += len(payload)
+        return acc
+
+    def allgather(self, out: np.ndarray, my_slice: np.ndarray, step: int,
+                  timeout: float = 120.0) -> None:
+        """Fill ``out`` with every member's Layout slice; ``my_slice``
+        is this rank's contribution (offsets are GLOBAL)."""
+        view = self._view
+        epoch, k = view.epoch, len(view.members)
+        r = view.rank_of(self.mid)
+        lay = Layout(k)
+        s, e = lay.interval(out.shape[0], r)
+        out[s:e] = my_slice
+        deadline = time.monotonic() + timeout
+        for dst, (m, _, _) in enumerate(view.members):
+            if dst != r:
+                self._send_chunked(m, K_AG, epoch, 0, step, s, my_slice)
+        expect = out.nbytes - my_slice.nbytes
+        got = 0
+        while got < expect:
+            hdr, payload = self._take(
+                epoch, lambda h: h[0] == K_AG and h[4] == step, deadline
+            )
+            part = np.frombuffer(payload, out.dtype)
+            off = hdr[5]
+            out[off:off + part.shape[0]] = part
+            got += len(payload)
+
+    def exchange_replica(self, name: str, shard: np.ndarray, step: int,
+                         timeout: float = 120.0) -> Optional[np.ndarray]:
+        """Per-step ring-replica exchange, STAGED: send ``shard`` (the
+        value my shard of ``name`` is about to become) to my successor
+        and return my predecessor's counterpart — without committing
+        either side here. The caller commits shard and replica together
+        once every exchange of the step completed, so a death mid-step
+        can never leave shard and replica on different steps (the
+        replica is the death-recovery source). Returns ``None`` at
+        world size 1."""
+        view = self._view
+        k = len(view.members)
+        if k <= 1:
+            return None
+        epoch, r = view.epoch, view.rank_of(self.mid)
+        aid = self.state.aid(name)
+        e = self.state.entries[name]
+        self._send_chunked(
+            view.members[(r + 1) % k][0], K_REPL, epoch, aid, step + 1, 0,
+            np.ascontiguousarray(shard),
+        )
+        deadline = time.monotonic() + timeout
+        fresh = np.empty_like(e.replica)
+        got = 0
+        while got < fresh.nbytes:
+            hdr, payload = self._take(
+                epoch,
+                lambda h: h[0] == K_REPL and h[3] == aid
+                and h[4] == step + 1,
+                deadline,
+            )
+            part = np.frombuffer(payload, e.dtype)
+            off = hdr[5]
+            fresh[off:off + part.shape[0]] = part
+            got += len(payload)
+        return fresh
+
+    def leave(self) -> None:
+        try:
+            _json_roundtrip(
+                self.coord, {"op": "leave", "mid": self.mid}, timeout=10
+            )
+        except (OSError, ValueError):
+            pass
+        self.close()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conn_guard:
+            conns, self._conns = dict(self._conns), {}
+        for c in conns.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        with self._cv:
+            self._cv.notify_all()
+
+
+def from_env(state: ElasticState) -> ElasticMember:
+    """Member bootstrap inside ``launch --elastic`` workers: the
+    coordinator address rides the TORCHMPI_TPU_ELASTIC env var.
+    ``launch --set-constant`` knob overrides apply here too (elastic
+    workers need not call ``start()`` — the host data plane has no jax
+    runtime dependency)."""
+    addr = os.environ.get("TORCHMPI_TPU_ELASTIC")
+    if not addr:
+        raise RuntimeError(
+            "TORCHMPI_TPU_ELASTIC is not set — run under "
+            "`python -m torchmpi_tpu.launch --elastic ...` or pass a "
+            "coordinator address to ElasticMember explicitly"
+        )
+    from ..runtime_state import _apply_env_constants
+
+    _apply_env_constants()
+    return ElasticMember(addr, state)
+
+
+# ---------------------------------------------------------------------------
+# host-zero1 elastic trainer
+# ---------------------------------------------------------------------------
+
+
+class ElasticZero1:
+    """Host-level ZeRO-1 data-parallel SGD over the elastic data plane.
+
+    Params replicated on every member; momentum SHARDED (the zero1
+    memory shape) with the per-step ring replica that makes a death
+    recoverable. One step:
+
+    1. ``grad_fn(params, rank, world) -> (loss, grad)`` — the caller's
+       local gradient on its data assignment;
+    2. gradient reduce-scatter (each member receives the summed slice
+       of its momentum shard);
+    3. sharded update: ``m = mu*m + g/world``; ``p_slice -= lr*m``;
+    4. parameter allgather (everyone gets the new full params);
+    5. momentum-replica refresh to the ring successor.
+
+    A membership change anywhere in 1-5 raises through the collectives
+    and the step retries after :meth:`ElasticMember.sync` redistributed
+    the state — the loss curve continues on the new world.
+    """
+
+    def __init__(self, member: ElasticMember, params: np.ndarray,
+                 lr: float = 0.1, momentum: float = 0.9):
+        self.member = member
+        p = np.asarray(params, np.float32).reshape(-1)
+        member.state.add("params", p, kind="replicated")
+        member.state.add("momentum", np.zeros_like(p), kind="sharded")
+        self.lr, self.mu = float(lr), float(momentum)
+        self.step_idx = 0
+        self._stash: Optional[dict] = None
+        member.on_agreed_step = self._apply_stash
+
+    def _apply_stash(self, agreed: int) -> None:
+        """Resize-barrier reconciliation: a step is torn when SOME
+        member aborts it mid-exchange while the anchor committed it
+        (agreed step = mine + 1). The anchor can only have committed if
+        every member reached its replica-exchange send — which happens
+        after ``new_mom`` was staged — so the stash always exists here,
+        and committing it puts this member's momentum shard on the
+        agreed step before redistribution reads it. Without this, the
+        shard would permanently miss one update (the missed-apply dual
+        of the double-apply the staged commit prevents)."""
+        st, self._stash = self._stash, None
+        view = self.member._view
+        if (
+            st is not None
+            and view is not None
+            and st["epoch"] == view.epoch
+            and st["step"] == self.step_idx
+            and agreed == st["step"] + 1
+        ):
+            self.member.state.entries["momentum"].shard[:] = st["mom"]
+
+    @property
+    def params(self) -> np.ndarray:
+        return self.member.state.entries["params"].full
+
+    def step(self, grad_fn) -> float:
+        m = self.member
+        while True:
+            role = m.sync(self.step_idx)
+            if role["resized"]:
+                self.step_idx = role["step"]
+            rank, world = role["rank"], role["world"]
+            st = self.member.state.entries
+            try:
+                loss, grad = grad_fn(st["params"].full, rank, world)
+                grad = np.asarray(grad, np.float32).reshape(-1)
+                gsum = m.reduce_scatter_sum(grad, self.step_idx)
+                # STAGED update: nothing commits until every exchange of
+                # the step — allgather AND replica refresh — completed.
+                # Committing earlier lets a death between the commit and
+                # the refresh retry the step against already-updated
+                # state (a double-applied update) with the dead rank's
+                # half rebuilt from a one-step-stale replica.
+                new_mom = self.mu * st["momentum"].shard + gsum / world
+                # stash the staged update for _apply_stash: from here on
+                # peers may complete the step using our sends even if WE
+                # abort, and the resize agreement will tell us whether
+                # the step counts (agreed step == ours + 1)
+                self._stash = {"epoch": role["epoch"],
+                               "step": self.step_idx, "mom": new_mom}
+                lay = Layout(world)
+                s, e = lay.interval(grad.shape[0], rank)
+                new_slice = st["params"].full[s:e] - self.lr * new_mom
+                new_params = np.empty_like(st["params"].full)
+                m.allgather(new_params, new_slice, self.step_idx)
+                new_replica = m.exchange_replica(
+                    "momentum", new_mom, self.step_idx
+                )
+                st["params"].full[:] = new_params
+                st["momentum"].shard[:] = new_mom
+                if new_replica is not None:
+                    st["momentum"].replica[:] = new_replica
+                self._stash = None
+                self.step_idx += 1
+                return float(loss)
+            except EpochChanged:
+                continue
+            except ConnectionError:
+                # a peer died under a send before the coordinator
+                # noticed: wait out the heartbeat detection, then retry
+                # the step against the post-death world
+                if m._closed:
+                    raise
+                deadline = time.monotonic() + 60
+                while not m.epoch_changed():
+                    if m._closed or time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+                continue
+
+
+def _main(argv=None) -> int:
+    """Operator CLI: ``python -m torchmpi_tpu.reshard.elastic grow
+    host:port`` / ``... shrink host:port``."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m torchmpi_tpu.reshard.elastic",
+        description="send an operator command to a live elastic job",
+    )
+    ap.add_argument("command", choices=["grow", "shrink", "view"])
+    ap.add_argument("address", help="coordinator host:port "
+                    "(see launch --elastic-addr-file)")
+    args = ap.parse_args(argv)
+    rep = operator_request(args.address, args.command)
+    print(json.dumps(rep))
+    return 0 if rep.get("ok", True) else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -304,7 +304,9 @@ def rank_stragglers(ranks: Dict[int, dict]) -> dict:
     samples = 0
     max_spread = 0.0
     for comm, by_rank in _collective_streams(ranks).items():
-        if len(by_rank) < 2:
+        if len(by_rank) < 2 or comm == _RESIZE_COMM:
+            # resize barrier entries spread by design (the first rank
+            # in waits for the last) — analyze_resizes owns that comm
             continue
         common = set.intersection(*(set(s) for s in by_rank.values()))
         for seq in common:
@@ -424,6 +426,81 @@ def ps_health(ranks: Dict[int, dict]) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# resize-epoch analysis
+# ---------------------------------------------------------------------------
+
+# the reserved flight comm key resize barriers record under (engine
+# resize, elastic member resize, PS chain re-formation); seq == epoch
+_RESIZE_COMM = "resize"
+
+
+def analyze_resizes(run: dict) -> dict:
+    """Group ``resize.*`` flight entries by epoch and name any rank
+    that never entered the resize barrier — the rank a resize hangs on.
+    Entries are recorded with ``seq = resize epoch`` and an identical
+    payload on every participant, so a missing (rank, epoch) pair IS
+    the diagnosis; heartbeats cover ranks that died without dumping."""
+    ranks = run["ranks"]
+    per_rank: Dict[int, Dict[int, dict]] = {}
+    for rank, data in ranks.items():
+        for e in _flight_entries(data):
+            if e["comm"] == _RESIZE_COMM:
+                per_rank.setdefault(rank, {})[e["seq"]] = e
+    if not per_rank:
+        return {"status": "none", "epochs": {}}
+    all_ranks = set(ranks)
+    for tag in run.get("heartbeats", {}):
+        try:
+            all_ranks.add(int(tag))
+        except ValueError:
+            pass
+    epochs = {}
+    clean = True
+    for epoch in sorted({s for m in per_rank.values() for s in m}):
+        entered = sorted(r for r, m in per_rank.items() if epoch in m)
+        # only ranks alive at (or after) the epoch can be expected in
+        # its barrier: a rank whose dump/heartbeat never reached this
+        # epoch's FIRST entry time was the death the resize responded
+        # to, not a straggler
+        t0 = min(
+            float(per_rank[r][epoch]["t_issue"]) for r in entered
+        )
+        expected = set(entered)
+        for r in all_ranks - set(entered):
+            # expected = the rank existed BEFORE the epoch fired (some
+            # entry at/below t0 — a later joiner is not a straggler)
+            # AND showed life AT/after it (an entry or heartbeat past
+            # t0 — the death the resize responded to is not one either)
+            data = ranks.get(r)
+            born_before = alive_past = False
+            if data is not None:
+                for e in _flight_entries(data):
+                    t = float(e["t_issue"])
+                    born_before |= t <= t0
+                    alive_past |= t >= t0
+            beat = run.get("heartbeats", {}).get(str(r))
+            if beat and float(beat.get("time", 0)) >= t0:
+                alive_past = True
+            if born_before and alive_past:
+                expected.add(r)
+        never = sorted(expected - set(entered))
+        failed = sorted(
+            r for r in entered
+            if per_rank[r][epoch].get("status") == "failed"
+        )
+        if never or failed:
+            clean = False
+        epochs[str(epoch)] = {
+            "entered": entered,
+            "never_entered": never,
+            "failed": failed,
+            "payload": per_rank[entered[0]][epoch]["payload"]
+            if entered else "",
+        }
+    return {"status": "ok" if clean else "incomplete", "epochs": epochs}
+
+
+# ---------------------------------------------------------------------------
 # hang analysis
 # ---------------------------------------------------------------------------
 
@@ -517,6 +594,7 @@ def analyze(telemetry_dir, run: Optional[dict] = None) -> dict:
         "desync": detect_desync(ranks),
         "stragglers": rank_stragglers(ranks),
         "ps": ps_health(ranks),
+        "resize": analyze_resizes(run),
         "hangs": analyze_hangs(run),
     }
     return report
@@ -552,6 +630,31 @@ def _summary_lines(report: dict) -> List[str]:
         )
     else:
         lines.append("straggler: none")
+    rz = report.get("resize", {"status": "none"})
+    if rz["status"] == "none":
+        lines.append("resize: none")
+    else:
+        bad = {
+            ep: info for ep, info in rz["epochs"].items()
+            if info["never_entered"] or info["failed"]
+        }
+        if not bad:
+            lines.append(
+                f"resize: {len(rz['epochs'])} epoch(s), every live rank "
+                "entered the barrier"
+            )
+        for ep, info in sorted(bad.items(), key=lambda kv: int(kv[0])):
+            detail = []
+            if info["never_entered"]:
+                detail.append(
+                    f"never entered by ranks {info['never_entered']}"
+                )
+            if info["failed"]:
+                detail.append(f"failed on ranks {info['failed']}")
+            lines.append(
+                f"resize: epoch {ep} ({info['payload']}) "
+                + "; ".join(detail)
+            )
     if report["hangs"]:
         for h in report["hangs"]:
             for d in h["stuck_collectives"]:

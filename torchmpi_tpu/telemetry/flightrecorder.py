@@ -136,11 +136,12 @@ class FlightRecorder:
 
     def record_complete(self, comm: str, op: str, t_issue: float,
                         t_complete: float, payload=None, wire: str = "",
-                        backend: str = "", routing: str = "") -> list:
+                        backend: str = "", routing: str = "",
+                        seq: Optional[int] = None) -> list:
         """Record an already-finished event (engine steps time themselves
         and report after the fact) with explicit wall timestamps."""
         entry = self.record(comm, op, payload=payload, wire=wire,
-                            backend=backend, routing=routing)
+                            backend=backend, routing=routing, seq=seq)
         entry[_T_ISSUE] = t_issue
         entry[_T_COMPLETE] = t_complete
         entry[_STATUS] = STATUS_COMPLETED
