@@ -1641,6 +1641,57 @@ def _ps_fleet(check: bool = False, clients: str = "", window_s: float = 1.2):
     return 0 if ok else 1
 
 
+def _sim_bench(check: bool = False, worlds: str = ""):
+    """``--sim``: the coordinator-scalability curve over a SIMULATED
+    fleet (torchmpi_tpu.sim — real control plane, modeled network).
+    For each world size (default 256,1024,4096,10000) a formation plus
+    a ~1% spread death wave runs through the real ElasticCoordinator;
+    the JSON line carries resize-commit latency, per-member
+    barrier/view control payloads, PS chain re-formation fan-out at
+    replication 3, and the schedule compiler's plan at that scale.
+    ``--check`` gates (CI sim-smoke): every world resizes, control
+    payloads grow (sub)linearly with the member list, re-formation
+    fan-out stays <= 2x replication on any single head, and the
+    smallest point replays byte-identically under its seed. Pure host
+    path — no jax backend, survives a dead TPU tunnel."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from torchmpi_tpu.sim.bench import (
+        DEFAULT_WORLDS,
+        bench_curve,
+        check_curve,
+    )
+
+    spec = worlds or os.environ.get("TORCHMPI_TPU_SIM_WORLDS", "")
+    ws = [int(x) for x in spec.split(",") if x.strip()] or list(
+        DEFAULT_WORLDS
+    )
+    points = bench_curve(ws)
+    line = {
+        "metric": "simulated-fleet coordinator scalability "
+        "(resize commit + control payloads + chain re-formation "
+        "fan-out vs world size)",
+        "unit": "s",
+        "platform": "sim",
+        "points": points,
+        "value": max(
+            (p["resize_commit_s"] or 0.0 for p in points), default=0.0
+        ),
+        "max_world": max((p["world"] for p in points), default=0),
+    }
+    print(json.dumps(line), flush=True)
+    if not check:
+        return 0
+    failures = check_curve(points)
+    if failures:
+        print(
+            "# sim smoke FAILED: " + "; ".join(failures),
+            file=sys.stderr,
+            flush=True,
+        )
+        return 1
+    return 0
+
+
 def main(argv=None):
     import argparse
 
@@ -1702,6 +1753,22 @@ def main(argv=None):
         "curve (overrides TORCHMPI_TPU_PS_FLEET_CLIENTS)",
     )
     ap.add_argument(
+        "--sim",
+        action="store_true",
+        help="simulated-fleet coordinator scalability curve: formation "
+        "+ a ~1%% death wave through the REAL elastic coordinator at "
+        "each world size (default 256,1024,4096,10000 — override with "
+        "--sim-worlds or TORCHMPI_TPU_SIM_WORLDS); prints one JSON "
+        "line with resize-commit latency, per-member control payload "
+        "bytes, and PS chain re-formation fan-out — pure host path, "
+        "virtual clock, no TPU tunnel needed",
+    )
+    ap.add_argument(
+        "--sim-worlds",
+        default="",
+        help="with --sim: comma-separated world sizes for the curve",
+    )
+    ap.add_argument(
         "--check",
         action="store_true",
         help="with --microbench: exit 1 unless fused dispatch <= unfused "
@@ -1711,9 +1778,14 @@ def main(argv=None):
         "within its encoding's error bound; with --ps-fleet: exit 1 on "
         "any lost/double-applied update, 256-client throughput below "
         "half the 32-client point, or server thread growth with client "
-        "count (CI perf-smoke)",
+        "count (CI perf-smoke); with --sim: exit 1 on a missed resize, "
+        "super-linear control payloads, re-formation hotspots, or a "
+        "non-deterministic replay",
     )
     args = ap.parse_args(argv)
+
+    if args.sim:
+        return _sim_bench(check=args.check, worlds=args.sim_worlds)
 
     if args.ps_fleet:
         return _ps_fleet(check=args.check, clients=args.fleet_clients)

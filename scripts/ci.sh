@@ -28,7 +28,29 @@ run_fast() {
     # validating tpu-lint's static lock graph.
     echo "=== fast tier (unit + interpret p<=3 + single-process; lock monitor armed) ==="
     TORCHMPI_TPU_LOCK_MONITOR=1 python -m pytest tests/ -q -m "not slow"
+    run_sim_smoke
     run_perf_smoke
+}
+
+run_sim_smoke() {
+    # sim-smoke: a 1024-rank simulated fleet (REAL elastic coordinator,
+    # schedule compiler and reshard planner on a modeled network) must
+    # survive a death wave and a partition, with telemetry.analyze
+    # reaching the verdict each scenario file names (hang naming the
+    # dead ranks; resize-incomplete naming the partitioned ones) —
+    # deterministically per seed. Then the coordinator-scalability
+    # curve (256 -> 10k ranks) gates resize commit, control-payload
+    # growth and chain re-formation fan-out. Pure host path — no jax
+    # backend, survives a dead TPU tunnel.
+    echo "=== sim-smoke (1k-rank fault scenarios + 10k coordinator curve) ==="
+    simdir="$(mktemp -d)"
+    # the EXIT trap survives set -eu: a failing scenario must not
+    # strand ~2k telemetry dumps per retry in /tmp on the CI box
+    trap 'rm -rf "$simdir"' EXIT
+    JAX_PLATFORMS=cpu python -m torchmpi_tpu.sim death_wave partition \
+        --ranks 1024 --out "$simdir"
+    rm -rf "$simdir"
+    python bench.py --sim --check
 }
 
 run_perf_smoke() {
@@ -81,10 +103,11 @@ run_slow_b() {
 case "$tier" in
     lint) run_lint ;;
     fast) run_fast ;;
+    sim-smoke) run_sim_smoke ;;
     perf-smoke) run_perf_smoke ;;
     slow-a) run_slow_a ;;
     slow-b) run_slow_b ;;
     all) run_fast; run_slow_a; run_slow_b ;;
-    *) echo "usage: scripts/ci.sh [lint|fast|perf-smoke|slow-a|slow-b|all]" >&2; exit 2 ;;
+    *) echo "usage: scripts/ci.sh [lint|fast|sim-smoke|perf-smoke|slow-a|slow-b|all]" >&2; exit 2 ;;
 esac
 echo "Success"

@@ -112,7 +112,14 @@ _PS_WORKER = textwrap.dedent(
     assert sum(inst.is_local(r) for r in range(p)) == 2, "2 shards/process"
 
     def grad_for(client, step):
-        rs = np.random.RandomState(97 * client + step)
+        # labeled deterministic stream (sim.derive_seed): both
+        # processes derive the identical gradient for (client, step).
+        # clock, not the sim package root — workers must not pay the
+        # fleet/compiler import for a seed helper
+        from torchmpi_tpu.sim.clock import derive_seed
+        rs = np.random.RandomState(
+            derive_seed("downpour-grad", client, step) % 2**32
+        )
         return rs.randn(N).astype(np.float32)
 
     for step in range(steps):
@@ -302,7 +309,10 @@ _EASGD_WORKER = textwrap.dedent(
     init = np.linspace(-1.0, 1.0, N).astype(np.float32)
 
     def replica0(client):
-        rs = np.random.RandomState(31 * client + 7)
+        from torchmpi_tpu.sim.clock import derive_seed
+        rs = np.random.RandomState(
+            derive_seed("easgd-replica", client) % 2**32
+        )
         return (init + rs.randn(N)).astype(np.float32)
 
     center = ps.ParameterServer(init, comm=comm)

@@ -1962,9 +1962,11 @@ def test_transport_chain_routing_marks_dead_and_fails_over():
             1: ("127.0.0.1", lst.port),
         })
         tr._dead_procs = {}
+        tr._dead_expired = set()
         tr._oseq = {}
         from torchmpi_tpu.analysis import lockmon
 
+        tr._dead_lock = lockmon.make_lock("test.dead")
         tr._oseq_lock = lockmon.make_lock("test.oseq")
         tr.update(
             0, 5, 0, 0, "add", np.full(2, 3.0, np.float32), chain=[0, 1]

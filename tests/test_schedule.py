@@ -95,7 +95,11 @@ def test_allreduce_equivalence_matrix(routing, wire):
         p, comm = _2level()
         if routing == "staged":
             constants.set("use_staged_collectives", True)
-    x = _payload(p, seed=hash((routing, wire)) % 1000)
+    # NOT hash(): string hashing is PYTHONHASHSEED-randomized, so the
+    # payload changed per run and the int8 cells flaked on unlucky
+    # draws near the quantization tolerance
+    from torchmpi_tpu.sim.clock import derive_seed
+    x = _payload(p, seed=derive_seed(routing, wire) % 1000)
 
     routed = np.asarray(eager.run("allreduce", x, comm, backend="ring"))
     if routing == "flat":

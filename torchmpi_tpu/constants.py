@@ -237,6 +237,28 @@ class _Constants:
     # the resize coordinator at this cadence, and a member silent for
     # 5 heartbeats is declared dead (epoch bump -> survivors reshard).
     elastic_heartbeat_seconds: float = 0.5
+    # Seconds a resize barrier may wait for the slowest member before
+    # the coordinator answers it stale (members retry after the next
+    # epoch). Bounds how long one wedged survivor can stall a resize;
+    # the member's control RPC allows 30s of slack on top. The SAME
+    # bound also caps the post-barrier redistribution wait (how long a
+    # member waits for its transfer frames), so tune it to the slower
+    # of barrier skew and state-transfer time.
+    elastic_barrier_timeout_s: float = 300.0
+
+    # --- fleet simulation (torchmpi_tpu.sim: modeled network, real
+    # --- control plane; see README "Fleet simulation") ---
+    # Modeled wall-clock period of one training step in the simulated
+    # fleet (compute + dispatch; the collective itself is priced by the
+    # plan cost model on top).
+    sim_step_seconds: float = 0.25
+    # Fractional latency jitter the modeled network draws per event
+    # (uniform in [1-j, 1+j], from the scenario's seeded RNG): 0 makes
+    # every latency exactly the cost-model value.
+    sim_jitter_pct: float = 0.05
+    # Modeled member<->coordinator control round trip (µs) for joins,
+    # barrier arrivals and view fetches in the simulated fleet.
+    sim_control_rtt_us: float = 500.0
 
     # --- coalescing dispatch (latency path; GC3-style fused plans) ---
     # Capacity of the flat fusion buffer: pending same-(op, dtype, comm,

@@ -181,6 +181,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     return rc
 
 
+def _constants_spec(set_constant) -> str:
+    """Merge ``--set-constant`` overrides onto any operator-exported
+    TORCHMPI_TPU_CONSTANTS (CLI overrides win: `_apply_env_constants`
+    applies entries in order). Replacing instead of merging silently
+    dropped the operator's env-specified knobs."""
+    ambient = os.environ.get("TORCHMPI_TPU_CONSTANTS", "")
+    parts = [s for s in (ambient,) if s] + list(set_constant)
+    return ";".join(parts)
+
+
 def _worker_env(args, rank: int, restart: int = 0) -> dict:
     """Per-rank environment (shared by the static and elastic paths)."""
     env = dict(
@@ -189,7 +199,7 @@ def _worker_env(args, rank: int, restart: int = 0) -> dict:
         TORCHMPI_TPU_RESTART_COUNT=str(restart),
     )
     if args.set_constant:
-        env["TORCHMPI_TPU_CONSTANTS"] = ";".join(args.set_constant)
+        env["TORCHMPI_TPU_CONSTANTS"] = _constants_spec(args.set_constant)
     if args.watchdog_timeout:
         env["TORCHMPI_TPU_WATCHDOG"] = str(args.watchdog_timeout)
     if args.cpu_devices:
@@ -210,6 +220,21 @@ def _run_elastic(args, target, extra) -> int:
     (survivors of tolerated deaths exit last, so a recovered job is 0)."""
     from .analysis import lockmon as _lockmon
     from .reshard.elastic import ElasticCoordinator
+
+    if args.set_constant:
+        # the membership coordinator lives in THIS process and reads
+        # fabric knobs (elastic_heartbeat_seconds, the barrier timeout)
+        # from constants — apply the overrides here too, not only in the
+        # worker envs, or `--set-constant elastic_heartbeat_seconds=...`
+        # would tune the members' beat cadence but not the coordinator's
+        # death-detection sweep. Merged onto any operator-exported spec
+        # (workers re-merge; the duplicate entries are idempotent).
+        os.environ["TORCHMPI_TPU_CONSTANTS"] = _constants_spec(
+            args.set_constant
+        )
+        from .runtime_state import _apply_env_constants
+
+        _apply_env_constants()
 
     lock = _lockmon.make_lock("launch.py:_run_elastic")
     procs: dict = {}

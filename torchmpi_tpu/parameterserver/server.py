@@ -246,6 +246,81 @@ class _ReplicaPump:
             self._cv.notify_all()
 
 
+def initial_chains(owners: Sequence[int], rep: int) -> List[List[int]]:
+    """Replica chains for a fresh instance: each shard rank's chain is
+    [owner (head), then the next rep-1 DISTINCT owner processes in ring
+    order]. Deterministic from ``(owners, rep)`` — every process (and
+    the fleet simulator) derives it without coordination; single-process
+    instances or rep == 1 degenerate to [owner]."""
+    distinct = sorted(set(int(o) for o in owners))
+    if rep > 1 and len(distinct) > 1:
+        k = min(rep, len(distinct))
+        pos = {p: i for i, p in enumerate(distinct)}
+        return [
+            [distinct[(pos[o] + j) % len(distinct)] for j in range(k)]
+            for o in owners
+        ]
+    return [[int(o)] for o in owners]
+
+
+def reform_layout(
+    owners: Sequence[int],
+    chains: Sequence[Sequence[int]],
+    live: Sequence[int],
+    rep: int,
+) -> Tuple[List[int], List[List[int]]]:
+    """The chain re-formation planner as a pure function: the
+    ``(new_owners, new_chains)`` layout after restricting an instance's
+    ``(owners, chains)`` to the ``live`` processes at replication
+    ``rep``. Deterministic from its arguments, so every live process —
+    and the fleet simulator, which measures re-formation fan-out at
+    thousands of ranks — computes the identical layout with no
+    coordination beyond agreeing on ``live``.
+
+    - a rank whose head died promotes its first live chain member (the
+      member already serving failover traffic);
+    - chains rebuild as [head + next rep-1 live pool members in ring
+      order]; the pool prefers the original owners and widens to ANY
+      live process when they cannot restore ``rep``;
+    - a rank with NO live chain member raises (state unrecoverable).
+    """
+    live_set = set(int(p) for p in live)
+    new_owners: List[int] = []
+    for r, owner in enumerate(owners):
+        if owner in live_set:
+            new_owners.append(owner)
+        else:
+            promoted = next(
+                (p for p in chains[r] if p in live_set), None
+            )
+            if promoted is None:
+                raise RuntimeError(
+                    f"shard {r}: no live member in chain "
+                    f"{list(chains[r])} (live={sorted(live_set)}) — "
+                    "state is unrecoverable, restore from checkpoint"
+                )
+            new_owners.append(promoted)
+    pool = sorted(live_set & set(owners))
+    if len(pool) < min(rep, len(live_set)):
+        pool = sorted(live_set)  # widen onto fresh processes
+    if rep > 1 and len(pool) > 1:
+        k = min(rep, len(pool))
+        pos = {p: i for i, p in enumerate(pool)}
+        new_chains = []
+        for o in new_owners:
+            if o in pos:
+                new_chains.append(
+                    [pool[(pos[o] + j) % len(pool)] for j in range(k)]
+                )
+            else:  # head outside the pool (promoted client proc)
+                new_chains.append(
+                    [o] + [p for p in pool if p != o][:k - 1]
+                )
+    else:
+        new_chains = [[o] for o in new_owners]
+    return new_owners, new_chains
+
+
 class _Instance:
     """Server-side state of one ParameterServer: per-rank shards + mailboxes.
 
@@ -287,16 +362,7 @@ class _Instance:
         # process agrees without coordination; single-process instances
         # (or ps_replication == 1) degenerate to [owner].
         rep = max(1, int(constants.get("ps_replication")))
-        distinct = sorted(set(self.owners))
-        if rep > 1 and len(distinct) > 1:
-            k = min(rep, len(distinct))
-            pos = {p: i for i, p in enumerate(distinct)}
-            self.chains: List[List[int]] = [
-                [distinct[(pos[o] + j) % len(distinct)] for j in range(k)]
-                for o in self.owners
-            ]
-        else:
-            self.chains = [[o] for o in self.owners]
+        self.chains: List[List[int]] = initial_chains(self.owners, rep)
         self.replication = max(len(c) for c in self.chains)
         # chain successor per rank (None at the tail / when this process
         # is not in the chain) + the replica forwarding pump, attached by
@@ -412,41 +478,10 @@ class _Instance:
         Returns ``{rank: [processes needing a state copy]}`` for ranks
         HEADED here — the copies the caller must stream."""
         rep = replication or max(1, int(constants.get("ps_replication")))
-        live_set = set(int(p) for p in live)
-        new_owners: List[int] = []
-        for r, owner in enumerate(self.owners):
-            if owner in live_set:
-                new_owners.append(owner)
-            else:
-                promoted = next(
-                    (p for p in self.chains[r] if p in live_set), None
-                )
-                if promoted is None:
-                    raise RuntimeError(
-                        f"shard {r}: no live member in chain "
-                        f"{self.chains[r]} (live={sorted(live_set)}) — "
-                        "state is unrecoverable, restore from checkpoint"
-                    )
-                new_owners.append(promoted)
-        pool = sorted(live_set & set(self.owners))
-        if len(pool) < min(rep, len(live_set)):
-            pool = sorted(live_set)  # widen onto fresh processes
         had_storage = {r: self.has_storage(r) for r in range(self.size)}
-        if rep > 1 and len(pool) > 1:
-            k = min(rep, len(pool))
-            pos = {p: i for i, p in enumerate(pool)}
-            new_chains = []
-            for r, o in enumerate(new_owners):
-                if o in pos:
-                    new_chains.append(
-                        [pool[(pos[o] + j) % len(pool)] for j in range(k)]
-                    )
-                else:  # head outside the pool (promoted client proc)
-                    new_chains.append(
-                        [o] + [p for p in pool if p != o][:k - 1]
-                    )
-        else:
-            new_chains = [[o] for o in new_owners]
+        new_owners, new_chains = reform_layout(
+            self.owners, self.chains, live, rep
+        )
         if self.native is not None:
             # native storage is sized at construction; migrate the live
             # shards to the numpy store so membership can change

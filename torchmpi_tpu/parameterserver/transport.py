@@ -145,6 +145,18 @@ def _metric_handles():
                 "BUSY/retry-after replies honored client-side with "
                 "jittered backoff",
             ),
+            m.gauge(
+                "tm_ps_dead_marks_active",
+                "peer processes currently skipped by replica-chain "
+                "failover routing (dead-marks inside their "
+                "ps_dead_peer_retry_s window)",
+            ),
+            m.counter(
+                "tm_ps_dead_mark_expiries_total",
+                "dead-marks whose retry window elapsed (the peer is "
+                "re-probed; each expiry closes one bounded split-brain "
+                "window)",
+            ),
         )
     return _MET
 
@@ -194,6 +206,40 @@ def _srv_metric_handles():
             ),
         )
     return _SRV_MET
+
+
+def busy_backoff_s(attempts: int, hint_ms: int = 0, rng=None) -> float:
+    """The client channel's BUSY backoff: base * 2^(attempts-1) capped
+    at 2s, +-50% jitter. One definition shared by the live channel and
+    the fleet simulator, so the modeled overload behavior IS the
+    deployed policy (``hint_ms`` is the server's retry-after hint; 0
+    falls back to the ``ps_busy_retry_ms`` knob)."""
+    import random
+
+    base = (hint_ms or constants.get("ps_busy_retry_ms")) / 1000.0
+    delay = min(2.0, base * (1 << min(max(attempts, 1) - 1, 6)))
+    return delay * (rng or random).uniform(0.5, 1.5)
+
+
+def admission_decision(pending: int, budget: int, busy_floor, seq: int,
+                       update_kind: bool):
+    """The listener's admission-control policy as a pure function:
+    ``(admit, new_busy_floor)`` for a frame arriving with ``pending``
+    frames already admitted against ``budget``. The per-connection
+    ``busy_floor`` keeps rejections order-safe for pipelined updates:
+    once an UPDATE is rejected, every later UPDATE on that connection is
+    rejected too until the first rejected seq is retried. Shared by
+    ``_Listener._admit`` and the fleet simulator's modeled servers."""
+    if budget <= 0:
+        return True, busy_floor
+    forced = update_kind and busy_floor is not None and seq > busy_floor
+    if pending >= budget or forced:
+        if update_kind and busy_floor is None:
+            busy_floor = seq
+        return False, busy_floor
+    if update_kind and busy_floor is not None and seq <= busy_floor:
+        busy_floor = None
+    return True, busy_floor
 
 
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
@@ -797,30 +843,17 @@ class _Listener:
         per-connection ``busy_floor`` keeps rejections order-safe for
         pipelined updates (see class docstring)."""
         budget = constants.get("ps_pending_frame_budget")
-        if budget <= 0:
-            return True
         update_kind = kind in (_KIND_UPDATE, _KIND_UPDATE_MULTI)
         with self._pending_lock:
-            over = self._pending_frames >= budget
-        forced = (
-            update_kind
-            and conn.busy_floor is not None
-            and seq > conn.busy_floor
+            pending = self._pending_frames
+        admit, conn.busy_floor = admission_decision(
+            pending, budget, conn.busy_floor, seq, update_kind
         )
-        if over or forced:
-            if update_kind and conn.busy_floor is None:
-                conn.busy_floor = seq
+        if not admit:
             self._busy_rejects += 1
             if _telemetry.enabled():
                 _srv_metric_handles()[0].inc(listener=str(self.port))
-            return False
-        if (
-            update_kind
-            and conn.busy_floor is not None
-            and seq <= conn.busy_floor
-        ):
-            conn.busy_floor = None
-        return True
+        return admit
 
     def _make_finisher(self, reply, fl):
         """Wrap ``reply`` so the frame's admission slot is released and
@@ -1437,8 +1470,6 @@ class _PeerChannel:
                 w.event.set()
 
     def _on_busy(self, rseq: int, hint: str) -> None:
-        import random
-
         try:
             hint_ms = int(hint)
         except (TypeError, ValueError):
@@ -1453,10 +1484,7 @@ class _PeerChannel:
             attempts = w.busy
         if _telemetry.enabled():
             _metric_handles()[8].inc()
-        base = (hint_ms or constants.get("ps_busy_retry_ms")) / 1000.0
-        delay = min(2.0, base * (1 << min(attempts - 1, 6)))
-        delay *= random.uniform(0.5, 1.5)
-        due = time.monotonic() + delay
+        due = time.monotonic() + busy_backoff_s(attempts, hint_ms)
         with self._busy_cv:
             self._busy_seqs.add(rseq)
             self._busy_due = max(self._busy_due, due)
@@ -1865,6 +1893,10 @@ class Transport:
         # channel-independent dedup identity that survives re-issue to a
         # replica (see the oseq header field).
         self._dead_procs: Dict[int, float] = {}
+        self._dead_expired: set = set()
+        self._dead_lock = _lockmon.make_lock(
+            "transport.py:Transport._dead_lock"
+        )
         self._oseq: Dict[Tuple[int, int, int], int] = {}
         self._oseq_lock = _lockmon.make_lock(
             "transport.py:Transport._oseq_lock"
@@ -1900,17 +1932,59 @@ class Transport:
             self._oseq[(inst, rank, client)] = v
             return v
 
+    def _dead_marks_gauge(self, ttl: float, now: float) -> None:
+        if not _telemetry.enabled():
+            return
+        # snapshot: another thread's _mark_dead may mutate the dict
+        # mid-iteration (the transport is shared across client threads
+        # and the replica pump)
+        active = sum(
+            1 for t in list(self._dead_procs.values())
+            if not ttl or now - t < ttl
+        )
+        _metric_handles()[9].set(active)
+
     def _mark_dead(self, proc: int) -> None:
-        self._dead_procs[proc] = time.monotonic()
+        with self._dead_lock:
+            self._dead_procs[proc] = time.monotonic()
+            self._dead_expired.discard(proc)
+        self._dead_marks_gauge(
+            constants.get("ps_dead_peer_retry_s"), time.monotonic()
+        )
 
     def _alive_chain(self, chain) -> List[int]:
         ttl = constants.get("ps_dead_peer_retry_s")
         now = time.monotonic()
-        alive = [
-            p for p in chain
-            if p not in self._dead_procs
-            or (ttl and now - self._dead_procs[p] >= ttl)
-        ]
+        alive = []
+        for p in chain:
+            t = self._dead_procs.get(p)
+            if t is None:
+                alive.append(p)
+            elif ttl and now - t >= ttl:
+                # the retry window elapsed: route to the peer again.
+                # Counting the expiry (once per mark) makes the bounded
+                # split-brain window PR 8 documented OBSERVABLE in
+                # ps_health instead of invisible until a partition
+                # scenario trips it. The lock-free pre-check keeps
+                # long-expired marks off the hot path; under the lock,
+                # the timestamp re-check drops the count if a racing
+                # _mark_dead re-marked the peer (counting then would
+                # pre-claim the FRESH mark's expiry).
+                if p not in self._dead_expired:
+                    with self._dead_lock:
+                        first = (
+                            self._dead_procs.get(p) == t
+                            and p not in self._dead_expired
+                        )
+                        if first:
+                            self._dead_expired.add(p)
+                    if first:
+                        if _telemetry.enabled():
+                            _metric_handles()[10].inc()
+                        # the gauge moves only on transitions (mark /
+                        # expiry), not on every routing call
+                        self._dead_marks_gauge(ttl, now)
+                alive.append(p)
         return alive if alive else list(chain)  # last resort: retry all
 
     def update(

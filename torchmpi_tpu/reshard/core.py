@@ -129,16 +129,29 @@ def plan_transfers(n: int, src: Layout, dst: Layout) -> List[Transfer]:
             s = d if d < src.world else d % src.world
             out.append(Transfer(s, d, ds, 0, de - ds, ds))
         return out
+    # Both interval lists are ordered contiguous partitions of [0, n)
+    # (shard_range is monotone in rank), so a two-pointer sweep finds
+    # every overlap in O(src.world + dst.world + transfers). The naive
+    # all-pairs scan was O(src.world * dst.world) — ~100M interval
+    # comparisons for one 10k -> 9.9k resize, which the fleet simulator
+    # measured as ~90s of coordinator-side planning per epoch.
     src_ivs = src.intervals(n)
+    s = 0
     for d in range(dst.world):
         ds, de = dst.interval(n, d)
         if de <= ds:
             continue
-        for s, (ss, se) in enumerate(src_ivs):
+        while s < src.world and src_ivs[s][1] <= ds:
+            s += 1
+        i = s
+        while i < src.world and src_ivs[i][0] < de:
+            ss, se = src_ivs[i]
             lo, hi = max(ds, ss), min(de, se)
-            if hi <= lo:
-                continue
-            out.append(Transfer(s, d, lo - ss, lo - ds, hi - lo, lo))
+            if hi > lo:
+                out.append(Transfer(i, d, lo - ss, lo - ds, hi - lo, lo))
+            if se >= de:
+                break
+            i += 1
     return out
 
 

@@ -65,6 +65,12 @@ K_AG = 5      # step: an allgather slice chunk
 _HDR = struct.Struct("!BIIHIQQ")
 
 _DEAD_BEATS = 5  # heartbeats of silence before a member is declared dead
+# epochs of member-list history the coordinator keeps for resolving the
+# source layout of survivors whose last committed resize predates the
+# current epoch (resize storms). A commit older than the window fails
+# LOUDLY at the barrier release (src_members=None -> DataLoss) instead
+# of silently redistributing from the wrong layout.
+_HISTORY_EPOCHS = 16
 
 
 class EpochChanged(Exception):
@@ -135,8 +141,11 @@ class ElasticCoordinator:
     plan they are the target of."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 on_grow: Optional[Callable[[], None]] = None):
+                 on_grow: Optional[Callable[[], None]] = None,
+                 serve: bool = True,
+                 clock: Optional[Callable[[], float]] = None):
         self._on_grow = on_grow
+        self._now = clock or time.monotonic
         self._lock = _lockmon.make_lock("elastic.py:Coordinator._lock")
         self._cv = threading.Condition(self._lock)
         self._members: Dict[int, dict] = {}
@@ -147,18 +156,26 @@ class ElasticCoordinator:
         self._history: Dict[int, List[int]] = {}
         # (epoch) -> {mid: value} barrier arrivals
         self._barriers: Dict[int, Dict[int, Any]] = {}
+        # (epoch) -> the one release reply every arrival shares (the
+        # summary is computed ONCE at release, not once per member)
+        self._released: Dict[int, dict] = {}
         self._closed = False
-        self._srv = socket.socket()
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(64)
-        self.address = self._srv.getsockname()[:2]
-        threading.Thread(
-            target=self._accept_loop, name="tm-elastic-coord", daemon=True
-        ).start()
-        threading.Thread(
-            target=self._monitor_loop, name="tm-elastic-mon", daemon=True
-        ).start()
+        self._srv: Optional[socket.socket] = None
+        self.address: Optional[Tuple[str, int]] = None
+        if serve:
+            self._srv = socket.socket()
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind((host, port))
+            self._srv.listen(64)
+            self.address = self._srv.getsockname()[:2]
+            threading.Thread(
+                target=self._accept_loop, name="tm-elastic-coord",
+                daemon=True,
+            ).start()
+            threading.Thread(
+                target=self._monitor_loop, name="tm-elastic-mon",
+                daemon=True,
+            ).start()
 
     # -- internals ---------------------------------------------------------
     def _bump_epoch_locked(self) -> None:
@@ -166,12 +183,17 @@ class ElasticCoordinator:
         self.epoch += 1
         self._epoch_members = sorted(self._members)
         self._barriers.pop(self.epoch - 1, None)
+        self._released.pop(self.epoch - 1, None)
         # bounded epoch->members history: a resize aborted by a SECOND
         # membership change leaves survivors laid out per the epoch they
         # last COMMITTED ("was" in the barrier value) — which may be
-        # older than epoch-1, so `prev` alone cannot name their layout
+        # older than epoch-1, so `prev` alone cannot name their layout.
+        # The history stays coordinator-internal: the barrier release
+        # resolves the source member list and ships it in the summary,
+        # so views no longer carry (and re-serialize, per member, per
+        # fetch) the whole table.
         self._history[self.epoch] = self._epoch_members
-        while len(self._history) > 16:
+        while len(self._history) > _HISTORY_EPOCHS:
             del self._history[min(self._history)]
         self._cv.notify_all()
 
@@ -183,7 +205,6 @@ class ElasticCoordinator:
                 for m in self._epoch_members
             ],
             "prev": list(self._prev_members),
-            "history": {str(e): list(m) for e, m in self._history.items()},
         }
 
     def _accept_loop(self) -> None:
@@ -217,14 +238,14 @@ class ElasticCoordinator:
                 self._members[mid] = {
                     "host": req["host"],
                     "data_port": int(req["data_port"]),
-                    "beat": time.monotonic(),
+                    "beat": self._now(),
                 }
                 self._bump_epoch_locked()
                 return {"mid": mid, **self._view_locked()}
             if op == "beat":
                 m = self._members.get(req["mid"])
                 if m is not None:
-                    m["beat"] = time.monotonic()
+                    m["beat"] = self._now()
                 return {"epoch": self.epoch,
                         "member": req["mid"] in self._members}
             if op == "view":
@@ -250,37 +271,152 @@ class ElasticCoordinator:
             return {"ok": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
-    def _barrier_locked(self, req: dict) -> dict:
-        mid, epoch = int(req["mid"]), int(req["epoch"])
-        deadline = time.monotonic() + float(req.get("timeout", 300))
+    def _release_locked(self, epoch: int, arrived: Dict[int, Any]) -> dict:
+        """Compute the ONE release reply every barrier member shares:
+        the resize agreement (stateful set, committed source epoch and
+        its member list, anchor, agreed resume step) aggregated HERE
+        instead of shipping every member's raw value to every member —
+        the per-member reply stays O(world), not O(world) dicts, and the
+        anchor/agreed-step selection runs once instead of N times."""
+        stateful = sorted(
+            m for m, v in arrived.items() if (v or {}).get("stateful")
+        )
+        was = sorted({
+            int((arrived[m] or {}).get("was", -1)) for m in stateful
+        })
+        summary: Dict[str, Any] = {
+            "stateful": stateful, "was": was,
+            "anchor": None, "step": 0, "src_members": [],
+        }
+        if len(was) == 1:
+            src = self._history.get(was[0])
+            if src is None:
+                src = self._prev_members
+                if 0 <= was[0] < self.epoch - 1:
+                    # the survivors' committed layout predates the
+                    # bounded history window (a resize storm outlasted
+                    # it): naming ANY other list would silently
+                    # redistribute from the wrong layout — fail loudly
+                    summary["src_unresolved"] = True
+            summary["src_members"] = list(src)
+            members = set(self._epoch_members)
+            survivors = [
+                m for m in src if m in members and m in set(stateful)
+            ]
+            if survivors and not summary.get("src_unresolved"):
+                anchor = max(
+                    survivors,
+                    key=lambda m: (
+                        int((arrived[m] or {}).get("step", 0)), -m
+                    ),
+                )
+                summary["anchor"] = anchor
+                summary["step"] = int(
+                    (arrived[anchor] or {}).get("step", 0)
+                )
+        return {"ok": True, "summary": summary}
+
+    def _barrier_arrive_locked(self, mid: int, epoch: int,
+                               value=None) -> Optional[dict]:
+        if epoch in self._released:
+            return self._released[epoch]
         if epoch != self.epoch or mid not in self._members:
             return {"stale": True, "epoch": self.epoch}
         arrived = self._barriers.setdefault(epoch, {})
-        arrived[mid] = req.get("value")
+        arrived[mid] = value
+        # arrivals are gated on current membership above, so counting
+        # suffices until the counts match — the O(world) set comparison
+        # runs once at the release, not once per arrival (at 10k ranks
+        # the per-arrival form is an O(world^2) barrier)
+        if len(arrived) >= len(self._epoch_members) and (
+            set(arrived) >= set(self._epoch_members)
+        ):
+            rel = self._release_locked(epoch, arrived)
+            self._released[epoch] = rel
         self._cv.notify_all()
-        while True:
-            if self.epoch != epoch:
-                return {"stale": True, "epoch": self.epoch}
-            if set(arrived) >= set(self._epoch_members):
-                return {"ok": True,
-                        "vals": {str(m): v for m, v in arrived.items()}}
-            if not self._cv.wait(min(1.0, deadline - time.monotonic())):
-                if time.monotonic() >= deadline:
+        return self._released.get(epoch)
+
+    def barrier_arrive(self, mid: int, epoch: int, value=None
+                       ) -> Optional[dict]:
+        """Non-blocking barrier arrival (the sim's entry point; the
+        threaded ``_barrier_locked`` wraps it). Returns the stale reply,
+        the shared release reply (when this arrival completes the set),
+        or None while the barrier is still filling."""
+        with self._cv:
+            return self._barrier_arrive_locked(mid, epoch, value)
+
+    def _barrier_poll_locked(self, epoch: int) -> Optional[dict]:
+        if epoch in self._released:
+            return self._released[epoch]
+        if self.epoch != epoch:
+            return {"stale": True, "epoch": self.epoch}
+        return None
+
+    def barrier_poll(self, epoch: int) -> Optional[dict]:
+        """The non-blocking side of a pending arrival: the release reply
+        once every member arrived, a stale reply after an epoch bump,
+        None while still filling."""
+        with self._cv:
+            return self._barrier_poll_locked(epoch)
+
+    def _barrier_locked(self, req: dict) -> dict:
+        """Blocking barrier (socket control plane; self._cv HELD)."""
+        mid, epoch = int(req["mid"]), int(req["epoch"])
+        deadline = self._now() + float(req.get(
+            "timeout", constants.get("elastic_barrier_timeout_s")
+        ))
+        rep = self._barrier_arrive_locked(mid, epoch, req.get("value"))
+        while rep is None:
+            if not self._cv.wait(min(1.0, deadline - self._now())):
+                if self._now() >= deadline:
                     return {"stale": True, "epoch": self.epoch,
                             "timeout": True}
+            rep = self._barrier_poll_locked(epoch)
+        return rep
+
+    def sweep_dead(self, hb: Optional[float] = None) -> List[int]:
+        """Evict members whose heartbeat is older than ``_DEAD_BEATS``
+        periods; one epoch bump covers the whole sweep (a death WAVE is
+        one membership change, not one resize per corpse). Returns the
+        evicted mids. Called by the monitor thread; the sim calls it on
+        its virtual clock."""
+        if hb is None:
+            hb = float(constants.get("elastic_heartbeat_seconds"))
+        cutoff = self._now() - _DEAD_BEATS * hb
+        with self._cv:
+            dead = [m for m, info in self._members.items()
+                    if info["beat"] < cutoff]
+            for m in dead:
+                del self._members[m]
+            if dead:
+                self._bump_epoch_locked()
+        return dead
+
+    def bulk_join(self, specs: List[Tuple[str, int]]) -> List[int]:
+        """Admit a cohort in one membership change: N joins, ONE epoch
+        bump (serial joins pay an O(N log N) member sort per join — a
+        10k-rank formation is 10k epochs and ~N^2 log N work). Used by
+        the fleet simulator's formation; returns the assigned mids."""
+        with self._cv:
+            mids = []
+            for host, data_port in specs:
+                mid = self._next_mid
+                self._next_mid += 1
+                self._members[mid] = {
+                    "host": host,
+                    "data_port": int(data_port),
+                    "beat": self._now(),
+                }
+                mids.append(mid)
+            if mids:
+                self._bump_epoch_locked()
+        return mids
 
     def _monitor_loop(self) -> None:
         while not self._closed:
             hb = float(constants.get("elastic_heartbeat_seconds"))
             time.sleep(hb)
-            cutoff = time.monotonic() - _DEAD_BEATS * hb
-            with self._cv:
-                dead = [m for m, info in self._members.items()
-                        if info["beat"] < cutoff]
-                for m in dead:
-                    del self._members[m]
-                if dead:
-                    self._bump_epoch_locked()
+            self.sweep_dead(hb)
 
     def members(self) -> List[int]:
         with self._cv:
@@ -288,10 +424,11 @@ class ElasticCoordinator:
 
     def close(self) -> None:
         self._closed = True
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -349,16 +486,12 @@ class ElasticState:
 
 
 class _View:
-    __slots__ = ("epoch", "members", "prev", "history")
+    __slots__ = ("epoch", "members", "prev")
 
     def __init__(self, d: dict):
         self.epoch = int(d["epoch"])
         self.members = [(int(m), h, int(p)) for m, h, p in d["members"]]
         self.prev = [int(m) for m in d.get("prev", [])]
-        self.history = {
-            int(e): [int(m) for m in ms]
-            for e, ms in d.get("history", {}).items()
-        }
 
     def mids(self) -> List[int]:
         return [m for m, _, _ in self.members]
@@ -644,19 +777,21 @@ class ElasticMember:
                 backend="elastic", routing=f"mid={self.mid}", seq=epoch,
             )
         t0 = time.monotonic()
+        barrier_s = float(constants.get("elastic_barrier_timeout_s"))
         rep = _json_roundtrip(self.coord, {
             "op": "barrier", "mid": self.mid, "epoch": epoch,
+            "timeout": barrier_s,
             "value": {"step": int(step),
                       "stateful": bool(self.state.initialized),
                       "was": self._view.epoch if self._view else -1},
-        }, timeout=330)
+        }, timeout=barrier_s + 30)
         if rep.get("stale"):
             self._note_epoch(int(rep["epoch"]))
             if entry is not None:
                 _flight.FlightRecorder.fail(entry)
             raise EpochChanged(int(rep["epoch"]))
-        vals = {int(m): v for m, v in rep["vals"].items()}
-        stateful = {m for m, v in vals.items() if v.get("stateful")}
+        summary = rep["summary"]
+        stateful = {int(m) for m in summary["stateful"]}
         stats: Dict[str, Any] = {
             "epoch": epoch, "old_world": len(view.prev),
             "new_world": len(view.members), "peak_chunk_bytes": 0,
@@ -667,7 +802,7 @@ class ElasticMember:
             stats["cold"] = True
             agreed = 0
         else:
-            agreed = self._redistribute(view, vals, stateful, stats)
+            agreed = self._redistribute(view, summary, stateful, stats)
         self._view = view
         self.state.initialized = True
         stats["seconds"] = time.monotonic() - t0
@@ -703,43 +838,51 @@ class ElasticMember:
                 ps, pe = lay.interval(e.n, (r - 1) % k)
                 e.replica = e.init[ps:pe].copy() if k > 1 else None
 
-    def _redistribute(self, view: _View, vals: Dict[int, dict],
+    def _redistribute(self, view: _View, summary: Dict[str, Any],
                       stateful: set, stats: Dict[str, Any]) -> int:
         """Move every array from the previous epoch's layout to the new
         one. Transfer sources resolve to the primary holder when it
         survived, else to its ring-replica holder (the single-death
         contract); the joiningest member is a pure receiver. Replicated
         arrays re-sync from the anchor — the stateful survivor with the
-        highest completed step — which also defines the agreed resume
-        step, superseding any step the death tore mid-collective."""
+        highest completed step (resolved ONCE by the coordinator at the
+        barrier release) — which also defines the agreed resume step,
+        superseding any step the death tore mid-collective."""
         epoch = view.epoch
         mids = view.mids()
         # the SOURCE layout is the world the survivors last COMMITTED —
         # normally epoch-1 (== view.prev), but a resize aborted by a
         # second membership change leaves them on an older epoch, whose
-        # member list only the coordinator's history knows. Mixed
-        # commit epochs (some members finished the aborted resize)
-        # cannot be redistributed coherently: fail loudly.
-        was = {int(vals[m].get("was", -1)) for m in stateful}
+        # member list only the coordinator's history knows (the barrier
+        # summary carries it). Mixed commit epochs (some members
+        # finished the aborted resize) cannot be redistributed
+        # coherently: fail loudly.
+        was = summary.get("was", [])
         if len(was) > 1:
             raise DataLoss(
                 f"epoch {epoch}: survivors hold mixed resize layouts "
                 f"(committed epochs {sorted(was)}) after an aborted "
                 "resize — restore from checkpoint"
             )
-        prev = view.history.get(next(iter(was)), view.prev) or view.prev
+        if summary.get("src_unresolved"):
+            raise DataLoss(
+                f"epoch {epoch}: survivors' committed layout (epoch "
+                f"{was[0]}) predates the coordinator's membership "
+                "history — restore from checkpoint"
+            )
+        prev = [int(m) for m in summary.get("src_members", [])] or view.prev
         k_old, k_new = len(prev), len(mids)
         r_new = view.rank_of(self.mid)
-        deadline = time.monotonic() + 300
-        survivors = [m for m in prev if m in mids and m in stateful]
-        if not survivors:
+        deadline = time.monotonic() + float(
+            constants.get("elastic_barrier_timeout_s")
+        )
+        anchor = summary.get("anchor")
+        if anchor is None:
             raise DataLoss(
                 f"epoch {epoch}: no stateful survivor from {prev}"
             )
-        anchor = max(
-            survivors, key=lambda m: (vals[m].get("step", 0), -m)
-        )
-        agreed = int(vals[anchor].get("step", 0))
+        anchor = int(anchor)
+        agreed = int(summary.get("step", 0))
         if self.on_agreed_step is not None:
             # reconcile BEFORE any transfer reads this member's shards:
             # if the anchor committed the step this member tore, the

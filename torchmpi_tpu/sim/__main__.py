@@ -1,0 +1,80 @@
+"""Scenario runner CLI.
+
+    python -m torchmpi_tpu.sim death_wave partition --ranks 1024
+    python -m torchmpi_tpu.sim path/to/custom.json --out /tmp/simout
+    python -m torchmpi_tpu.sim --list
+
+Runs each scenario (packaged name or JSON path), writes the per-rank
+telemetry dumps + ``analysis.json`` under ``--out/<name>``, prints one
+JSON line per scenario, and exits non-zero if any expectation failed —
+the CI sim-smoke entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+from .faults import SCENARIO_DIR, load_scenario, run_scenario
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m torchmpi_tpu.sim",
+        description="deterministic fleet fault simulator "
+        "(real control plane, modeled network)",
+    )
+    ap.add_argument("scenarios", nargs="*",
+                    help="packaged scenario names or JSON paths")
+    ap.add_argument("--list", action="store_true",
+                    help="list packaged scenarios and exit")
+    ap.add_argument("--out", default=None,
+                    help="output root (default: a temp dir); dumps land "
+                    "under <out>/<scenario name>/")
+    ap.add_argument("--ranks", type=int, default=None,
+                    help="override every scenario's fleet size")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override every scenario's seed (the analyzer "
+                    "verdict must not change with it)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for p in sorted(SCENARIO_DIR.glob("*.json")):
+            scn = load_scenario(p)
+            print(f"{p.stem}: {scn.get('description', '')}")
+        return 0
+    if not args.scenarios:
+        ap.error("no scenarios given (try --list)")
+
+    root = Path(args.out) if args.out else Path(
+        tempfile.mkdtemp(prefix="tm-sim-")
+    )
+    rc = 0
+    for src in args.scenarios:
+        scn = load_scenario(src)
+        out = root / scn["name"]
+        res = run_scenario(
+            scn, out, seed=args.seed, ranks=args.ranks
+        )
+        line = {
+            "scenario": res["name"],
+            "ranks": args.ranks or scn.get("ranks"),
+            "verdict": res["verdict"],
+            "ok": res["ok"],
+            "failures": res["failures"],
+            "resizes": len(res["stats"].get("resizes", [])),
+            "steps_completed": res["stats"].get("steps_completed"),
+            "events": res["stats"].get("events"),
+            "analysis": res["analysis_path"],
+        }
+        print(json.dumps(line), flush=True)
+        if not res["ok"]:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

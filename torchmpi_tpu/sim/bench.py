@@ -1,0 +1,154 @@
+"""Coordinator-scalability curve: the control plane at 256..10k ranks.
+
+While the TPU tunnel is dead every bench number is a stale replay; this
+curve is the hardware-independent line the sim buys. Per world size it
+forms a fleet, runs a ~1% death wave through the REAL coordinator
+(bulk formation, heartbeat sweep, barrier release with the aggregated
+summary), prices the redistribution with the real reshard plan, and
+re-forms PS replica chains with the real planner — reporting:
+
+- ``resize_commit_s``      epoch publish -> redistribution commit
+  (virtual seconds: the modeled-network cost of the real plan)
+- ``barrier_reply_bytes`` / ``view_bytes``  per-member control-plane
+  payloads (the curve that caught the O(epochs x world) view history)
+- ``reform_*``             chain re-formation fan-out (copies per new
+  head, total copied bytes) at ``ps_replication`` 3
+- ``plan_id`` / ``plan_est_us``  the schedule compiler's pick for the
+  fleet's allreduce at that scale
+- ``wall_s``               REAL seconds the simulation took (the
+  coordinator-bottleneck proxy: the state machine itself is what runs)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List
+
+from .. import constants
+from ..parameterserver.server import initial_chains, reform_layout
+from .fleet import SimFleet, reform_copies
+
+DEFAULT_WORLDS = (256, 1024, 4096, 10000)
+#: replica-chain length the curve measures re-formation at; the CI
+#: fan-out gate (<= 2x this) derives from the same constant
+REPLICATION = 3
+
+
+def bench_point(world: int, seed: int = 17,
+                death_fraction: float = 0.01) -> Dict[str, Any]:
+    # the watchdog override lives HERE, not only in bench_curve: the
+    # determinism replay in check_curve calls bench_point directly and
+    # must run under the same knobs as the original point
+    prev_wd = constants.get("watchdog_timeout_seconds")
+    constants.set("watchdog_timeout_seconds", 0)
+    try:
+        return _bench_point(world, seed, death_fraction)
+    finally:
+        constants.set("watchdog_timeout_seconds", prev_wd)
+
+
+def _bench_point(world: int, seed: int,
+                 death_fraction: float) -> Dict[str, Any]:
+    t_wall = time.perf_counter()
+    fleet = SimFleet(
+        world, seed=seed, group_size=8, steps=6,
+        state_elems=1 << 18,
+    )
+    n_dead = max(1, int(world * death_fraction))
+    # a spread wave (not a contiguous block): adjacent deaths >= the
+    # replication factor would wipe whole ring chains, which is a
+    # checkpoint-restore event, not a failover measurement. t=0.7 lands
+    # mid-run at every world size (the smallest fleet is still stepping)
+    stride = max(1, world // n_dead)
+    dead = [(i * stride + stride // 2) % world for i in range(n_dead)]
+    fleet.kill(dead, t=0.7)
+    stats = fleet.run(horizon_s=30.0)
+    resizes = stats["resizes"]
+    post_death = [r for r in resizes if r["world_old"] > r["world_new"]]
+    commit = post_death[-1] if post_death else (
+        resizes[-1] if resizes else {}
+    )
+    plan_id, plan_s = fleet._plan(world)
+    # chain re-formation fan-out at replication 3 over the same wave,
+    # through the REAL planners (initial_chains + reform_layout)
+    owners = list(range(world))
+    chains = initial_chains(owners, REPLICATION)
+    live = [p for p in owners if p not in set(dead)]
+    new_owners, new_chains = reform_layout(
+        owners, chains, live, REPLICATION
+    )
+    acct = reform_copies(owners, chains, new_owners, new_chains)
+    return {
+        "world": world,
+        "dead": n_dead,
+        "resize_commit_s": commit.get("commit_s"),
+        "publish_to_release_s": commit.get("publish_to_release_s"),
+        "barrier_reply_bytes": commit.get("barrier_reply_bytes"),
+        "view_bytes": commit.get("view_bytes"),
+        "redistribution_wire_bytes": commit.get(
+            "redistribution_wire_bytes"
+        ),
+        "resize_epochs": len(resizes),
+        "reform_copies_total": acct["copies_total"],
+        "reform_copies_changed": acct["copies_changed"],
+        "reform_max_copies_per_head": acct["max_copies_per_head"],
+        "plan_id": plan_id,
+        "plan_est_us": round(plan_s * 1e6, 3),
+        "events": stats["events"],
+        "wall_s": round(time.perf_counter() - t_wall, 3),
+    }
+
+
+def bench_curve(worlds=DEFAULT_WORLDS, seed: int = 17
+                ) -> List[Dict[str, Any]]:
+    return [bench_point(int(w), seed=seed) for w in worlds]
+
+
+def check_curve(points: List[Dict[str, Any]], seed: int = 17
+                ) -> List[str]:
+    """CI gates over the curve; failures as strings (empty = pass)."""
+    failures: List[str] = []
+    by_world = {p["world"]: p for p in points}
+    for p in points:
+        if p["resize_commit_s"] is None:
+            failures.append(f"world {p['world']}: death wave never "
+                            "resized")
+        if p["resize_epochs"] < 2:
+            failures.append(
+                f"world {p['world']}: expected formation + death "
+                f"resize, got {p['resize_epochs']} epoch(s)"
+            )
+        if p["reform_max_copies_per_head"] > 2 * REPLICATION:
+            failures.append(
+                f"world {p['world']}: reform fan-out "
+                f"{p['reform_max_copies_per_head']} copies on one head "
+                "(> 2x replication) — re-formation hotspot"
+            )
+    worlds = sorted(by_world)
+    if len(worlds) >= 2:
+        lo, hi = by_world[worlds[0]], by_world[worlds[-1]]
+        ratio_n = hi["world"] / lo["world"]
+        for key in ("barrier_reply_bytes", "view_bytes"):
+            if lo.get(key) and hi.get(key):
+                growth = hi[key] / lo[key]
+                # per-member control payloads must scale (sub)linearly
+                # with the member list — quadratic growth here is the
+                # resize-storm bankruptcy the summary refactor removed
+                if growth > 1.5 * ratio_n:
+                    failures.append(
+                        f"{key} grew {growth:.1f}x over a {ratio_n:.1f}x "
+                        "world (super-linear per-member control payload)"
+                    )
+    # determinism: the smallest point replayed with the same seed must
+    # reproduce byte-identically
+    if points:
+        again = bench_point(points[0]["world"], seed=seed)
+        a = {k: v for k, v in points[0].items() if k != "wall_s"}
+        b = {k: v for k, v in again.items() if k != "wall_s"}
+        if json.dumps(a, sort_keys=True) != json.dumps(b, sort_keys=True):
+            failures.append(
+                f"world {points[0]['world']}: replay with seed {seed} "
+                "diverged — determinism broken"
+            )
+    return failures

@@ -1,0 +1,54 @@
+"""Seeded randomness + deadline helpers for the simulator and tests.
+
+Determinism contract: every random draw in a simulation comes from a
+``random.Random`` seeded by :func:`derive_seed` over the scenario seed
+plus a stable stream name — never the process-global ``random`` module,
+never wall-clock entropy. Two runs with the same (seed, scenario) make
+identical draws in identical order, which is what lets CI assert
+byte-identical ``analysis.json`` replays.
+
+:func:`wait_until` is the real-time counterpart for the multiprocess
+tests: a deadline-based predicate wait that replaces bare
+``time.sleep`` polling (the historical flake source in the elastic
+fault tests — a sleep that races a rank is a flake, a deadline that
+polls the condition is not).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import time
+from typing import Callable
+
+
+def derive_seed(*parts) -> int:
+    """A stable 63-bit seed from arbitrary labeled parts. Unlike
+    ``hash()``, unaffected by PYTHONHASHSEED — the same (seed, stream)
+    pair derives the same RNG on every interpreter."""
+    h = hashlib.sha256(repr(parts).encode()).digest()
+    return int.from_bytes(h[:8], "big") >> 1
+
+
+def rng_for(seed, *stream) -> random.Random:
+    """An independent deterministic RNG for one named stream of a run.
+    Distinct streams (net jitter, client pacing, fault timing) never
+    perturb each other's draw sequences — adding a draw to one stream
+    cannot shift another stream's events."""
+    return random.Random(derive_seed(seed, *stream))
+
+
+def wait_until(pred: Callable[[], bool], timeout: float = 30.0,
+               interval: float = 0.005) -> bool:
+    """Poll ``pred`` until it holds or ``timeout`` elapses (returns the
+    final truth value). The test-side replacement for sleep-based
+    synchronization: asserting ``wait_until(cond)`` documents WHAT is
+    being waited for and fails only when the condition truly never
+    holds, not when a fixed sleep lost a scheduling race."""
+    deadline = time.monotonic() + timeout
+    while True:
+        if pred():
+            return True
+        if time.monotonic() >= deadline:
+            return bool(pred())
+        time.sleep(interval)

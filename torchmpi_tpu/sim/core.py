@@ -1,0 +1,65 @@
+"""Minimal deterministic discrete-event loop (the sim's clock).
+
+A binary heap of ``(time, seq, fn, args)`` with an insertion-order tie
+break: two events at the same virtual instant run in scheduling order,
+so the execution trace is a pure function of the scheduling calls —
+no thread interleaving, no wall clock. ``EventLoop.time`` is the
+injectable clock the real :class:`~..reshard.elastic.ElasticCoordinator`
+accepts, which is how the real membership state machine runs on virtual
+time.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class EventLoop:
+    """Deterministic single-threaded event loop over virtual seconds."""
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, Callable, tuple]] = []
+        self._seq = 0
+        self._now = 0.0
+        self.processed = 0
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def time(self) -> float:
+        """Callable clock (``ElasticCoordinator(clock=loop.time)``)."""
+        return self._now
+
+    def at(self, t: float, fn: Callable, *args: Any) -> None:
+        """Schedule ``fn(*args)`` at virtual time ``t`` (clamped to now:
+        the past is immutable)."""
+        heapq.heappush(self._heap, (max(t, self._now), self._seq, fn, args))
+        self._seq += 1
+
+    def after(self, dt: float, fn: Callable, *args: Any) -> None:
+        self.at(self._now + max(0.0, dt), fn, *args)
+
+    def run(self, until: Optional[float] = None,
+            max_events: int = 50_000_000) -> float:
+        """Drain events (optionally only up to virtual time ``until``);
+        returns the final virtual time. ``max_events`` is a runaway
+        backstop — a scenario that schedules events faster than it
+        retires them fails loudly instead of spinning forever."""
+        while self._heap:
+            t, _, fn, args = self._heap[0]
+            if until is not None and t > until:
+                break
+            heapq.heappop(self._heap)
+            self._now = t
+            fn(*args)
+            self.processed += 1
+            if self.processed > max_events:
+                raise RuntimeError(
+                    f"sim event budget exhausted ({max_events} events) — "
+                    "runaway scenario?"
+                )
+        if until is not None:
+            self._now = max(self._now, until)
+        return self._now

@@ -1,0 +1,278 @@
+"""Scripted fault scenarios: JSON in, analyzer verdict out, asserted.
+
+A scenario file declares a fleet (ranks, grouping, steps), a fault
+script (events on the virtual clock), knob overrides, and — the
+contract — the **verdict** the PR 6 analyzer must reach on the dumps
+the run leaves behind. :func:`run_scenario` builds the fleet, injects
+the faults, dumps telemetry, runs the real
+:func:`~..telemetry.analyze.analyze`, writes a deterministic
+``analysis.json``, and checks every expectation. CI replays a scenario
+pair at 1k ranks on every fast-tier run (``scripts/ci.sh`` sim-smoke).
+
+Event kinds: ``die`` (rank-death wave), ``straggle`` (persistent
+per-step skew), ``partition`` (coordinator + cross-group unreachability,
+optional ``heal_t``), and fleet-level keys ``arrival_spread_s`` (widens
+the barrier-arrival window so a second death can tear a resize) and
+``ps`` (attach a modeled PS shard group — servers, replication, client
+load — for BUSY storms and failover dead-mark scenarios).
+
+Verdicts (:func:`verdict_of`, derived ONLY from the analyzer report):
+
+- ``desync``            a cross-rank (seq, op, payload, plan) divergence
+- ``hang``              watchdog hang reports with diagnosed stuck ops
+- ``resize-torn``       a resize epoch with failed barrier entries
+- ``resize-incomplete`` a resize epoch a live rank never entered
+- ``straggler``         a significant cross-rank issue-time laggard
+- ``ps-overload``       admission-control BUSY rejections under a
+                        queue-dominated server
+- ``clean``             none of the above
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+from .. import constants
+from ..telemetry.analyze import analyze, load_run
+from .fleet import SimFleet, SimPS
+
+#: packaged scenario library (death_wave.json, straggler.json, ...)
+SCENARIO_DIR = Path(__file__).resolve().parent / "scenarios"
+
+
+def load_scenario(src: Union[str, Path, dict]) -> dict:
+    """A scenario dict from a path, a packaged scenario name, or a
+    passthrough dict."""
+    if isinstance(src, dict):
+        return dict(src)
+    p = Path(src)
+    if not p.exists():
+        packaged = SCENARIO_DIR / f"{p.name.removesuffix('.json')}.json"
+        if packaged.exists():
+            p = packaged
+        else:
+            raise FileNotFoundError(
+                f"no scenario at {src!r} and no packaged scenario "
+                f"{packaged.name!r} (have: "
+                f"{sorted(q.stem for q in SCENARIO_DIR.glob('*.json'))})"
+            )
+    scn = json.loads(p.read_text())
+    scn.setdefault("name", p.stem)
+    return scn
+
+
+def verdict_of(report: dict) -> str:
+    """The named diagnosis, derived purely from the analyzer report
+    (the scenario's ``expected.verdict`` is checked against this)."""
+    if report["desync"]["status"] != "none":
+        return "desync"
+    if report.get("hangs"):
+        return "hang"
+    epochs = report.get("resize", {}).get("epochs", {})
+    if any(e.get("failed") for e in epochs.values()):
+        return "resize-torn"
+    if any(e.get("never_entered") for e in epochs.values()):
+        return "resize-incomplete"
+    if report.get("stragglers", {}).get("significant"):
+        return "straggler"
+    for srv in report.get("ps", {}).get("servers", {}).values():
+        conns = srv.get("connections") or {}
+        if conns.get("busy_rejected"):
+            dominant = {
+                a.get("dominant")
+                for a in (srv.get("server_time") or {}).values()
+            }
+            if "queue" in dominant or not dominant:
+                return "ps-overload"
+    return "clean"
+
+
+def _resize_sets(report: dict, key: str) -> set:
+    out: set = set()
+    for e in report.get("resize", {}).get("epochs", {}).values():
+        out.update(e.get(key) or [])
+    return out
+
+
+def _hang_never_entered(report: dict) -> set:
+    out: set = set()
+    for h in report.get("hangs", []):
+        for d in h.get("stuck_collectives", []):
+            out.update(d.get("ranks_never_entered") or [])
+    return out
+
+
+def check_expectations(expected: dict, report: dict,
+                       verdict: str, stats: dict) -> List[str]:
+    """Every failed expectation as a human-readable string (empty =
+    scenario passed)."""
+    failures: List[str] = []
+
+    def need(cond: bool, msg: str) -> None:
+        if not cond:
+            failures.append(msg)
+
+    if "verdict" in expected:
+        need(
+            verdict == expected["verdict"],
+            f"verdict: expected {expected['verdict']!r}, got {verdict!r}",
+        )
+    if "never_entered_includes" in expected:
+        want = set(expected["never_entered_includes"])
+        got = _hang_never_entered(report) | _resize_sets(
+            report, "never_entered"
+        )
+        need(
+            want <= got,
+            f"never-entered ranks: expected ⊇ {sorted(want)}, "
+            f"got {sorted(got)}",
+        )
+    if "resize_failed_min" in expected:
+        got = len(_resize_sets(report, "failed"))
+        need(
+            got >= expected["resize_failed_min"],
+            f"failed barrier entries: expected >= "
+            f"{expected['resize_failed_min']}, got {got}",
+        )
+    if "resize_epochs_min" in expected:
+        got = len(report.get("resize", {}).get("epochs", {}))
+        need(
+            got >= expected["resize_epochs_min"],
+            f"resize epochs: expected >= "
+            f"{expected['resize_epochs_min']}, got {got}",
+        )
+    if "straggler_rank" in expected:
+        got = report.get("stragglers", {}).get("worst")
+        need(
+            got == expected["straggler_rank"],
+            f"worst straggler: expected rank "
+            f"{expected['straggler_rank']}, got {got}",
+        )
+    if "busy_rejected_min" in expected:
+        got = sum(
+            (s.get("connections") or {}).get("busy_rejected", 0)
+            for s in report.get("ps", {}).get("servers", {}).values()
+        )
+        need(
+            got >= expected["busy_rejected_min"],
+            f"busy rejections: expected >= "
+            f"{expected['busy_rejected_min']}, got {got}",
+        )
+    if "dead_mark_expiries_min" in expected:
+        got = sum(
+            (s.get("connections") or {}).get("dead_mark_expiries", 0)
+            for s in report.get("ps", {}).get("servers", {}).values()
+        )
+        need(
+            got >= expected["dead_mark_expiries_min"],
+            f"dead-mark expiries: expected >= "
+            f"{expected['dead_mark_expiries_min']}, got {got}",
+        )
+    if "dead_marks_seen_min" in expected:
+        got = sum(
+            1 for s in report.get("ps", {}).get("servers", {}).values()
+            if "dead_marks_active" in (s.get("connections") or {})
+        )
+        need(
+            got >= expected["dead_marks_seen_min"],
+            f"ranks reporting dead-marks: expected >= "
+            f"{expected['dead_marks_seen_min']}, got {got}",
+        )
+    if "steps_completed_min" in expected:
+        need(
+            stats.get("steps_completed", 0)
+            >= expected["steps_completed_min"],
+            f"steps completed: expected >= "
+            f"{expected['steps_completed_min']}, got "
+            f"{stats.get('steps_completed', 0)}",
+        )
+    return failures
+
+
+def run_scenario(src, out_dir, seed: Optional[int] = None,
+                 ranks: Optional[int] = None) -> Dict[str, Any]:
+    """Run one scenario end to end; returns ``{name, verdict, ok,
+    failures, report, stats, analysis_path}``. ``seed``/``ranks``
+    override the scenario file (the determinism tests re-run with a
+    different seed and assert the verdict survives)."""
+    scn = load_scenario(src)
+    seed = scn.get("seed", 0) if seed is None else seed
+    world = int(ranks if ranks is not None else scn.get("ranks", 64))
+    overrides = dict(scn.get("constants", {}))
+    prev = {k: constants.get(k) for k in overrides}
+    for k, v in overrides.items():
+        constants.set(k, type(constants.get(k))(v))
+    try:
+        fleet = SimFleet(
+            world, seed=seed,
+            group_size=int(scn.get("group_size", 8)),
+            steps=int(scn.get("steps", 8)),
+            state_elems=int(scn.get("state_elems", 1 << 18)),
+            arrival_spread_s=float(scn.get("arrival_spread_s", 0.0)),
+        )
+        for ev in scn.get("events", []):
+            kind = ev["kind"]
+            if kind == "die":
+                fleet.kill(
+                    ev["ranks"], float(ev["t"]),
+                    align=ev.get("align", "exact"),
+                )
+            elif kind == "partition":
+                fleet.partition(
+                    ev["ranks"], float(ev["t"]),
+                    heal_t=ev.get("heal_t"),
+                )
+            elif kind == "straggle":
+                fleet.straggle(
+                    int(ev["rank"]), float(ev["skew_s"]),
+                    t=float(ev.get("t", 0.0)),
+                )
+            else:
+                raise ValueError(f"unknown scenario event kind {kind!r}")
+        if "ps" in scn:
+            ps = dict(scn["ps"])
+            SimPS(
+                fleet,
+                servers=int(ps.get("servers", 4)),
+                replication=int(ps.get("replication", 1)),
+                clients=int(ps.get("clients", 8)),
+                payload_bytes=int(ps.get("payload_bytes", 1 << 16)),
+                interval_s=float(ps.get("interval_s", 0.02)),
+                apply_us=float(ps.get("apply_us", 0.0)),
+                updates_per_client=int(
+                    ps.get("updates_per_client", 40)
+                ),
+            )
+        stats = fleet.run(horizon_s=float(scn.get("horizon_s", 60.0)))
+        out = Path(out_dir)
+        fleet.dump_telemetry(out)
+        run = load_run(out)
+        report = analyze(out, run=run)
+        # the report must be byte-stable across runs AND run dirs: the
+        # only path-dependent field is the dir itself
+        report["dir"] = scn.get("name", "scenario")
+        analysis_path = out / "analysis.json"
+        analysis_path.write_text(
+            json.dumps(report, indent=2, default=str, sort_keys=True)
+        )
+        verdict = verdict_of(report)
+        failures = check_expectations(
+            scn.get("expected", {}), report, verdict, stats
+        )
+        return {
+            "name": scn.get("name", "scenario"),
+            "verdict": verdict,
+            "ok": not failures,
+            "failures": failures,
+            "report": report,
+            "stats": stats,
+            "analysis_path": str(analysis_path),
+        }
+    finally:
+        for k, v in prev.items():
+            try:
+                constants.set(k, v)
+            except constants.FrozenConstantsError:
+                pass

@@ -1,0 +1,838 @@
+"""SimFleet: thousands of simulated ranks driving the real control plane.
+
+One :class:`SimFleet` is a virtual job: ``world`` ranks grouped into
+fast-link islands, running a training-step loop, heartbeating into a
+**real** :class:`~..reshard.elastic.ElasticCoordinator` (constructed
+with ``serve=False`` and the event loop as its clock — the genuine
+membership/epoch/barrier state machine, no sockets or threads), and
+recording **real** :class:`~..telemetry.flightrecorder.FlightRecorder`
+entries per rank at virtual timestamps. Resizes run the real barrier
+(``barrier_arrive``/``barrier_poll``) and price the redistribution with
+the real :func:`~..reshard.core.plan_transfers` schedule over the
+modeled network; training steps carry the real schedule compiler's
+``plan_id`` for the fleet's declared topology, so a cross-rank plan
+divergence is diffable exactly as in production.
+
+The per-rank dumps (:meth:`SimFleet.dump_telemetry`) are format-
+identical to a ``launch --telemetry-dir`` run — ``telemetry_rank_*``
+snapshots, ``heartbeat_rank_*`` liveness, ``hang_rank_*`` watchdog
+reports — which is the point: the PR 6 analyzer diagnoses the simulated
+fleet with the same code that diagnoses a real one.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from .. import constants
+from ..parameterserver.server import initial_chains, reform_layout
+from ..parameterserver.transport import admission_decision, busy_backoff_s
+from ..reshard.core import Layout, plan_transfers
+from ..reshard.elastic import ElasticCoordinator
+from ..schedule import candidate_plans
+from ..schedule.topology import LINK_HOST, Topology
+from ..schedule.cost import link_alpha_us, link_beta_us_per_mib
+from ..telemetry import flightrecorder as _flight
+from ..telemetry.flightrecorder import FlightRecorder
+from ..telemetry.registry import MetricsRegistry
+from .clock import rng_for
+from .core import EventLoop
+from .net import ModeledNetwork
+
+#: virtual t=0 in analyzer wall-clock terms: every recorded timestamp is
+#: WALL_BASE + virtual seconds, so the cross-rank analyzer's wall-clock
+#: math (clock sync offsets, hang windows) works unchanged on sim dumps
+WALL_BASE = 1_750_000_000.0
+
+_T_ISSUE, _T_COMPLETE, _STATUS = (
+    _flight._T_ISSUE, _flight._T_COMPLETE, _flight._STATUS,
+)
+
+
+class SimRank:
+    __slots__ = (
+        "mid", "rank", "recorder", "registry", "alive", "partitioned",
+        "evicted", "skew_s", "last_beat", "committed_epoch", "steps_done",
+        "hang_fired",
+    )
+
+    def __init__(self, mid: int, rank: int):
+        self.mid = mid
+        self.rank = rank
+        self.recorder = FlightRecorder(capacity=1024)
+        self.registry: Optional[MetricsRegistry] = None
+        self.alive = True
+        self.partitioned = False
+        self.evicted = False
+        self.skew_s = 0.0
+        self.last_beat = 0.0
+        self.committed_epoch: Optional[int] = None
+        self.steps_done = 0
+        self.hang_fired = False
+
+    def metrics(self) -> MetricsRegistry:
+        if self.registry is None:
+            self.registry = MetricsRegistry()
+        return self.registry
+
+    def reachable(self, other: "SimRank") -> bool:
+        return self.partitioned == other.partitioned
+
+
+class SimFleet:
+    """A simulated world driving the real control plane (module doc)."""
+
+    def __init__(self, world: int, seed: int = 0, group_size: int = 8,
+                 steps: int = 8, state_elems: int = 1 << 20,
+                 payload_elems: int = 1 << 20,
+                 arrival_spread_s: float = 0.0,
+                 hang_reporters: int = 4):
+        self.loop = EventLoop()
+        self.net = ModeledNetwork(group_size, rng_for(seed, "net"))
+        self.rng = rng_for(seed, "fleet")
+        self.seed = seed
+        self.group_size = group_size
+        self.steps_total = int(steps)
+        self.state_elems = int(state_elems)
+        self.payload_elems = int(payload_elems)
+        self.arrival_spread_s = float(arrival_spread_s)
+        self.hang_reporters = int(hang_reporters)
+        # the REAL membership/epoch/barrier state machine on virtual time
+        self.coord = ElasticCoordinator(serve=False, clock=self.loop.time)
+        mids = self.coord.bulk_join([("sim", 0)] * int(world))
+        self.ranks: Dict[int, SimRank] = {
+            m: SimRank(m, i) for i, m in enumerate(mids)
+        }
+        # rank -> SimRank index (ranks are fixed at formation): the PS
+        # layer resolves peers per modeled event, which must be O(1),
+        # not an O(world) scan, in a 10k-rank simulator
+        self._rank_index: Dict[int, SimRank] = {
+            sr.rank: sr for sr in self.ranks.values()
+        }
+        self.ps: Optional[SimPS] = None
+        self.hangs: List[dict] = []
+        self.stats: Dict[str, Any] = {
+            "world": int(world), "seed": int(seed),
+            "group_size": int(group_size),
+            "resizes": [], "reforms": [], "steps_completed": 0,
+        }
+        self._seen_epoch = self.coord.epoch
+        self._resizing = False
+        self._views: Dict[int, dict] = {}  # epoch -> coordinator view
+        self._publish_t: Dict[int, float] = {self.coord.epoch: 0.0}
+        self._barrier_waiting: List[tuple] = []
+        self._stuck: List[tuple] = []  # (mid, entry) issued, unresolved
+        self._plan_cache: Dict[tuple, tuple] = {}
+        self._pending_kills: List[List[int]] = []
+        self._finished = False
+        self._step_token = 0
+        hb = float(constants.get("elastic_heartbeat_seconds"))
+        self.loop.after(hb, self._beat_tick)
+        self.loop.after(hb * 1.5, self._sweep_tick)
+        self.loop.at(0.0, self._on_epoch)  # formation resize (cold)
+
+    # -- helpers -----------------------------------------------------------
+    def wall(self, t: Optional[float] = None) -> float:
+        return WALL_BASE + (self.loop.now if t is None else t)
+
+    def members_live(self) -> List[int]:
+        return [
+            m for m in self.coord.members()
+            if self.ranks[m].alive and not self.ranks[m].evicted
+        ]
+
+    def _plan(self, world: int) -> tuple:
+        """The real schedule compiler's pick for this world's allreduce:
+        (plan_id, modeled seconds). Candidate generation, gating and the
+        alpha-beta pricing are the deployed code paths."""
+        key = (world, constants.generation())
+        cached = self._plan_cache.get(key)
+        if cached is not None:
+            return cached
+        g = self.group_size
+        sizes = [g] * (world // g)
+        if world % g:
+            sizes.append(world % g)
+        topo = Topology(
+            platform="cpu", group_sizes=tuple(sizes) or (1,),
+            cartesian=len(set(sizes)) == 1 and len(sizes) > 1,
+            nodes=max(1, len(sizes)), name="sim",
+        )
+        cands = candidate_plans(
+            "allreduce", self.payload_elems, 4, topo, backend="ring",
+            wire="full", route_small=False,
+        )
+        feasible = [
+            c for c in cands if c.feasible and c.cost_us is not None
+        ]
+        if feasible:
+            best = min(feasible, key=lambda c: c.cost_us)
+            out = (best.plan.plan_id, best.cost_us * 1e-6)
+        else:  # world size 1: no collective, a local step
+            out = ("local", 0.0)
+        self._plan_cache[key] = out
+        return out
+
+    # -- scenario surface --------------------------------------------------
+    def kill(self, ranks, t: float, align: str = "exact") -> None:
+        """Hard rank death at virtual ``t``: heartbeats stop, in-flight
+        collectives strand. The coordinator notices by heartbeat sweep,
+        exactly as live. ``align='gap'`` defers the death to the next
+        inter-step gap after ``t`` — the victims complete their last
+        step and never issue the next one, so the survivors' stuck
+        collective is diagnosable by seq high-water at ANY world size
+        (an exact-time death can land after the victims already issued,
+        which is a different — also real — evidence shape)."""
+        def _die(rs=list(ranks)):
+            for r in rs:
+                sr = self._by_rank(r)
+                if sr is not None:
+                    sr.alive = False
+        if align == "gap":
+            self.loop.at(t, lambda rs=list(ranks):
+                         self._pending_kills.append(rs))
+        else:
+            self.loop.at(t, _die)
+
+    def partition(self, ranks, t: float,
+                  heal_t: Optional[float] = None) -> None:
+        """Network partition at ``t``: the named ranks stay alive (local
+        heartbeat files keep advancing) but can reach neither the
+        coordinator nor any rank outside the partition. ``heal_t``
+        restores reachability (by then the coordinator has evicted
+        them — the healed ranks discover their eviction and stop)."""
+        def _cut(rs=list(ranks)):
+            for r in rs:
+                sr = self._by_rank(r)
+                if sr is not None:
+                    sr.partitioned = True
+        self.loop.at(t, _cut)
+        if heal_t is not None:
+            def _heal(rs=list(ranks)):
+                for r in rs:
+                    sr = self._by_rank(r)
+                    if sr is not None:
+                        sr.partitioned = False
+                        sr.evicted = True  # membership moved on without it
+            self.loop.at(heal_t, _heal)
+
+    def straggle(self, rank: int, skew_s: float,
+                 t: float = 0.0) -> None:
+        """Give one rank a persistent per-step entry lag (slow host,
+        contended input pipeline) from virtual ``t`` on."""
+        def _skew():
+            sr = self._by_rank(rank)
+            if sr is not None:
+                sr.skew_s = float(skew_s)
+        self.loop.at(t, _skew)
+
+    def _by_rank(self, rank: int) -> Optional[SimRank]:
+        return self._rank_index.get(rank)
+
+    def run(self, horizon_s: float = 120.0) -> Dict[str, Any]:
+        self.loop.run(until=horizon_s)
+        self.stats["virtual_seconds"] = round(self.loop.now, 6)
+        self.stats["events"] = self.loop.processed
+        return self.stats
+
+    # -- heartbeats / sweeps -----------------------------------------------
+    def _beat_tick(self) -> None:
+        if self._finished:
+            return
+        for sr in self.ranks.values():
+            if not sr.alive:
+                continue
+            sr.last_beat = self.loop.now  # local heartbeat file write
+            if sr.partitioned or sr.evicted:
+                continue  # the beat RPC never reaches the coordinator
+            rep = self.coord._handle({"op": "beat", "mid": sr.mid})
+            if not rep.get("member", True):
+                sr.evicted = True
+        self.loop.after(
+            float(constants.get("elastic_heartbeat_seconds")),
+            self._beat_tick,
+        )
+
+    def _sweep_tick(self) -> None:
+        if self._finished:
+            return
+        self.coord.sweep_dead()
+        if self.coord.epoch != self._seen_epoch:
+            self._on_epoch()
+        self.loop.after(
+            float(constants.get("elastic_heartbeat_seconds")),
+            self._sweep_tick,
+        )
+
+    # -- the training-step loop --------------------------------------------
+    def _step(self, token: int) -> None:
+        if self._finished or self._resizing or token != self._step_token:
+            return  # superseded (a resize rescheduled the loop)
+        # the world each rank BELIEVES in is the last published
+        # membership; a member that died since still counts toward the
+        # collective, which is exactly why survivors strand on a death
+        # until the resize supersedes the step
+        world_view = self.coord.members()
+        issuers = [
+            m for m in world_view
+            if self.ranks[m].alive and not self.ranks[m].evicted
+        ]
+        if not issuers:
+            self._finished = True
+            return
+        world = len(world_view)
+        plan_id, coll_s = self._plan(world)
+        comm = f"global[{world}]"
+        payload = f"({self.payload_elems},):float32"
+        t0 = self.loop.now
+        entries = []
+        t_max_issue = t0
+        for m in issuers:
+            sr = self.ranks[m]
+            ti = t0 + sr.skew_s + 0.0005 * self.net.jitter()
+            t_max_issue = max(t_max_issue, ti)
+            e = sr.recorder.record(
+                comm, "allreduce", payload=payload, backend="ring",
+                routing="sim", plan=plan_id,
+            )
+            e[_T_ISSUE] = self.wall(ti)
+            entries.append((m, e, ti))
+        t_done = t_max_issue + coll_s * self.net.jitter()
+        epoch = self.coord.epoch
+        self.loop.at(t_done, self._finish_step, entries, epoch, world_view)
+        wd = float(constants.get("watchdog_timeout_seconds"))
+        if wd > 0:
+            self.loop.at(t_max_issue + wd, self._watchdog_check, entries)
+
+    def _finish_step(self, entries, epoch: int, world_view) -> None:
+        ok = epoch == self.coord.epoch and all(
+            self.ranks[m].alive
+            and not self.ranks[m].partitioned
+            and not self.ranks[m].evicted
+            for m in world_view
+        )
+        if not ok:
+            # the collective tore: entries strand at `issued` until the
+            # resize supersedes the step (survivors) or forever (dead /
+            # partitioned ranks — their dumps carry the evidence)
+            self._stuck.extend((m, e) for m, e, _ in entries)
+            return
+        t = self.loop.now
+        for m, e, _ in entries:
+            e[_T_COMPLETE] = self.wall(t)
+            e[_STATUS] = _flight.STATUS_COMPLETED
+            self.ranks[m].steps_done += 1
+        self.stats["steps_completed"] += 1
+        if self._pending_kills:
+            kills, self._pending_kills = self._pending_kills, []
+            for rs in kills:
+                for r in rs:
+                    sr = self._by_rank(r)
+                    if sr is not None:
+                        sr.alive = False
+        if self.stats["steps_completed"] >= self.steps_total:
+            self._finished = True
+            return
+        self._step_token += 1
+        self.loop.at(
+            t + float(constants.get("sim_step_seconds")),
+            self._step, self._step_token,
+        )
+
+    def _watchdog_check(self, entries) -> None:
+        stuck = [
+            (m, e) for m, e, _ in entries
+            if e[_STATUS] == _flight.STATUS_ISSUED
+        ]
+        if not stuck:
+            return
+        wd = float(constants.get("watchdog_timeout_seconds"))
+        reporters = 0
+        for m, e in sorted(stuck, key=lambda it: self.ranks[it[0]].rank):
+            sr = self.ranks[m]
+            if not sr.alive or sr.hang_fired:
+                continue
+            if reporters >= self.hang_reporters:
+                break
+            sr.hang_fired = True
+            reporters += 1
+            self.hangs.append({
+                "reason": "in_flight_timeout",
+                "rank": sr.rank,
+                "pid": sr.rank,
+                "time": self.wall(),
+                "watchdog_timeout_seconds": wd,
+                "detail": {"stuck": [FlightRecorder._as_dict(e)]},
+            })
+
+    # -- resize ------------------------------------------------------------
+    def _on_epoch(self) -> None:
+        epoch = self.coord.epoch
+        self._seen_epoch = epoch
+        self._publish_t.setdefault(epoch, self.loop.now)
+        # pending arrivals from an older barrier observe the bump: the
+        # stale reply fails their resize entries (the torn-resize path)
+        still = []
+        for mid, ep, entry in self._barrier_waiting:
+            rep = self.coord.barrier_poll(ep)
+            if rep is None:
+                still.append((mid, ep, entry))
+            elif rep.get("stale"):
+                entry[_T_COMPLETE] = self.wall()
+                entry[_STATUS] = _flight.STATUS_FAILED
+            else:
+                pass  # released concurrently; commit handles completion
+        self._barrier_waiting = still
+        self._start_resize(epoch)
+
+    def _start_resize(self, epoch: int) -> None:
+        self._resizing = True
+        view = self.coord._handle({"op": "view"})
+        self._views[epoch] = view
+        participants = [
+            int(m) for m, _, _ in view["members"]
+            if self.ranks[int(m)].alive
+            and not self.ranks[int(m)].partitioned
+            and not self.ranks[int(m)].evicted
+        ]
+        n = max(1, len(participants))
+        for i, mid in enumerate(participants):
+            dt = self.net.control_rtt_s()
+            if self.arrival_spread_s:
+                dt += self.arrival_spread_s * (i + 1) / n
+            self.loop.after(dt, self._arrive, mid, epoch)
+
+    def _arrive(self, mid: int, epoch: int) -> None:
+        sr = self.ranks[mid]
+        if not sr.alive or sr.partitioned or sr.evicted:
+            return
+        view = self._views.get(epoch) or {"prev": [], "members": []}
+        entry = sr.recorder.record(
+            "resize", "resize.enter",
+            payload=f"{len(view['prev'])}->{len(view['members'])}",
+            backend="elastic", routing=f"mid={mid}", seq=epoch,
+        )
+        entry[_T_ISSUE] = self.wall()
+        value = {
+            "step": sr.steps_done,
+            "stateful": sr.committed_epoch is not None,
+            "was": sr.committed_epoch if sr.committed_epoch is not None
+            else -1,
+        }
+        rep = self.coord.barrier_arrive(mid, epoch, value)
+        if rep is None:
+            self._barrier_waiting.append((mid, epoch, entry))
+            return
+        if rep.get("stale"):
+            entry[_T_COMPLETE] = self.wall()
+            entry[_STATUS] = _flight.STATUS_FAILED
+            return
+        self._commit_resize(epoch, rep, (mid, entry))
+
+    def _commit_resize(self, epoch: int, rep: dict, last) -> None:
+        release_t = self.loop.now
+        view = self._views.get(epoch) or {"prev": [], "members": []}
+        summary = rep.get("summary", {})
+        mids = [int(m) for m, _, _ in view["members"]]
+        prev = [int(m) for m in summary.get("src_members", [])] \
+            or [int(m) for m in view.get("prev", [])]
+        k_old, k_new = len(prev), len(mids)
+        chunk = int(constants.get("reshard_chunk_bytes"))
+        commit_t = release_t + 1e-4
+        wire_bytes = 0
+        if summary.get("stateful") and k_old and k_new and k_old != k_new:
+            # the REAL redistribution schedule, priced per transfer on
+            # its actual (source, destination) link class — a receiver
+            # drains its incoming chunks through one scratch buffer, so
+            # its wait is the SUM of its transfers' latencies
+            transfers = plan_transfers(
+                self.state_elems, Layout(k_old), Layout(k_new)
+            )
+            recv_lat: Dict[int, float] = {}
+            for t in transfers:
+                src_m = prev[t.src] if t.src < k_old else prev[0]
+                dst_m = mids[t.dst]
+                if src_m == dst_m:
+                    continue  # local copy: zero wire bytes
+                nbytes = t.n * 4
+                wire_bytes += nbytes
+                recv_lat[t.dst] = recv_lat.get(t.dst, 0.0) \
+                    + self.net.latency_s(
+                        self.ranks[src_m].rank, self.ranks[dst_m].rank,
+                        nbytes, chunk_bytes=chunk,
+                    )
+            lay_new = Layout(k_new)
+            slowest = 0.0
+            for dst in range(k_new):
+                # ring-replica re-formation on the new world rides along
+                s, e = lay_new.interval(self.state_elems, dst)
+                lat = recv_lat.get(dst, 0.0) + self.net.latency_s(
+                    self.ranks[mids[dst]].rank,
+                    self.ranks[mids[(dst + 1) % k_new]].rank,
+                    max(0, e - s) * 4, chunk_bytes=chunk,
+                )
+                slowest = max(slowest, lat)
+            commit_t = release_t + slowest
+        waiting, self._barrier_waiting = self._barrier_waiting, []
+        done = list(waiting) + [(last[0], epoch, last[1])]
+        agreed = int(summary.get("step", 0))
+        for mid, ep, entry in done:
+            if ep != epoch:
+                rep2 = self.coord.barrier_poll(ep)
+                if rep2 is not None and rep2.get("stale"):
+                    entry[_T_COMPLETE] = self.wall()
+                    entry[_STATUS] = _flight.STATUS_FAILED
+                continue
+            entry[_T_COMPLETE] = self.wall(commit_t)
+            entry[_STATUS] = _flight.STATUS_COMPLETED
+            sr = self.ranks[mid]
+            sr.committed_epoch = epoch
+            if summary.get("stateful"):
+                sr.steps_done = agreed
+        # survivors' torn step entries are superseded by the resize (the
+        # retry completes post-commit); dead/partitioned ranks keep
+        # theirs stranded at `issued`
+        still_stuck = []
+        for mid, e in self._stuck:
+            sr = self.ranks[mid]
+            if (
+                sr.alive and not sr.partitioned and not sr.evicted
+                and e[_STATUS] == _flight.STATUS_ISSUED
+            ):
+                e[_T_COMPLETE] = self.wall(commit_t)
+                e[_STATUS] = _flight.STATUS_COMPLETED
+            elif e[_STATUS] == _flight.STATUS_ISSUED:
+                still_stuck.append((mid, e))
+        self._stuck = still_stuck
+        publish_t = self._publish_t.get(epoch, release_t)
+        self.stats["resizes"].append({
+            "epoch": epoch,
+            "world_old": k_old,
+            "world_new": k_new,
+            "publish_to_release_s": round(release_t - publish_t, 6),
+            "commit_s": round(commit_t - publish_t, 6),
+            "redistribution_wire_bytes": wire_bytes,
+            "barrier_reply_bytes": len(json.dumps(rep)),
+            "view_bytes": len(json.dumps(view)),
+        })
+        self._resizing = False
+        if self.ps is not None:
+            # chain re-formation rides the resize commit (PR 10's
+            # coupling): clients had the whole detection window to
+            # dead-mark and fail over first, exactly as live
+            self.loop.at(commit_t, self.ps.on_membership_change)
+        self._step_token += 1
+        self.loop.at(commit_t + 1e-4, self._step, self._step_token)
+
+    # -- dumps -------------------------------------------------------------
+    def dump_telemetry(self, outdir) -> Path:
+        out = Path(outdir)
+        out.mkdir(parents=True, exist_ok=True)
+        for mid in sorted(self.ranks):
+            sr = self.ranks[mid]
+            snap = {
+                "enabled": True,
+                "pid": sr.rank,
+                "time": self.wall(),
+                "clock_sync": {
+                    "wall_time": WALL_BASE, "perf_counter": 0.0,
+                    "monotonic": 0.0, "rank": sr.rank,
+                },
+                "metrics": (
+                    sr.registry.snapshot() if sr.registry is not None
+                    else {}
+                ),
+                "audit": [],
+                "spans": {"buffered": 0, "recorded": 0, "capacity": 0,
+                          "dropped": 0},
+                "flight_recorder": sr.recorder.snapshot(),
+            }
+            (out / f"telemetry_rank_{sr.rank}.json").write_text(
+                json.dumps(snap, indent=1, default=str)
+            )
+            beat = {
+                "rank": sr.rank, "pid": sr.rank,
+                "time": WALL_BASE + sr.last_beat,
+                "seq_high_water": sr.recorder.seq_high_water(),
+                "in_flight": sr.recorder.in_flight_count(),
+            }
+            (out / f"heartbeat_rank_{sr.rank}.json").write_text(
+                json.dumps(beat)
+            )
+        for hang in self.hangs:
+            (out / f"hang_rank_{hang['rank']}.json").write_text(
+                json.dumps(hang, indent=1, default=str)
+            )
+        return out
+
+
+def reform_copies(old_owners, old_chains, new_owners, new_chains,
+                  shard_bytes: int = 0) -> Dict[str, Any]:
+    """Copy-stream accounting for one chain re-formation, shared by the
+    scenario stats and the bench curve (one definition, or the CI
+    hotspot gate and the scenario reports drift apart). ``copies_total``
+    counts every non-head chain member — what the real
+    ``_Instance.reform`` streams (stale-replica refresh included);
+    ``copies_changed`` is the death-sensitive subset whose chain
+    membership actually moved."""
+    copies: Dict[int, int] = {}
+    changed = 0
+    copied_bytes = 0
+    for r, chain in enumerate(new_chains):
+        head = new_owners[r]
+        fresh = len([p for p in chain if p != head])
+        if fresh:
+            copies[head] = copies.get(head, 0) + fresh
+            copied_bytes += fresh * shard_bytes
+            if head != old_owners[r] or list(chain) != list(old_chains[r]):
+                changed += fresh
+    return {
+        "copies_total": sum(copies.values()),
+        "copies_changed": changed,
+        "max_copies_per_head": max(copies.values(), default=0),
+        "copied_bytes": copied_bytes,
+    }
+
+
+# ---------------------------------------------------------------------------
+# modeled PS fabric layer (real chain planner + admission policy)
+# ---------------------------------------------------------------------------
+
+
+class SimPS:
+    """A modeled PS shard group inside the fleet: the first ``servers``
+    ranks own one shard each; ``clients`` ranks stream downpour-shaped
+    updates at them. Chains come from the real
+    :func:`~..parameterserver.server.initial_chains`; death/partition
+    re-forms them through the real
+    :func:`~..parameterserver.server.reform_layout` (fan-out measured);
+    admission control is the real
+    :func:`~..parameterserver.transport.admission_decision` against the
+    ``ps_pending_frame_budget`` knob, and BUSY retries back off with the
+    real :func:`~..parameterserver.transport.busy_backoff_s`. Failover
+    dead-marks honor ``ps_dead_peer_retry_s`` on the virtual clock and
+    surface as the ``tm_ps_dead_marks_active`` /
+    ``tm_ps_dead_mark_expiries_total`` series ``ps_health`` reads."""
+
+    def __init__(self, fleet: SimFleet, servers: int, replication: int = 1,
+                 clients: int = 8, payload_bytes: int = 1 << 16,
+                 interval_s: float = 0.02, apply_us: float = 0.0,
+                 updates_per_client: int = 40, start_t: float = 0.1):
+        self.fleet = fleet
+        self.rng = rng_for(fleet.seed, "ps")
+        self.owners = list(range(int(servers)))
+        self.replication = max(1, int(replication))
+        self.chains = initial_chains(self.owners, self.replication)
+        self.payload_bytes = int(payload_bytes)
+        self.interval_s = float(interval_s)
+        self.updates_per_client = int(updates_per_client)
+        if apply_us <= 0:
+            mib = self.payload_bytes / float(1 << 20)
+            apply_us = link_alpha_us(LINK_HOST) \
+                + mib * link_beta_us_per_mib(LINK_HOST)
+        self.apply_s = apply_us * 1e-6
+        self.servers: Dict[int, dict] = {
+            p: {"pending": 0, "next_free": 0.0, "floors": {}, "busy": 0}
+            for p in self.owners
+        }
+        nranks = len(fleet.ranks)
+        first = int(servers)
+        self.clients = [
+            first + i for i in range(int(clients))
+            if first + i < nranks
+        ]
+        self.stats = {"acked": 0, "busy": 0, "failovers": 0,
+                      "unroutable": 0}
+        self._marks: Dict[int, Dict[int, float]] = {
+            c: {} for c in self.clients
+        }
+        self._expiries: Dict[int, int] = {c: 0 for c in self.clients}
+        fleet.ps = self
+        for i, c in enumerate(self.clients):
+            t0 = start_t + self.rng.uniform(0, self.interval_s)
+            fleet.loop.at(t0, self._send, c, 1, 0)
+
+    # -- chain maintenance -------------------------------------------------
+    def live_procs(self) -> List[int]:
+        out = []
+        for p in self.owners:
+            sr = self.fleet._by_rank(p)
+            if sr is not None and sr.alive and not sr.partitioned:
+                out.append(p)
+        return out
+
+    def on_membership_change(self) -> None:
+        """Deaths/partitions re-form the chains through the REAL
+        planner; the copies each new head must stream are the fan-out
+        the 10k-rank curve measures."""
+        live = self.live_procs()
+        if not live or sorted(live) == sorted(set(self.owners)):
+            return
+        try:
+            new_owners, new_chains = reform_layout(
+                self.owners, self.chains, live, self.replication
+            )
+        except RuntimeError:
+            return  # unrecoverable shard: scenario asserts elsewhere
+        acct = reform_copies(
+            self.owners, self.chains, new_owners, new_chains,
+            shard_bytes=self.payload_bytes,
+        )
+        self.fleet.stats["reforms"].append({
+            "t": round(self.fleet.loop.now, 6),
+            "live": len(live),
+            "shards": len(self.owners),
+            **acct,
+        })
+        self.owners, self.chains = new_owners, new_chains
+
+    # -- client update flow ------------------------------------------------
+    def _sweep_marks(self, c: int) -> None:
+        """Expire dead-marks past their retry window: the peer is
+        re-probed on its next chain walk (the expiry that closes the
+        bounded split-brain window — counted like the live transport's
+        ``tm_ps_dead_mark_expiries_total``)."""
+        ttl = float(constants.get("ps_dead_peer_retry_s"))
+        if not ttl:
+            return
+        now = self.fleet.loop.now
+        marks = self._marks[c]
+        for p in [p for p, t in marks.items() if now - t >= ttl]:
+            del marks[p]
+            self._count_expiry(c)
+
+    def _route(self, c: int, shard: int):
+        """Failover walk down the shard's chain with virtual-clock
+        dead-marks (the transport's routing policy on sim time)."""
+        now = self.fleet.loop.now
+        self._sweep_marks(c)
+        marks = self._marks[c]
+        chain = self.chains[shard % len(self.chains)]
+        candidates = [p for p in chain if p not in marks]
+        for p in candidates or list(chain):
+            srv = self.fleet._by_rank(p)
+            cli = self.fleet._by_rank(c)
+            if (
+                srv is not None and srv.alive and cli is not None
+                and srv.reachable(cli)
+            ):
+                return p
+            marks[p] = now
+            self.stats["failovers"] += 1
+            self._client_metrics(c)
+        return None
+
+    def _count_expiry(self, c: int) -> None:
+        self._expiries[c] += 1
+        sr = self.fleet._by_rank(c)
+        if sr is not None:
+            sr.metrics().counter(
+                "tm_ps_dead_mark_expiries_total",
+                "dead-mark retry windows elapsed (peer re-probed)",
+            ).inc()
+        self._client_metrics(c)
+
+    def _client_metrics(self, c: int) -> None:
+        sr = self.fleet._by_rank(c)
+        if sr is None:
+            return
+        ttl = float(constants.get("ps_dead_peer_retry_s"))
+        now = self.fleet.loop.now
+        active = sum(
+            1 for t in self._marks[c].values()
+            if not ttl or now - t < ttl
+        )
+        sr.metrics().gauge(
+            "tm_ps_dead_marks_active",
+            "peers skipped by failover routing",
+        ).set(active)
+
+    def _send(self, c: int, seq: int, attempts: int) -> None:
+        if seq > self.updates_per_client or self.fleet._finished:
+            return
+        cli = self.fleet._by_rank(c)
+        if cli is None or not cli.alive:
+            return
+        p = self._route(c, seq)
+        if p is None:
+            self.stats["unroutable"] += 1
+            self.fleet.loop.after(
+                self.interval_s, self._send, c, seq, 0
+            )
+            return
+        lat = self.fleet.net.latency_s(c, p, self.payload_bytes)
+        self.fleet.loop.after(
+            lat, self._arrive, p, c, seq, attempts, self.fleet.loop.now
+        )
+
+    def _arrive(self, p: int, c: int, seq: int, attempts: int,
+                sent_t: float) -> None:
+        srv_rank = self.fleet._by_rank(p)
+        cli = self.fleet._by_rank(c)
+        if (
+            srv_rank is None or not srv_rank.alive or cli is None
+            or not srv_rank.reachable(cli)
+        ):
+            # the connection broke in flight: mark and re-route
+            self._marks[c][p] = self.fleet.loop.now
+            self.stats["failovers"] += 1
+            self._client_metrics(c)
+            self.fleet.loop.after(0.001, self._send, c, seq, attempts)
+            return
+        srv = self.servers.setdefault(
+            p, {"pending": 0, "next_free": 0.0, "floors": {}, "busy": 0}
+        )
+        budget = int(constants.get("ps_pending_frame_budget"))
+        admit, srv["floors"][c] = admission_decision(
+            srv["pending"], budget, srv["floors"].get(c), seq, True
+        )
+        reg = srv_rank.metrics()
+        now = self.fleet.loop.now
+        if not admit:
+            srv["busy"] += 1
+            self.stats["busy"] += 1
+            reg.counter(
+                "tm_ps_busy_rejected_total",
+                "frames rejected by the admission budget",
+            ).inc(listener=str(p))
+            back = busy_backoff_s(
+                attempts + 1, int(constants.get("ps_busy_retry_ms")),
+                rng=self.rng,
+            )
+            reply_lat = self.fleet.net.latency_s(p, c, 64)
+            self.fleet.loop.after(
+                reply_lat + back, self._send, c, seq, attempts + 1
+            )
+            return
+        srv["pending"] += 1
+        start = max(srv["next_free"], now)
+        done = start + self.apply_s
+        srv["next_free"] = done
+        reg.histogram(
+            "tm_ps_server_queue_seconds",
+            "admission-to-apply-start wait per admitted PS frame",
+        ).observe(start - now, kind="update")
+        reg.histogram(
+            "tm_ps_server_apply_seconds",
+            "apply time per admitted PS frame",
+        ).observe(self.apply_s, kind="update")
+        self.fleet.loop.at(done, self._done, p, c, seq, sent_t)
+
+    def _done(self, p: int, c: int, seq: int, sent_t: float) -> None:
+        srv = self.servers[p]
+        srv["pending"] -= 1
+        self.stats["acked"] += 1
+        srv_rank = self.fleet._by_rank(p)
+        if srv_rank is not None:
+            reply_lat = self.fleet.net.latency_s(p, c, 64)
+            srv_rank.metrics().histogram(
+                "tm_ps_rpc_latency_seconds",
+                "submit-to-reply latency per PS frame",
+            ).observe(
+                self.fleet.loop.now + reply_lat - sent_t, kind="update"
+            )
+        self.fleet.loop.after(
+            self.interval_s, self._send, c, seq + 1, 0
+        )

@@ -1,0 +1,65 @@
+"""Modeled network: the PR 9 cost-model constants as a latency oracle.
+
+Ranks group into fast-link islands of ``group_size`` (the declared
+:class:`~..schedule.topology.Topology` the schedule compiler plans
+against); same-island transfers ride the ICI alpha-beta constants,
+cross-island the DCN ones, and control-plane RPCs a flat
+``sim_control_rtt_us``. Every latency is multiplied by seeded jitter
+(uniform in ``[1-j, 1+j]``, ``sim_jitter_pct``), so the fleet is noisy
+the way real fabrics are noisy — but identically noisy per seed.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from .. import constants
+from ..schedule.cost import link_alpha_us, link_beta_us_per_mib
+from ..schedule.topology import LINK_DCN, LINK_ICI
+
+_MIB = float(1 << 20)
+
+
+class ModeledNetwork:
+    def __init__(self, group_size: int, rng: random.Random,
+                 jitter_pct: Optional[float] = None):
+        self.group_size = max(1, int(group_size))
+        self.rng = rng
+        self._jitter = (
+            float(constants.get("sim_jitter_pct"))
+            if jitter_pct is None else float(jitter_pct)
+        )
+
+    def jitter(self) -> float:
+        j = self._jitter
+        if j <= 0:
+            return 1.0
+        return self.rng.uniform(1.0 - j, 1.0 + j)
+
+    def link(self, a: int, b: int) -> str:
+        return (
+            LINK_ICI if a // self.group_size == b // self.group_size
+            else LINK_DCN
+        )
+
+    def latency_s(self, src: int, dst: int, nbytes: int,
+                  chunk_bytes: int = 0) -> float:
+        """One transfer's modeled latency: alpha per chunk + beta on the
+        payload, jittered. ``chunk_bytes`` > 0 models a chunked stream
+        (the reshard data plane): each chunk pays the per-hop alpha."""
+        level = self.link(src, dst)
+        chunks = 1
+        if chunk_bytes and nbytes > chunk_bytes:
+            chunks = -(-nbytes // chunk_bytes)
+        us = (
+            chunks * link_alpha_us(level)
+            + (nbytes / _MIB) * link_beta_us_per_mib(level)
+        )
+        return us * 1e-6 * self.jitter()
+
+    def control_rtt_s(self) -> float:
+        """Member <-> coordinator control round trip (join, barrier
+        arrival, view fetch)."""
+        return float(constants.get("sim_control_rtt_us")) * 1e-6 \
+            * self.jitter()

@@ -405,6 +405,11 @@ def ps_health(ranks: Dict[int, dict]) -> dict:
             ("tm_ps_accepts_total", "accepted"),
             ("tm_ps_disconnects_total", "disconnected"),
             ("tm_ps_busy_rejected_total", "busy_rejected"),
+            # failover dead-marks: active = peers this rank is currently
+            # routing around; expiries = retry windows that elapsed (each
+            # one closed a bounded split-brain window by re-probing)
+            ("tm_ps_dead_marks_active", "dead_marks_active"),
+            ("tm_ps_dead_mark_expiries_total", "dead_mark_expiries"),
         ):
             series = metrics.get(name, {}).get("series", {})
             if series:
