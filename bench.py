@@ -876,16 +876,32 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     # regression like an accidental device sync is 100x that), with the
     # relative number kept as reported evidence only.
     from torchmpi_tpu.telemetry import flightrecorder as flight
+    from torchmpi_tpu.telemetry import live as live_mod
     from torchmpi_tpu.telemetry.watchdog import start_watchdog, stop_watchdog
 
     start_watchdog(timeout=600.0, interval=0.25, heartbeat_dir=None)
+    # the live-plane exporter is part of the "telemetry on" side of the
+    # gate: a local aggregator + a fast-interval exporter stream real
+    # frames during the on-laps (paused for the off-laps), so the CI
+    # budget covers recorder + watchdog + exporter together
+    constants.set("telemetry_live_interval_s", 0.1)
+    live_agg = live_mod.FleetAggregator()
+    live_agg.serve()
+    live_exp = live_mod.start_exporter(
+        ("127.0.0.1", live_agg.ingest_port), rank=0
+    )
     off_laps, on_laps = [], []
     for _ in range(iters):
         telemetry.disable()
         flight.disable()
+        live_exp.pause()
         off_laps.append(unfused_pass() + fused_pass())
         flight.enable()
+        live_exp.resume()
         on_laps.append(unfused_pass() + fused_pass())
+    live_frames = live_agg.frames_total
+    live_mod.stop_exporter()
+    live_agg.close()
     stop_watchdog()
     flight.disable()
     telemetry.enable()
@@ -910,6 +926,24 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     fused_pass()
     compiles_after = compile_misses() - misses_before
     plan_misses_after = plan_misses() - plan_misses_before
+
+    # measured cost-model calibration from THIS run's dispatch samples
+    # (the same extraction the live aggregator does from streamed
+    # tails): fit per-(op, comm, wire) over the LeNet bucket set and
+    # compare the hand-set analytic model's error against the fit's.
+    # Persisted (the tune_plan idiom; start() re-applies) when the
+    # cache path env var is set — how CI captures the artifact.
+    from torchmpi_tpu import schedule as schedule_mod
+    from torchmpi_tpu.telemetry import calibrate as calibrate_mod
+
+    cal_store = calibrate_mod.samples_from_entries(
+        flight.recorder.entries()
+    )
+    cal = schedule_mod.calibrate(
+        cal_store, apply=False,
+        persist=bool(os.environ.get("TORCHMPI_TPU_CALIBRATION_CACHE")),
+    )
+    cal_report = cal["report"]
 
     fused_us = warm_fused_s / n_tensors * 1e6
     unfused_us = warm_unfused_s / n_tensors * 1e6
@@ -937,6 +971,15 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
         ),
         "recorder_off_ms": round(off_s * 1e3, 4),
         "recorder_on_ms": round(on_s * 1e3, 4),
+        "live_exporter_armed": True,
+        "live_frames_streamed": live_frames,
+        "calibration": {
+            "samples": cal_report["samples"],
+            "keys": cal_report["keys"],
+            "modeled_err_pct": cal_report["modeled_err_pct"],
+            "calibrated_err_pct": cal_report["calibrated_err_pct"],
+            "path": cal.get("path"),
+        },
     }
     print(json.dumps(line), flush=True)
     mpi.stop()
@@ -947,11 +990,23 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
         # above this box's median-of-laps noise floor — every relative
         # threshold tried here (2%, 5%) flaked on unchanged code
         overhead_ok = recorder_overhead_us_per_dispatch < 150.0
+        # calibration gate: the fitted cost model must beat the
+        # hand-set analytic constants on this run's measured medians
+        # (strictly smaller mean |error|), with frames actually
+        # streamed through the live plane during the on-laps
+        cal_ok = (
+            cal_report["modeled_err_pct"] is not None
+            and cal_report["calibrated_err_pct"] is not None
+            and cal_report["calibrated_err_pct"]
+            < cal_report["modeled_err_pct"]
+        )
         ok = (
             fused_us <= unfused_us
             and compiles_after == 0
             and plan_misses_after == 0
             and overhead_ok
+            and cal_ok
+            and live_frames > 0
         )
         if not ok:
             print(
@@ -959,9 +1014,13 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
                 f"{unfused_us:.1f}us per tensor, "
                 f"{compiles_after} post-precompile compiles, "
                 f"{plan_misses_after} post-precompile plan-cache misses, "
-                "recorder+watchdog overhead "
+                "recorder+watchdog+exporter overhead "
                 f"{recorder_overhead_us_per_dispatch:.1f}us/dispatch "
-                f"({recorder_overhead_pct:.2f}%; budget 150us/dispatch)",
+                f"({recorder_overhead_pct:.2f}%; budget 150us/dispatch), "
+                f"calibration modeled {cal_report['modeled_err_pct']}% vs "
+                f"calibrated {cal_report['calibrated_err_pct']}% "
+                f"(calibrated must be strictly smaller), "
+                f"{live_frames} live frames streamed",
                 file=sys.stderr,
                 flush=True,
             )

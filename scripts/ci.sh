@@ -58,10 +58,21 @@ run_perf_smoke() {
     # CPU and show fused dispatch <= unfused for the canonical LeNet
     # bucket set (correctness-of-direction, not absolute timing), with
     # zero collective compiles after precompile(). --check encodes both
-    # assertions in the exit code.
-    echo "=== perf-smoke (eager dispatch microbench, CPU) ==="
+    # assertions in the exit code, plus the live-plane extensions: the
+    # recorder-overhead laps run with the live exporter ARMED (streaming
+    # real frames to a local aggregator) under the same 150us/dispatch
+    # budget, and schedule.calibrate() fit from this run's dispatch
+    # samples must beat the hand-set plan_cost_* constants
+    # (calibrated error strictly smaller) — the calibration table is
+    # persisted to a temp cache as the CI artifact of the persistence
+    # path start() re-applies.
+    echo "=== perf-smoke (eager dispatch microbench + live plane, CPU) ==="
+    calfile="$(mktemp -u).calibration.json"
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        TORCHMPI_TPU_CALIBRATION_CACHE="$calfile" \
         python bench.py --microbench --check
+    test -s "$calfile"  # the persisted calibrated cost model must exist
+    rm -f "$calfile"
     # PS wire perf-smoke: int8 wire must move >= 2x the effective logical
     # bytes/sec of fp32 on the LeNet parameter round trip over the paced
     # (bandwidth-bound) link, with every decoded fetch inside its
@@ -80,6 +91,13 @@ run_perf_smoke() {
     # `desync: none` analyzer report.
     echo "=== telemetry smoke (2-proc flight recorder + analyzer) ==="
     python scripts/telemetry_smoke.py
+    # live-plane smoke: a 2-proc job with --telemetry-live must serve
+    # fleet Prometheus + JSON (per-rank seq high-waters) and a streaming
+    # `desync: none` verdict WHILE still running, the top CLI must
+    # render both ranks, and a clean shutdown must leave no exporter
+    # threads behind.
+    echo "=== live telemetry smoke (2-proc streaming aggregator) ==="
+    python scripts/live_smoke.py
     # resize smoke: a 2-proc live-elastic run must survive an operator
     # grow (2->3) and shrink (3->2) through the launcher without any
     # relaunch, with `desync: none` and every live rank inside every

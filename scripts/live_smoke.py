@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""CI smoke for the live telemetry plane.
+
+Launches a short 2-process job with ``--telemetry-live``, and WHILE it
+is still running scrapes the launcher-resident fleet aggregator:
+
+- ``/metrics`` must serve fleet-level Prometheus text with per-rank
+  ``tm_fleet_seq_high_water{rank=...,comm=...}`` lines for both ranks;
+- ``/verdicts`` must carry a streaming ``desync: none`` verdict summary
+  (identical collective streams) with both ranks known;
+- ``/health`` must list both ranks with fresh report ages;
+- ``python -m torchmpi_tpu.telemetry.top <addr> --once`` must render a
+  row per rank.
+
+After the job exits: launch rc == 0, and each rank must have printed
+the ``exporter-threads-clean`` marker (explicit ``stop_exporter()``
+leaves no ``tm-live-exporter`` thread behind — clean shutdown). Exits
+non-zero on any failed assertion — wired into ``scripts/ci.sh fast``.
+"""
+
+import json
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from urllib.request import urlopen
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = """
+import os, sys, threading, time
+sys.path.insert(0, {repo!r})
+os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+import numpy as np
+import torchmpi_tpu as mpi
+
+mpi.start()
+p = mpi.current_communicator().size
+# enough wall time for several live export intervals mid-run
+for i in range(24):
+    mpi.allreduce_tensor(np.ones((p, 32), np.float32))
+    time.sleep(0.25)
+mpi.stop()
+from torchmpi_tpu.telemetry import live
+live.stop_exporter()
+leftovers = [t.name for t in threading.enumerate()
+             if t.name == "tm-live-exporter"]
+assert not leftovers, leftovers
+print("exporter-threads-clean", flush=True)
+"""
+
+
+def _get(base: str, path: str):
+    with urlopen(f"http://{base}{path}", timeout=10) as resp:
+        return resp.read().decode()
+
+
+def main() -> int:
+    tmp = Path(tempfile.mkdtemp(prefix="tm_live_smoke_"))
+    worker = tmp / "worker.py"
+    worker.write_text(WORKER.format(repo=str(REPO)))
+    addr_file = tmp / "live_addr.json"
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "torchmpi_tpu.launch",
+         "--nproc", "2", "--cpu-devices", "2",
+         "--telemetry-live",
+         "--telemetry-live-addr-file", str(addr_file),
+         "--set-constant", "telemetry_live_interval_s=0.25",
+         str(worker)],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    checks = {}
+    try:
+        deadline = time.time() + 120
+        while not addr_file.exists() and time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        if not addr_file.exists():
+            out, _ = proc.communicate(timeout=60)
+            print(out[-3000:])
+            print("live smoke FAILED: no live addr file", file=sys.stderr)
+            return 1
+        base = json.loads(addr_file.read_text())["http"]
+
+        # wait until both ranks reported at least one frame, mid-run
+        health = {}
+        deadline = time.time() + 120
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                health = json.loads(_get(base, "/health"))
+            except OSError:
+                time.sleep(0.25)
+                continue
+            if set(health.get("ranks", {})) >= {"0", "1"} and health.get(
+                "fleet_seq_high_water"
+            ):
+                # both ranks streaming AND collectives already recorded
+                break
+            time.sleep(0.25)
+        mid_run = proc.poll() is None
+        checks["scraped while the job was still running"] = mid_run
+        checks["/health lists both ranks"] = (
+            set(health.get("ranks", {})) >= {"0", "1"}
+        )
+
+        prom = _get(base, "/metrics")
+        hw_ranks = {
+            line.split('rank="', 1)[1].split('"', 1)[0]
+            for line in prom.splitlines()
+            if line.startswith("tm_fleet_seq_high_water{")
+        }
+        checks["per-rank seq high-waters on /metrics"] = (
+            hw_ranks >= {"0", "1"}
+        )
+
+        verd = json.loads(_get(base, "/verdicts"))
+        checks["streaming desync: none"] = (
+            "desync: none" in verd.get("summary", [])
+        )
+        checks["live verdict clean"] = verd.get("verdict") == "clean"
+
+        top = subprocess.run(
+            [sys.executable, "-m", "torchmpi_tpu.telemetry.top", base,
+             "--once"],
+            cwd=str(REPO), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=60,
+        )
+        rows = [
+            line for line in top.stdout.splitlines()
+            if line.strip().startswith(("0 ", "1 "))
+        ]
+        checks["top CLI renders a row per rank"] = (
+            top.returncode == 0 and len(rows) >= 2
+        )
+
+        out, _ = proc.communicate(timeout=180)
+        checks["launch rc == 0"] = proc.returncode == 0
+        checks["both ranks shut their exporters down clean"] = (
+            out.count("exporter-threads-clean") == 2
+        )
+        if proc.returncode != 0:
+            print(out[-3000:])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    failed = [name for name, passed in checks.items() if not passed]
+    for name, passed in checks.items():
+        print(f"  [{'ok' if passed else 'FAIL'}] {name}")
+    if failed:
+        print(f"live smoke FAILED: {failed}", file=sys.stderr)
+        return 1
+    print("live smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,13 @@ if "TORCHMPI_TPU_TUNING_CACHE" not in os.environ:
     os.environ["TORCHMPI_TPU_TUNING_CACHE"] = os.path.join(
         tempfile.mkdtemp(prefix="tm-test-tuning-"), "autotune.json"
     )
+# same isolation for the measured cost-model calibration start() loads
+if "TORCHMPI_TPU_CALIBRATION_CACHE" not in os.environ:
+    import tempfile
+
+    os.environ["TORCHMPI_TPU_CALIBRATION_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="tm-test-calib-"), "calibration.json"
+    )
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -43,11 +50,14 @@ def _fresh_runtime():
     yield
     from torchmpi_tpu import constants, runtime_state
     from torchmpi_tpu.schedule import compiler as _sched_compiler
+    from torchmpi_tpu.schedule import cost as _sched_cost
 
     runtime_state._reset_for_tests()
     constants._reset_for_tests()
-    # plan overrides are process-global autotuner state like constants
+    # plan overrides and the measured calibration table are
+    # process-global autotuner state like constants
     _sched_compiler.clear_plan_overrides()
+    _sched_cost.clear_calibration()
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -927,3 +927,80 @@ def test_launcher_max_restarts_budget_exhausted(tmp_path):
     )
     assert proc.returncode == 7, (proc.returncode, proc.stdout[-800:])
     assert proc.stdout.count("restarting the world") == 2
+
+
+_KILLED_MEMBER_WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+    import torchmpi_tpu  # arms watchdog + live exporter from env
+
+    rank = int(os.environ["TORCHMPI_TPU_PROCESS_ID"])
+    teldir = sys.argv[1]
+    if rank == 1:
+        time.sleep(2.0)
+        # hard death: no atexit, no live 'bye', heartbeat never
+        # retracted — exactly what a SIGKILL'd member leaves behind.
+        # Exit code 0 keeps the launcher from terminating rank 0
+        # before its watchdog can diagnose the silence.
+        os._exit(0)
+    # rank 0 outlives rank 1 long enough for (a) the aggregator to mark
+    # the severed stream dead and (b) the watchdog to see the stale
+    # heartbeat and compose the two into a 'peer_dead' attribution
+    deadline = time.time() + 60
+    marker = os.path.join(teldir, "dead_rank_1.json")
+    reports = [
+        os.path.join(teldir, "hang_rank_0.json"),
+        os.path.join(teldir, "hang_rank_0.peer_dead.json"),
+    ]
+    import json
+    while time.time() < deadline:
+        for p in reports:
+            if os.path.exists(p):
+                if json.load(open(p))["reason"] == "peer_dead":
+                    print("peer-dead attributed", flush=True)
+                    sys.exit(0)
+        time.sleep(0.2)
+    sys.exit(3)
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_live_plane_marks_killed_member_and_watchdog_attributes_peer_dead(
+    tmp_path,
+):
+    """Watchdog/aggregator composition (live plane): a 2-proc member
+    that dies hard (no bye, heartbeat left behind) is flagged dead by
+    the launcher's aggregator (dead_rank_1.json), and the survivor's
+    watchdog then attributes 'peer_dead' — not 'stale heartbeat' — in
+    its hang report."""
+    import json
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_KILLED_MEMBER_WORKER)
+    tel = tmp_path / "tel"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "1",
+            "--telemetry-dir", str(tel), "--telemetry-live",
+            "--watchdog-timeout", "1",
+            "--set-constant", "telemetry_live_interval_s=0.1",
+            str(worker), "--", str(tel),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "peer-dead attributed" in proc.stdout
+    # the live plane's marker and the composed attribution both exist
+    assert (tel / "dead_rank_1.json").exists()
+    report = None
+    for name in ("hang_rank_0.json", "hang_rank_0.peer_dead.json"):
+        p = tel / name
+        if p.exists() and json.loads(p.read_text())["reason"] == "peer_dead":
+            report = json.loads(p.read_text())
+    assert report is not None
+    assert [b["rank"] for b in report["detail"]["peers"]] == [1]

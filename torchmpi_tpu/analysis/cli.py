@@ -64,6 +64,18 @@ def run_analysis(
         per_file[sf].extend(locks.check_file(sf))
         per_file[sf].extend(epoch_mod.check_file(sf))
 
+    if doc_paths is None:
+        doc_paths = [root / "README.md", root / "docs" / "PARITY.md"]
+    owner = {sf.display: sf for sf in sources}
+
+    def _attribute(repo_findings):
+        for f in repo_findings:
+            sf = owner.get(f.file)
+            if sf is not None:
+                per_file.setdefault(sf, []).append(f)
+            else:  # pragma: no cover - finding on an unscanned file
+                findings.append(f)
+
     # repo-level knob rules: keyed off a scanned constants.py that
     # defines _Constants
     constants_sf = next(
@@ -72,22 +84,18 @@ def run_analysis(
         None,
     )
     if constants_sf is not None:
-        if doc_paths is None:
-            doc_paths = [root / "README.md", root / "docs" / "PARITY.md"]
         runtime_state_sf = next(
             (sf for sf in sources if sf.path.name == "runtime_state.py"),
             None,
         )
-        knob_findings = knobs_mod.check_knobs(
+        _attribute(knobs_mod.check_knobs(
             constants_sf, sources, doc_paths, runtime_state_sf
-        )
-        owner = {sf.display: sf for sf in sources}
-        for f in knob_findings:
-            sf = owner.get(f.file)
-            if sf is not None:
-                per_file.setdefault(sf, []).append(f)
-            else:  # pragma: no cover - finding on an unscanned file
-                findings.append(f)
+        ))
+
+    # repo-level metric documentation rule (TPL204): every registered
+    # tm_* family must be in the docs table — the metrics mirror of
+    # TPL203, and not gated on constants.py being in the scan set
+    _attribute(knobs_mod.check_metrics_docs(sources, doc_paths))
 
     for sf, flist in per_file.items():
         for f in flist:

@@ -72,6 +72,11 @@ RULES: Dict[str, Tuple[str, str]] = {
         "knob-undocumented",
         "constants knob is not mentioned in README or docs/PARITY.md",
     ),
+    "TPL204": (
+        "metric-undocumented",
+        "registered tm_* metric family is not mentioned in README or "
+        "docs/PARITY.md",
+    ),
 }
 
 _SLUG_TO_ID = {slug: rid for rid, (slug, _) in RULES.items()}

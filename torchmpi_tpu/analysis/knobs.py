@@ -1,4 +1,4 @@
-"""Knob-consistency lint (rules TPL201-TPL203).
+"""Knob/metric-consistency lint (rules TPL201-TPL204).
 
 ``constants.py`` is the single source of truth for every tunable knob.
 Three invariants keep it honest:
@@ -15,6 +15,12 @@ Three invariants keep it honest:
 - **TPL203 knob-undocumented** — every knob must appear in README.md or
   docs/PARITY.md (suffix pairs like ``_cpu``/``_tpu`` may be documented
   by their base name).
+- **TPL204 metric-undocumented** — every registered ``tm_*`` metric
+  family (a ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` call
+  with a ``tm_``-prefixed literal name) must appear in the metrics
+  documentation table (README.md or docs/PARITY.md), same shape as
+  TPL203 for knobs: an undocumented family is an operator surface
+  nobody can discover.
 """
 
 from __future__ import annotations
@@ -143,5 +149,61 @@ def check_knobs(
                 "constants knobs are settable at the entry point",
                 hint="add **constant_overrides to start() and forward "
                 "each to constants.set()",
+            ))
+    return findings
+
+
+_METRIC_REGISTRARS = ("counter", "gauge", "histogram")
+
+
+def registered_metric_families(
+    package_files: Sequence[SourceFile],
+) -> Dict[str, Tuple[str, int]]:
+    """Every ``tm_*`` family registered anywhere in the tree:
+    name -> (file display path, first registration line)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in package_files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] not in _METRIC_REGISTRARS:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ) and arg.value.startswith("tm_"):
+                if arg.value not in out:
+                    out[arg.value] = (sf.display, node.lineno)
+    return out
+
+
+def check_metrics_docs(
+    package_files: Sequence[SourceFile],
+    doc_paths: Sequence[Path],
+) -> List[Finding]:
+    """TPL204: every registered ``tm_*`` metric family must appear in
+    the metrics documentation (README.md / docs/PARITY.md)."""
+    docs = ""
+    for p in doc_paths:
+        try:
+            docs += Path(p).read_text()
+        except OSError:
+            pass
+    findings: List[Finding] = []
+    if not docs:
+        return findings  # no docs to check against (same rule as TPL203)
+    for name, (display, line) in sorted(
+        registered_metric_families(package_files).items()
+    ):
+        if name not in docs:
+            findings.append(Finding(
+                "TPL204", display, line,
+                f"metric family '{name}' is not mentioned in README.md "
+                "or docs/PARITY.md",
+                hint="add a row (name, type, labels, emitting module) "
+                "to the metrics table",
             ))
     return findings

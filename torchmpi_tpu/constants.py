@@ -199,6 +199,23 @@ class _Constants:
     # Watchdog poll + heartbeat-file period, in seconds.
     watchdog_interval_seconds: int = 1
 
+    # --- live telemetry plane (telemetry/live.py) ---
+    # Export period of the per-rank live exporter: every interval one
+    # bounded frame (metric-family delta, flight seq high-waters, flight
+    # tail) streams to the fleet aggregator (`launch --telemetry-live`).
+    # Also sets the aggregator's default staleness bound (3 intervals
+    # without a frame = a stale rank).
+    telemetry_live_interval_s: float = 1.0
+    # Newest flight-recorder entries shipped per frame. Bounds the frame
+    # size and the aggregator's per-(rank, comm) rolling window the
+    # incremental desync/straggler detectors diff.
+    telemetry_live_tail_entries: int = 128
+    # Minimum measured dispatch samples per (op, comm, wire, payload
+    # bucket, plan) key before schedule.calibrate() counts the key's
+    # median as a fit point (a single noisy dispatch must not bend the
+    # calibrated cost model).
+    plan_calibration_min_samples: int = 3
+
     # --- schedule-compiler cost model (alpha-beta per link class) ---
     # Per-hop launch latency (alpha, µs) and per-MiB transfer time
     # (beta, µs/MiB) for each link class a plan step can ride: 'ici'

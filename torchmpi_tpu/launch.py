@@ -66,6 +66,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "— including abnormal exit (SIGTERM/SIGINT/fault "
                     "handlers); feed the dir to "
                     "`python -m torchmpi_tpu.telemetry.analyze`")
+    ap.add_argument("--telemetry-live", action="store_true",
+                    help="run a live telemetry aggregator in the launcher "
+                    "and stream per-rank telemetry to it while the job "
+                    "runs: every rank exports bounded metric/flight deltas "
+                    "(over the elastic heartbeat when --elastic, a "
+                    "dedicated socket otherwise) and the launcher serves "
+                    "fleet-level /metrics (Prometheus), /health, /verdicts "
+                    "(streaming desync/straggler/hang/PS verdicts) and "
+                    "/calibration over HTTP; watch it with "
+                    "`python -m torchmpi_tpu.telemetry.top <addr>`")
+    ap.add_argument("--telemetry-live-port", type=int, default=0,
+                    help="HTTP scrape port for --telemetry-live "
+                    "(default: auto-chosen, printed at startup)")
+    ap.add_argument("--telemetry-live-addr-file", default=None,
+                    help="write the live plane's addresses here as JSON "
+                    "{\"http\": ..., \"ingest\": ...} (atomic), for "
+                    "operators and tests")
     ap.add_argument("--watchdog-timeout", type=float, default=0,
                     help="arm the per-rank hang watchdog: a collective or "
                     "PS RPC in flight (or a peer heartbeat stale) longer "
@@ -212,6 +229,66 @@ def _worker_env(args, rank: int, restart: int = 0) -> dict:
     return env
 
 
+def _start_live_aggregator(args, telemetry_dir):
+    """``--telemetry-live``: start the launcher-resident fleet
+    aggregator + scrape endpoints; returns it (or None when off)."""
+    if not args.telemetry_live:
+        return None
+    from .telemetry.live import FleetAggregator
+
+    if args.set_constant:
+        # the aggregator reads fabric knobs (telemetry_live_interval_s
+        # drives its staleness bound) from THIS process's constants —
+        # apply the overrides here like _run_elastic does, or workers
+        # framing at an overridden cadence read as stale to an
+        # aggregator still assuming the default
+        os.environ["TORCHMPI_TPU_CONSTANTS"] = _constants_spec(
+            args.set_constant
+        )
+        from .runtime_state import _apply_env_constants
+
+        _apply_env_constants()
+    agg = FleetAggregator(
+        mark_dir=telemetry_dir,
+        # --watchdog-timeout reaches the WORKERS via env; hand it to the
+        # aggregator explicitly so the live hang verdict uses the same
+        # bound (None = fall back to the constants knob)
+        hang_after_s=args.watchdog_timeout or None,
+    )
+    agg.serve(http_port=args.telemetry_live_port)
+    print(
+        f"[launch] live telemetry at http://127.0.0.1:{agg.http_port} "
+        "(/metrics /health /verdicts /calibration) — watch with "
+        f"`python -m torchmpi_tpu.telemetry.top 127.0.0.1:{agg.http_port}`",
+        file=sys.stderr,
+    )
+    if args.telemetry_live_addr_file:
+        import json
+
+        path = Path(args.telemetry_live_addr_file)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps({
+            "http": f"127.0.0.1:{agg.http_port}",
+            "ingest": f"127.0.0.1:{agg.ingest_port}",
+        }))
+        os.replace(tmp, path)
+    return agg
+
+
+def _close_live_aggregator(agg, telemetry_dir) -> None:
+    if agg is None:
+        return
+    if telemetry_dir is not None:
+        try:
+            # the calibration feed outlives the job: schedule.calibrate()
+            # fits the persisted samples offline
+            agg.save_samples(Path(telemetry_dir) / "live_samples.json")
+        except OSError:
+            pass
+    agg.close()
+
+
 def _run_elastic(args, target, extra) -> int:
     """Live-elastic supervision: one membership coordinator in THIS
     process, workers that survive each other's deaths, and an operator
@@ -247,12 +324,14 @@ def _run_elastic(args, target, extra) -> int:
     telemetry_dir = Path(args.telemetry_dir) if args.telemetry_dir else None
     if telemetry_dir is not None:
         telemetry_dir.mkdir(parents=True, exist_ok=True)
-        for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json"):
+        for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json",
+                        "dead_rank_*.json"):
             for stale in telemetry_dir.glob(pattern):
                 try:
                     stale.unlink()
                 except OSError:
                     pass
+    live_agg = _start_live_aggregator(args, telemetry_dir)
 
     def spawn_locked(addr: str) -> None:
         rank = next_rank[0]
@@ -269,6 +348,12 @@ def _run_elastic(args, target, extra) -> int:
             env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(
                 telemetry_dir / f"telemetry_rank_{rank}.json"
             )
+        if live_agg is not None:
+            # elastic workers piggyback their live frames on the
+            # membership heartbeat instead of opening another socket;
+            # the coordinator's on_telemetry hook feeds the aggregator
+            env["TORCHMPI_TPU_TELEMETRY"] = "1"
+            env["TORCHMPI_TPU_TELEMETRY_LIVE_VIA"] = "heartbeat"
         if log_dir is not None:
             out = open(log_dir / f"rank_{rank}.log", "w")
             logs.append(out)
@@ -296,7 +381,10 @@ def _run_elastic(args, target, extra) -> int:
                   file=sys.stderr)
             spawn_locked(coord_box["addr"])
 
-    coord = ElasticCoordinator(on_grow=on_grow)
+    coord = ElasticCoordinator(
+        on_grow=on_grow,
+        on_telemetry=live_agg.ingest if live_agg is not None else None,
+    )
     coord_box["addr"] = f"{coord.address[0]}:{coord.address[1]}"
     print(f"[launch] elastic coordinator at {coord_box['addr']}",
           file=sys.stderr)
@@ -354,6 +442,7 @@ def _run_elastic(args, target, extra) -> int:
             reader.join(timeout=5)
         for f in logs:
             f.close()
+        _close_live_aggregator(live_agg, telemetry_dir)
     return rc
 
 
@@ -384,13 +473,16 @@ def _run_world(args, target, extra, restart: int) -> int:
         telemetry_dir.mkdir(parents=True, exist_ok=True)
         # clear liveness/hang artifacts from a previous attempt or a
         # reused dir: a SIGKILL'd rank never retracts its heartbeat, and
-        # a leftover hang report would read as THIS run's diagnosis
-        for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json"):
+        # a leftover hang report (or live-plane dead-rank marker) would
+        # read as THIS run's diagnosis
+        for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json",
+                        "dead_rank_*.json"):
             for stale in telemetry_dir.glob(pattern):
                 try:
                     stale.unlink()
                 except OSError:
                     pass
+    live_agg = _start_live_aggregator(args, telemetry_dir)
     for i in range(args.nproc):
         rank = base + i
         # _worker_env: PROCESS_ID/RESTART_COUNT, --set-constant knob
@@ -409,6 +501,13 @@ def _run_world(args, target, extra, restart: int) -> int:
             )
             env["TORCHMPI_TPU_TELEMETRY"] = "1"
             env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(telemetry_dir / tname)
+        if live_agg is not None:
+            # arm the per-rank live exporter (telemetry import-time
+            # hook) streaming to the launcher's aggregator
+            env["TORCHMPI_TPU_TELEMETRY"] = "1"
+            env["TORCHMPI_TPU_TELEMETRY_LIVE"] = (
+                f"127.0.0.1:{live_agg.ingest_port}"
+            )
         if log_dir is not None:
             # restart attempts keep distinct logs: the failed attempt's
             # tail is the evidence worth reading
@@ -475,6 +574,7 @@ def _run_world(args, target, extra, restart: int) -> int:
             reader.join(timeout=5)
         for f in logs:
             f.close()
+        _close_live_aggregator(live_agg, telemetry_dir)
     return rc
 
 

@@ -143,8 +143,14 @@ class ElasticCoordinator:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  on_grow: Optional[Callable[[], None]] = None,
                  serve: bool = True,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 on_telemetry: Optional[Callable[[dict], None]] = None):
         self._on_grow = on_grow
+        # live telemetry piggyback: member heartbeats may carry one
+        # bounded exporter frame (launch --elastic --telemetry-live);
+        # the hook hands it to the fleet aggregator — zero extra
+        # sockets per member
+        self._on_telemetry = on_telemetry
         self._now = clock or time.monotonic
         self._lock = _lockmon.make_lock("elastic.py:Coordinator._lock")
         self._cv = threading.Condition(self._lock)
@@ -231,6 +237,16 @@ class ElasticCoordinator:
 
     def _handle(self, req: dict) -> dict:
         op = req.get("op")
+        if op == "beat" and self._on_telemetry is not None:
+            # forward the piggybacked telemetry frame OUTSIDE the
+            # membership lock: the aggregator takes its own lock and
+            # must never serialize against epoch bumps
+            tel = req.get("telemetry")
+            if isinstance(tel, dict):
+                try:
+                    self._on_telemetry(tel)
+                except Exception:  # noqa: BLE001 - telemetry must never
+                    pass           # break membership liveness
         with self._cv:
             if op == "join":
                 mid = self._next_mid
@@ -675,11 +691,28 @@ class ElasticMember:
     def _beat_loop(self) -> None:
         while not self._closed:
             time.sleep(float(constants.get("elastic_heartbeat_seconds")))
+            req: dict = {"op": "beat", "mid": self.mid}
+            exp = None
             try:
-                rep = _json_roundtrip(
-                    self.coord, {"op": "beat", "mid": self.mid}, timeout=10
-                )
+                # live telemetry piggyback: when the exporter is armed
+                # in carrier mode (launch --elastic --telemetry-live),
+                # each beat carries one bounded frame to the
+                # coordinator-resident aggregator
+                from ..telemetry import live as _live
+
+                tel = _live.heartbeat_frame()
+                if tel is not None:
+                    req["telemetry"] = tel
+                    exp = _live.exporter()
+            except Exception:  # noqa: BLE001 - beats outrank telemetry
+                pass
+            try:
+                rep = _json_roundtrip(self.coord, req, timeout=10)
             except (OSError, ValueError):
+                if exp is not None:
+                    # the frame never arrived: break the delta chain so
+                    # the next beat ships a full snapshot
+                    exp.mark_dropped()
                 continue
             self._note_epoch(int(rep["epoch"]))
             if not rep.get("member", True):

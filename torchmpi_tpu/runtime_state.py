@@ -262,6 +262,16 @@ def start(
                 load_tuning(comm=_stack.current, apply=True)
             except Exception:
                 pass  # cache is best-effort; defaults are always safe
+            # measured cost-model calibration (schedule.calibrate(),
+            # fed by the live telemetry plane) re-applies like the
+            # tuned constants: persisted medians beat the analytic
+            # plan_cost_* defaults for plans that were actually timed
+            try:
+                from .schedule import load_calibration
+
+                load_calibration()
+            except Exception:
+                pass  # calibration is best-effort, like the tuning cache
             # launcher + explicit user overrides beat persisted tuned
             # values (explicit last: it wins over the launcher's too)
             _apply_env_constants()

@@ -19,7 +19,14 @@ Public surface:
   decision dump (chosen plan, cost estimate, rejected candidates).
 - :func:`set_plan_override` / :func:`plan_overrides` — the autotuner's
   measured-winner persistence surface (``tune_plan``).
+- :func:`calibrate` / :func:`load_calibration` — the measured cost
+  model: fit per-(op, comm, wire, payload bucket, plan_id) dispatch
+  latencies from live-telemetry samples, persist them like ``tune_plan``
+  (``start()`` re-applies), and have ``select_plan`` prefer measured
+  microseconds over the analytic estimate.
 """
+
+from typing import Optional
 
 from .compiler import (  # noqa: F401
     ExecutablePlan,
@@ -32,11 +39,19 @@ from .compiler import (  # noqa: F401
     explain,
     override_key,
     payload_bucket,
+    plan_by_id,
     plan_overrides,
     select_plan,
     set_plan_override,
 )
-from .cost import cost_breakdown, estimate_us  # noqa: F401
+from .cost import (  # noqa: F401
+    calibrated_plan_us,
+    calibration_epoch,
+    clear_calibration,
+    cost_breakdown,
+    estimate_us,
+    set_calibration,
+)
 from .generators import (  # noqa: F401
     GENERATORS,
     HIER_OPS,
@@ -47,6 +62,55 @@ from .generators import (  # noqa: F401
 from .ir import STEP_KINDS, Plan, Step  # noqa: F401
 from .topology import Topology  # noqa: F401
 
+
+def calibrate(samples, apply: bool = True, persist: bool = False,
+              path=None) -> dict:
+    """Fit the measured cost model from live-plane dispatch samples.
+
+    ``samples`` is a :class:`~..telemetry.calibrate.SampleStore`, its
+    ``to_json()`` dict, or a path to a saved store (what the fleet
+    aggregator persists). The fit prices every measured plan_id it can
+    resolve through this process's plan registry with the hand-set
+    analytic model, so the returned ``report`` shows modeled-vs-measured
+    error next to the calibrated fit's. ``apply`` loads the table into
+    the selection path (:func:`set_calibration`, bumping the calibration
+    epoch every plan-cache key embeds); ``persist`` saves the result
+    like ``tune_plan`` (``$TORCHMPI_TPU_CALIBRATION_CACHE`` or
+    ``~/.cache/torchmpi_tpu/calibration.json``) for ``start()`` to
+    re-apply."""
+    from ..telemetry import calibrate as _calib
+
+    if isinstance(samples, (str, bytes)) or hasattr(samples, "__fspath__"):
+        store = _calib.SampleStore.load(samples)
+    elif isinstance(samples, dict):
+        store = _calib.SampleStore.from_json(samples)
+    else:
+        store = samples
+    result = _calib.fit_store(store, plan_lookup=plan_by_id)
+    if apply:
+        result["applied"] = set_calibration(result["table"])
+    if persist:
+        result["path"] = str(_calib.save_calibration(
+            {k: result[k] for k in ("version", "fitted", "table", "report")},
+            path=path,
+        ))
+    return result
+
+
+def load_calibration(path=None, apply: bool = True) -> Optional[dict]:
+    """Re-apply a persisted calibration (the ``start()`` hook, mirroring
+    the tuned-constants load). Returns the loaded result dict, or None
+    when no calibration file exists."""
+    from ..telemetry import calibrate as _calib
+
+    result = _calib.load_calibration_file(path)
+    if result is None:
+        return None
+    if apply:
+        result["applied"] = set_calibration(result.get("table", {}))
+    return result
+
+
 __all__ = [
     "Plan", "Step", "STEP_KINDS", "Topology",
     "compile_collective", "compile_fused", "explain",
@@ -54,6 +118,8 @@ __all__ = [
     "estimate_us", "cost_breakdown",
     "set_plan_override", "apply_plan_overrides", "plan_overrides",
     "clear_plan_overrides", "override_key", "payload_bucket",
-    "select_plan", "effective_backend",
+    "select_plan", "effective_backend", "plan_by_id",
+    "calibrate", "load_calibration", "set_calibration",
+    "clear_calibration", "calibrated_plan_us", "calibration_epoch",
     "ExecutablePlan", "FusedExecutablePlan",
 ]

@@ -126,6 +126,31 @@ def payload_bucket(nbytes: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# plan registry: plan_id -> Plan for every candidate the compiler has
+# considered in this process. Bounded; lets the calibration fit price a
+# measured plan_id with the analytic model (modeled-vs-measured report)
+# and lets tooling explain a plan_id seen in a flight dump.
+# ---------------------------------------------------------------------------
+
+_PLAN_REGISTRY: Dict[str, Plan] = {}
+_PLAN_REGISTRY_MAX = 1024
+
+
+def _register_plans(cands) -> None:
+    for c in cands:
+        plan = getattr(c, "plan", c)
+        _PLAN_REGISTRY.setdefault(plan.plan_id, plan)
+    while len(_PLAN_REGISTRY) > _PLAN_REGISTRY_MAX:
+        _PLAN_REGISTRY.pop(next(iter(_PLAN_REGISTRY)))
+
+
+def plan_by_id(plan_id: str) -> Optional[Plan]:
+    """The Plan behind a ``plan_id`` this process has compiled or
+    considered; None for plan_ids from other processes/runs."""
+    return _PLAN_REGISTRY.get(plan_id)
+
+
+# ---------------------------------------------------------------------------
 # request resolution (the policy the legacy branch stack applied inline)
 # ---------------------------------------------------------------------------
 
@@ -195,7 +220,8 @@ def select_plan(
     bucket = payload_bucket(nelem * itemsize)
     pkey = (
         "_planchoice", op, topo.fingerprint(), bucket, wire, backend,
-        route_small, small, _OVR_EPOCH, constants.generation(),
+        route_small, small, _OVR_EPOCH, _cost.calibration_epoch(),
+        constants.generation(),
     )
     cache = _plan_cache(comm) if comm is not None else None
     if cache is not None:
@@ -206,6 +232,7 @@ def select_plan(
         op, nelem, itemsize, topo, backend, wire=wire,
         route_small=route_small,
     )
+    _register_plans(cands)
     feasible = [c for c in cands if c.feasible]
     chosen = None
     override = _PLAN_OVERRIDES.get(
@@ -216,7 +243,24 @@ def select_plan(
             (c for c in feasible if c.plan.generator == override), None
         )
     if chosen is None and feasible:
-        chosen = min(feasible, key=lambda c: c.cost_us or float("inf"))
+        # measured (calibrated) costs re-order candidates only when the
+        # WHOLE feasible set was timed: wall-clock microseconds and
+        # idealized analytic estimates are incommensurable scales, and
+        # mixing them in one min() flips selection on measurement
+        # coverage, not merit (the timed incumbent looks expensive next
+        # to an untimed candidate's optimistic estimate). A partially-
+        # measured set keeps the analytic ordering; tune_plan overrides
+        # (checked above) remain the measured-search authority.
+        measured = {
+            c.plan.plan_id: _cost.calibrated_plan_us(
+                op, bucket, wire, c.plan.plan_id
+            )
+            for c in feasible
+        }
+        if all(v is not None for v in measured.values()):
+            chosen = min(feasible, key=lambda c: measured[c.plan.plan_id])
+        else:
+            chosen = min(feasible, key=lambda c: c.cost_us or float("inf"))
     if chosen is None:
         # defensive: the gate algebra always leaves one feasible flat
         # candidate, but a plan must exist even if it ever does not
@@ -475,7 +519,9 @@ def compile_collective(
         wire_dtype, wire_override, generator, impl, root, src, dst,
     )
     ent = memo.get(sig)
-    if ent is not None and ent[0] == gen_now and ent[2] == _OVR_EPOCH:
+    if ent is not None and ent[0] == gen_now and ent[2] == (
+        _OVR_EPOCH, _cost.calibration_epoch(),
+    ):
         _count_hit(op)
         return ent[1]
     import jax.numpy as jnp
@@ -507,7 +553,7 @@ def compile_collective(
             op, nelem, itemsize, topo, eff, wire, route_small, comm=comm
         )
     ep = _bind(plan, comm, tuple(shape), dtype, wire, root, src, dst)
-    memo[sig] = (gen_now, ep, _OVR_EPOCH)
+    memo[sig] = (gen_now, ep, (_OVR_EPOCH, _cost.calibration_epoch()))
     _count_compile(op, plan.generator)
     return ep
 
@@ -535,7 +581,9 @@ def compile_fused(
     sig = ("_planfused", op, tuple(ns), str(dtype), backend, route_small,
            wire_dtype)
     ent = memo.get(sig)
-    if ent is not None and ent[0] == gen_now and ent[2] == _OVR_EPOCH:
+    if ent is not None and ent[0] == gen_now and ent[2] == (
+        _OVR_EPOCH, _cost.calibration_epoch(),
+    ):
         _count_hit(op)
         return ent[1]
     itemsize = jnp.dtype(dtype).itemsize
@@ -573,7 +621,7 @@ def compile_fused(
             plan, cat, comm, plan.backend, wire, tuple(ns), total, dtype,
             None, False, inner=(backend, route_small, wire_dtype),
         )
-    memo[sig] = (gen_now, ep, _OVR_EPOCH)
+    memo[sig] = (gen_now, ep, (_OVR_EPOCH, _cost.calibration_epoch()))
     _count_compile(op, plan.generator)
     return ep
 

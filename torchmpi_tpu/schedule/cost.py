@@ -15,11 +15,20 @@ the winner per cache key, and the small-message crossover constants
 (``small_*_size_*``, themselves autotuned) feed the latency-path gate.
 The analytic model's job is to ORDER candidates between measurements,
 not to predict wall time to the microsecond.
+
+On top of the analytic model sits the **measured calibration table**
+(``schedule.calibrate()`` / ``load_calibration()``, fed by the live
+telemetry plane's dispatch-latency samples): per-(op, payload bucket,
+wire, plan_id) measured microseconds that :func:`calibrated_plan_us`
+serves and ``select_plan`` prefers over the analytic estimate when a
+candidate has actually been measured. Applying a table bumps
+:func:`calibration_epoch`, which plan-cache keys embed — a calibration
+load invalidates stale plan choices exactly like an autotuner override.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 from .. import constants
 from .ir import Plan, Step
@@ -75,6 +84,62 @@ def estimate_us(plan: Plan) -> float:
     for step in plan.steps:
         total += step_cost_us(step)
     return total
+
+
+# ---------------------------------------------------------------------------
+# measured calibration table (the live-plane cost model load path)
+# ---------------------------------------------------------------------------
+
+# (op, bucket, wire, plan_id) -> measured median dispatch microseconds.
+# plan_id hashes the topology fingerprint, so topology identity rides
+# along without a separate key part.
+_CALIBRATED: Dict[tuple, float] = {}
+_CAL_EPOCH = 0
+
+
+def set_calibration(table: Dict[str, dict]) -> int:
+    """Apply a calibrated cost table (``telemetry.calibrate`` ``table``
+    shape: ``"op|comm|wire|b<bucket>|plan_id" -> {"us": ...}``).
+    Replaces the previous table; returns the number of applied entries.
+    Duplicate (op, bucket, wire, plan) keys from different comms merge
+    by sample-weighted mean."""
+    global _CAL_EPOCH
+    from ..telemetry.calibrate import split_key
+
+    merged: Dict[tuple, list] = {}
+    for key, row in (table or {}).items():
+        parts = split_key(key)
+        us = (row or {}).get("us")
+        if parts is None or us is None:
+            continue
+        k = (parts["op"], parts["bucket"], parts["wire"], parts["plan_id"])
+        n = max(1, int((row or {}).get("n", 1)))
+        acc = merged.setdefault(k, [0.0, 0])
+        acc[0] += float(us) * n
+        acc[1] += n
+    _CALIBRATED.clear()
+    for k, (tot, n) in merged.items():
+        _CALIBRATED[k] = tot / n
+    _CAL_EPOCH += 1
+    return len(_CALIBRATED)
+
+
+def clear_calibration() -> None:
+    global _CAL_EPOCH
+    if _CALIBRATED:
+        _CALIBRATED.clear()
+        _CAL_EPOCH += 1
+
+
+def calibration_epoch() -> int:
+    return _CAL_EPOCH
+
+
+def calibrated_plan_us(op: str, bucket: int, wire: str,
+                       plan_id: str) -> Optional[float]:
+    """Measured microseconds for one candidate, or None when this plan
+    was never measured (the analytic estimate then stands)."""
+    return _CALIBRATED.get((op, bucket, wire, plan_id))
 
 
 def cost_breakdown(plan: Plan) -> Dict[str, float]:

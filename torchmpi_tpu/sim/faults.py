@@ -192,11 +192,20 @@ def check_expectations(expected: dict, report: dict,
 
 
 def run_scenario(src, out_dir, seed: Optional[int] = None,
-                 ranks: Optional[int] = None) -> Dict[str, Any]:
+                 ranks: Optional[int] = None,
+                 live: bool = False) -> Dict[str, Any]:
     """Run one scenario end to end; returns ``{name, verdict, ok,
     failures, report, stats, analysis_path}``. ``seed``/``ranks``
     override the scenario file (the determinism tests re-run with a
-    different seed and assert the verdict survives)."""
+    different seed and assert the verdict survives).
+
+    ``live=True`` additionally attaches a
+    :class:`~..telemetry.live.FleetAggregator` fed on the virtual clock
+    (:meth:`~.fleet.SimFleet.attach_live`): the result then carries
+    ``live_verdicts`` — the streaming verdict transitions, each stamped
+    with the virtual time it was reached — and ``live`` (the aggregator
+    itself), so tests can assert the named verdict appeared WHILE the
+    scenario was still running and replays byte-identically per seed."""
     scn = load_scenario(src)
     seed = scn.get("seed", 0) if seed is None else seed
     world = int(ranks if ranks is not None else scn.get("ranks", 64))
@@ -231,6 +240,15 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
                 )
             else:
                 raise ValueError(f"unknown scenario event kind {kind!r}")
+        aggregator = None
+        if live:
+            from ..telemetry.live import FleetAggregator
+
+            hb = float(constants.get("elastic_heartbeat_seconds"))
+            aggregator = FleetAggregator(
+                clock=lambda: fleet.wall(), stale_after_s=3.0 * hb
+            )
+            fleet.attach_live(aggregator, interval_s=hb)
         if "ps" in scn:
             ps = dict(scn["ps"])
             SimPS(
@@ -261,7 +279,7 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
         failures = check_expectations(
             scn.get("expected", {}), report, verdict, stats
         )
-        return {
+        result = {
             "name": scn.get("name", "scenario"),
             "verdict": verdict,
             "ok": not failures,
@@ -270,6 +288,10 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
             "stats": stats,
             "analysis_path": str(analysis_path),
         }
+        if aggregator is not None:
+            result["live"] = aggregator
+            result["live_verdicts"] = list(aggregator.verdict_history)
+        return result
     finally:
         for k, v in prev.items():
             try:

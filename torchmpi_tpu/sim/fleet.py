@@ -128,6 +128,8 @@ class SimFleet:
         self._pending_kills: List[List[int]] = []
         self._finished = False
         self._step_token = 0
+        self.live = None  # FleetAggregator fed on virtual time
+        self._live_interval = 0.0
         hb = float(constants.get("elastic_heartbeat_seconds"))
         self.loop.after(hb, self._beat_tick)
         self.loop.after(hb * 1.5, self._sweep_tick)
@@ -236,6 +238,60 @@ class SimFleet:
         self.stats["virtual_seconds"] = round(self.loop.now, 6)
         self.stats["events"] = self.loop.processed
         return self.stats
+
+    # -- live telemetry feed -----------------------------------------------
+    def attach_live(self, aggregator,
+                    interval_s: Optional[float] = None) -> None:
+        """Feed a live :class:`~..telemetry.live.FleetAggregator` from
+        the simulated fleet on the VIRTUAL clock: every interval each
+        reachable rank ships one frame (seq high-waters, flight tail,
+        registry snapshot) via plain ``ingest`` — no sockets, no
+        threads — and the aggregator's verdicts are evaluated at that
+        virtual instant. Dead or partitioned ranks simply stop sending,
+        exactly like a real severed stream, so the streaming verdicts
+        (desync / hang / rank-dead / resize-torn / straggler /
+        ps-overload) are testable deterministically at 1k-10k ranks and
+        replay byte-identically per seed."""
+        if interval_s is None:
+            interval_s = float(
+                constants.get("telemetry_live_interval_s")
+            )
+        self.live = aggregator
+        self._live_interval = float(interval_s)
+        self.loop.after(self._live_interval, self._live_tick)
+
+    def _live_tick(self) -> None:
+        agg = self.live
+        if agg is None:
+            return
+        tail_n = int(constants.get("telemetry_live_tail_entries"))
+        for mid in sorted(self.ranks):
+            sr = self.ranks[mid]
+            if not sr.alive or sr.partitioned:
+                continue  # the frame can't reach the aggregator
+            agg.ingest({
+                "v": 1,
+                "kind": "full",
+                "rank": sr.rank,
+                "pid": sr.rank,
+                "time": self.wall(),
+                "metrics": (
+                    sr.registry.snapshot()
+                    if sr.registry is not None else {}
+                ),
+                "seq_high_water": sr.recorder.seq_high_water(),
+                "flight_tail": sr.recorder.tail(tail_n),
+                "flight_dropped": sr.recorder.dropped,
+                "flight_recorded": sr.recorder.total_recorded,
+                "spans": {"recorded": 0, "dropped": 0},
+                "resize_epoch": (
+                    sr.committed_epoch
+                    if sr.committed_epoch is not None else 0
+                ),
+            })
+        agg.evaluate(now=self.wall())
+        if not self._finished:
+            self.loop.after(self._live_interval, self._live_tick)
 
     # -- heartbeats / sweeps -----------------------------------------------
     def _beat_tick(self) -> None:

@@ -310,3 +310,12 @@ if _DUMP_PATH:
 from . import watchdog  # noqa: E402 - needs the module fully initialized
 
 watchdog._maybe_start_from_env()
+
+# live telemetry plane: the launcher's --telemetry-live exports
+# TORCHMPI_TPU_TELEMETRY_LIVE=host:port (standalone socket exporter) or
+# TORCHMPI_TPU_TELEMETRY_LIVE_VIA=heartbeat (frames piggyback on the
+# elastic member's coordinator heartbeat); armed at import like the
+# watchdog so streaming starts before start().
+from . import live  # noqa: E402 - needs the module fully initialized
+
+live._maybe_start_from_env()

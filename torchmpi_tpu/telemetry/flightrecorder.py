@@ -179,6 +179,17 @@ class FlightRecorder:
             snap = [list(e) for e in self._buf]
         return [self._as_dict(e) for e in snap]
 
+    def tail(self, n: int) -> List[dict]:
+        """The newest ``n`` entries (oldest first) as dicts — the bounded
+        flight payload the live telemetry exporter streams each interval.
+        Entries are copied under the lock, so in-place completion racing
+        the copy is harmless; a ``completed`` status for an entry a
+        previous tail shipped as ``issued`` simply rides the next one."""
+        with self._lock:
+            buf = list(self._buf)
+            snap = [list(e) for e in (buf[-int(n):] if n else buf)]
+        return [self._as_dict(e) for e in snap]
+
     def snapshot(self) -> dict:
         """JSON-serializable dump: entries + seq high-water + ring health
         (``dropped`` > 0 means the oldest entries were evicted)."""

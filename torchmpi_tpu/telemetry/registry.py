@@ -11,6 +11,10 @@ numbers themselves. This registry is deliberately tiny and dependency-free:
   labelset;
 - the registry renders both a JSON :meth:`snapshot` (the ``telemetry.dump``
   payload) and Prometheus text exposition (:meth:`prometheus`);
+- every mutation stamps a process-wide **generation**, so
+  ``snapshot(since=g)`` returns only the families that changed after
+  generation ``g`` — the bounded-delta payload the live telemetry
+  exporter streams (O(changes) per interval, not O(metrics));
 - external producers plug in as **collectors** — callables returning a
   plain dict merged into the snapshot (``utils.tracing.wire_stats`` is
   registered this way, so the logical-vs-wire byte accounting appears in
@@ -38,6 +42,34 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
 
 
+# ---------------------------------------------------------------------------
+# change generations: one process-wide monotone counter stamped on every
+# metric mutation. The delta contract the live exporter depends on: a
+# change stamped at generation g is returned by every snapshot(since=s)
+# with s < g — the stamp happens inside the metric's own lock together
+# with the data write, and the counter has its own lock, so a snapshot
+# that read generation g0 *before* scanning families can never miss a
+# change it did not include (the change's stamp is then > g0 and the
+# next delta picks it up).
+# ---------------------------------------------------------------------------
+
+_GEN_LOCK = _lockmon.make_lock("registry.py:_generation")
+_generation = 0
+
+
+def _bump_generation() -> int:
+    global _generation
+    with _GEN_LOCK:
+        _generation += 1
+        return _generation
+
+
+def metrics_generation() -> int:
+    """The current process-wide metrics change generation."""
+    with _GEN_LOCK:
+        return _generation
+
+
 def _label_key(labels: Dict[str, object]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
@@ -61,10 +93,14 @@ class _Metric:
         self.help = help
         self._lock = _lockmon.make_lock("registry.py:_Metric._lock")
         self._series: Dict[Tuple[Tuple[str, str], ...], object] = {}
+        # creation counts as a change: a family registered after a delta
+        # baseline must appear in the next delta even if never bumped
+        self._gen = _bump_generation()
 
     def reset(self) -> None:
         with self._lock:
             self._series.clear()
+            self._gen = _bump_generation()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -98,6 +134,7 @@ class Counter(_Metric):
         k = _label_key(labels)
         with self._lock:
             self._series[k] = self._series.get(k, 0) + value
+            self._gen = _bump_generation()
 
     def value(self, **labels) -> float:
         with self._lock:
@@ -115,6 +152,7 @@ class Gauge(_Metric):
     def set(self, value: float, **labels) -> None:
         with self._lock:
             self._series[_label_key(labels)] = value
+            self._gen = _bump_generation()
 
     def value(self, **labels) -> Optional[float]:
         with self._lock:
@@ -149,6 +187,7 @@ class Histogram(_Metric):
                 counts[-1] += 1
             state[1] += value
             state[2] += 1
+            self._gen = _bump_generation()
 
     def _quantile_estimates(self, counts, n) -> Dict[str, float]:
         """p50/p95/p99 from the bucket counts: the classic Prometheus
@@ -298,17 +337,46 @@ class MetricsRegistry:
         with self._lock:
             self._collectors.pop(name, None)
 
-    def snapshot(self) -> dict:
+    def generation(self) -> int:
+        """Process-wide metrics change generation (see module notes)."""
+        return metrics_generation()
+
+    def snapshot(self, since: Optional[int] = None) -> dict:
+        """Full snapshot (``since=None``, the historical flat form), or a
+        **bounded delta**: only the typed families whose change
+        generation is > ``since``, wrapped as ``{"generation", "since",
+        "families", "collectors"}``. The generation is read BEFORE the
+        family scan, so a concurrent change is either included here or
+        guaranteed to appear in the next delta — never silently lost.
+        Collector producers are external (their change times are
+        unknowable), so every delta carries them verbatim."""
+        g0 = metrics_generation() if since is not None else 0
         with self._lock:
             metrics = list(self._metrics.values())
             collectors = list(self._collectors.items())
-        out = {m.name: m.snapshot() for m in metrics}
+        if since is not None:
+            families = {}
+            for m in metrics:
+                with m._lock:
+                    changed = m._gen > since
+                if changed:
+                    families[m.name] = m.snapshot()
+            out: dict = {
+                "generation": g0,
+                "since": since,
+                "families": families,
+                "collectors": {},
+            }
+            sink = out["collectors"]
+        else:
+            out = {m.name: m.snapshot() for m in metrics}
+            sink = out
         for name, fn in collectors:
             try:
-                out[name] = fn()
+                sink[name] = fn()
             except Exception as e:  # noqa: BLE001 - a broken producer must
                 # never take the snapshot down with it
-                out[name] = {"error": f"{type(e).__name__}: {e}"}
+                sink[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
     def prometheus(self) -> str:

@@ -186,7 +186,24 @@ class Watchdog:
             self.fire("in_flight_timeout", {"stuck": stuck})
         stale = self._stale_peers()
         if stale:
-            self.fire("peer_heartbeat_stale", {"peers": stale})
+            # compose with the live telemetry plane: a peer whose live
+            # stream already closed without a bye (the aggregator's
+            # dead_rank_<r>.json marker) is DEAD, not merely late with a
+            # heartbeat — attribute it as such so the hang report names
+            # the real condition
+            dead = [b for b in stale if self._live_marked_dead(b)]
+            plain = [b for b in stale if b not in dead]
+            if dead:
+                self.fire("peer_dead", {"peers": dead})
+            if plain:
+                self.fire("peer_heartbeat_stale", {"peers": plain})
+
+    def _live_marked_dead(self, beat: dict) -> bool:
+        if self.dir is None:
+            return False
+        rank = beat.get("rank")
+        tag = str(rank) if rank is not None else f"pid{beat.get('pid')}"
+        return (self.dir / f"dead_rank_{tag}.json").exists()
 
     def _stale_peers(self) -> list:
         if self.dir is None:
@@ -245,6 +262,14 @@ class Watchdog:
         except Exception as e:  # noqa: BLE001
             report["telemetry_error"] = f"{type(e).__name__}: {e}"
         path = self.hang_path()
+        if self.hang_reports:
+            # one file per distinct reason: a second diagnosis (e.g.
+            # peer_dead after in_flight_timeout) must not overwrite the
+            # first report's evidence. Still matches the analyzer's and
+            # launcher-cleanup's hang_rank_*.json glob.
+            path = path.with_name(
+                f"hang_rank_{self._rank_tag}.{reason}.json"
+            )
         try:
             tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
             tmp.write_text(json.dumps(report, indent=2, default=str))
