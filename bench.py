@@ -1710,14 +1710,18 @@ def _sim_bench(check: bool = False, worlds: str = ""):
     replication 3, and the schedule compiler's plan at that scale.
     ``--check`` gates (CI sim-smoke): every world resizes, control
     payloads grow (sub)linearly with the member list, re-formation
-    fan-out stays <= 2x replication on any single head, and the
-    smallest point replays byte-identically under its seed. Pure host
-    path — no jax backend, survives a dead TPU tunnel."""
+    fan-out stays <= 2x replication on any single head, the smallest
+    point replays byte-identically under its seed, AND supervised
+    death-wave recovery at 1024 ranks converges within a bounded
+    number of supervisor actions (evict + shrink, no rollback) with a
+    byte-identical journal replay. Pure host path — no jax backend,
+    survives a dead TPU tunnel."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from torchmpi_tpu.sim.bench import (
         DEFAULT_WORLDS,
         bench_curve,
         check_curve,
+        check_supervised_recovery,
     )
 
     spec = worlds or os.environ.get("TORCHMPI_TPU_SIM_WORLDS", "")
@@ -1741,6 +1745,7 @@ def _sim_bench(check: bool = False, worlds: str = ""):
     if not check:
         return 0
     failures = check_curve(points)
+    failures += check_supervised_recovery(ranks=1024)
     if failures:
         print(
             "# sim smoke FAILED: " + "; ".join(failures),

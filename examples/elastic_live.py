@@ -16,7 +16,15 @@ job and receives the state), or a ``shrink`` (the newest member is
 evicted) — survivors pass the resize barrier, redistribute the sharded
 state through the reshard plan, and the loss curve CONTINUES: no
 relaunch, no checkpoint restore. Compare ``examples/elastic_training.py``,
-the old ``--max-restarts`` cold-restart model this supersedes.
+the old ``--max-restarts`` cold-restart model live elasticity
+supersedes for SINGLE faults.
+
+Beyond the single-fault contract, the two models COMPOSE
+(``--elastic --max-restarts N``, PR 14): ``--checkpoint`` +
+``--checkpoint-every`` keep a registered rollback artifact fresh, and
+when the whole world dies (``--die-rank -1``) — or ``--supervise``
+decides a rollback — the launcher relaunches every worker, which
+resumes here from the artifact instead of cold-starting.
 """
 
 from __future__ import annotations
@@ -41,11 +49,30 @@ def main() -> int:
     ap.add_argument("--momentum", type=float, default=0.9)
     ap.add_argument("--initial-world", type=int, default=2,
                     help="wait for this many members before training")
+    ap.add_argument("--step-sleep", type=float, default=0.0,
+                    help="seconds to sleep between steps (paces the run "
+                    "so mid-train faults land mid-train)")
     ap.add_argument("--die-at-step", type=int, default=-1,
                     help="this worker hard-dies (os._exit) at this step")
     ap.add_argument("--die-rank", type=int, default=-1,
                     help="only the worker launched with this elastic "
-                    "rank dies (TORCHMPI_TPU_ELASTIC_RANK)")
+                    "rank dies (TORCHMPI_TPU_ELASTIC_RANK); -1 with "
+                    "--die-at-step >= 0 kills EVERY worker — the "
+                    "beyond-single-fault drill the checkpoint rollback "
+                    "recovers from")
+    ap.add_argument("--die-on-restart", type=int, default=0,
+                    help="the death injection fires only on this "
+                    "TORCHMPI_TPU_RESTART_COUNT attempt (so a relaunched "
+                    "world survives)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="rollback-artifact path (.npz): resumed from "
+                    "when it exists (params + step), kept fresh by "
+                    "--checkpoint-every")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="arm ElasticZero1.checkpoint_every: the rank-0 "
+                    "member async-saves {params, step} to --checkpoint "
+                    "every N committed steps and registers it as the "
+                    "newest rollback artifact")
     ap.add_argument("--grow-at-step", type=int, default=-1,
                     help="launch rank 0 requests an operator grow here")
     ap.add_argument("--shrink-at-step", type=int, default=-1,
@@ -53,15 +80,30 @@ def main() -> int:
     args = ap.parse_args()
 
     my_launch_rank = int(os.environ.get("TORCHMPI_TPU_ELASTIC_RANK", "0"))
+    restart = int(os.environ.get("TORCHMPI_TPU_RESTART_COUNT", "0"))
     rs = np.random.RandomState(7)
     data = rs.randn(args.samples, args.dim).astype(np.float32)
+
+    # resume from the rollback artifact when one exists (every member
+    # reads the SAME file, preserving the deterministic-init contract
+    # the cold-attach scatter relies on)
+    init = np.zeros(args.dim, np.float32)
+    resume_step = 0
+    if args.checkpoint:
+        ckpt = elastic.load_zero1_checkpoint(args.checkpoint)
+        if ckpt is not None:
+            init, resume_step = ckpt["params"], ckpt["step"]
+            print(f"[elastic {my_launch_rank}] resuming from checkpoint "
+                  f"step {resume_step} (restart {restart})", flush=True)
 
     state = elastic.ElasticState()
     member = elastic.from_env(state)
     trainer = elastic.ElasticZero1(
-        member, np.zeros(args.dim, np.float32),
-        lr=args.lr, momentum=args.momentum,
+        member, init, lr=args.lr, momentum=args.momentum,
     )
+    trainer.step_idx = resume_step
+    if args.checkpoint and args.checkpoint_every:
+        trainer.checkpoint_every(args.checkpoint_every, args.checkpoint)
     # joiners (operator grow) must NOT wait for the initial world — they
     # attach to whatever membership exists and receive the live state
     if "TORCHMPI_TPU_ELASTIC_JOINER" not in os.environ:
@@ -81,7 +123,12 @@ def main() -> int:
     try:
         while trainer.step_idx < args.steps:
             step = trainer.step_idx
-            if step == args.die_at_step and my_launch_rank == args.die_rank:
+            if (
+                step == args.die_at_step
+                and restart == args.die_on_restart
+                and (args.die_rank == -1
+                     or my_launch_rank == args.die_rank)
+            ):
                 print(f"[elastic {my_launch_rank}] dying at step {step}",
                       flush=True)
                 os._exit(1)  # hard death: no goodbye to anyone
@@ -102,6 +149,11 @@ def main() -> int:
             print(f"[elastic {my_launch_rank}] step {trainer.step_idx - 1} "
                   f"world={len(member._view.members)} "
                   f"loss={loss:.6f}", flush=True)
+            if args.step_sleep:
+                import time as _time
+
+                _time.sleep(args.step_sleep)
+        trainer.flush_checkpoint()
         done = True
         print(f"[elastic {my_launch_rank}] done steps={trainer.step_idx} "
               f"final_loss={loss:.6f}", flush=True)

@@ -50,6 +50,15 @@ run_sim_smoke() {
     JAX_PLATFORMS=cpu python -m torchmpi_tpu.sim death_wave partition \
         --ranks 1024 --out "$simdir"
     rm -rf "$simdir"
+    # partition SUPERVISED at 1024 ranks: the recovery ladder (verdict
+    # -> evict the wave -> committed shrink -> training resumed) per
+    # the scenario's expected.recovery contract. death_wave's
+    # supervised 1024-rank coverage lives in bench.py --sim --check
+    # below (check_supervised_recovery: bounded action count +
+    # byte-identical journal replay), so it is not repeated here.
+    JAX_PLATFORMS=cpu python -m torchmpi_tpu.sim --supervise \
+        partition --ranks 1024 --out "$simdir"
+    rm -rf "$simdir"
     python bench.py --sim --check
 }
 
@@ -104,6 +113,13 @@ run_perf_smoke() {
     # resize.* epoch barrier per telemetry.analyze.
     echo "=== resize smoke (2-proc live-elastic grow/shrink) ==="
     python scripts/elastic_smoke.py
+    # recover smoke: a 2-proc --elastic --supervise run loses a worker
+    # to a hard mid-train kill and must self-heal with no operator
+    # input — the supervisor's evict-shrink on /actions mid-run, the
+    # survivor finishing at world=1, and `desync: none` from the
+    # analyzer.
+    echo "=== recover smoke (2-proc supervised kill -> auto-shrink) ==="
+    python scripts/recover_smoke.py
 }
 
 run_slow_a() {

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""CI smoke for the self-healing loop (`scripts/ci.sh fast`).
+
+A 2-proc ``--elastic --supervise`` run loses one worker to a hard
+mid-train death (``os._exit``, no goodbye) and must recover with NO
+operator input:
+
+- WHILE the job runs, the live plane's ``/actions`` endpoint must show
+  the supervisor's ``evict-shrink`` action for the dead rank (scraped
+  mid-run, like the live smoke scrapes ``/verdicts``);
+- the survivor continues at ``world=1`` and finishes every step —
+  the committed live shrink, training resumed;
+- launch rc == 0 (a recovered job is a successful job);
+- ``telemetry.analyze`` over the run reports ``desync: none`` and a
+  clean resize report (every live rank inside every epoch barrier).
+
+Exit 0 on success; nonzero with the evidence printed otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from urllib.request import urlopen
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    root = Path(tempfile.mkdtemp(prefix="tm-recover-smoke-"))
+    tel = root / "tel"
+    addr_file = root / "live_addr.json"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--elastic", "--supervise",
+            "--telemetry-dir", str(tel),
+            "--telemetry-live-addr-file", str(addr_file),
+            "--set-constant", "elastic_heartbeat_seconds=0.1",
+            "--set-constant", "telemetry_live_interval_s=0.1",
+            "--set-constant", "supervisor_backoff_base_s=0.2",
+            str(REPO / "examples" / "elastic_live.py"), "--",
+            "--steps", "40", "--step-sleep", "0.1",
+            "--die-at-step", "10", "--die-rank", "1",
+        ],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    checks = {}
+    actions = []
+    try:
+        deadline = time.time() + 120
+        while not addr_file.exists() and time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        if addr_file.exists():
+            base = json.loads(addr_file.read_text())["http"]
+            # mid-run: wait for the supervisor's evict to hit /actions
+            while time.time() < deadline and proc.poll() is None:
+                try:
+                    doc = json.loads(urlopen(
+                        f"http://{base}/actions", timeout=5
+                    ).read().decode())
+                except OSError:
+                    time.sleep(0.2)
+                    continue
+                actions = doc.get("journal", [])
+                if any(a["action"] == "evict-shrink" for a in actions):
+                    break
+                time.sleep(0.2)
+        checks["/actions served the evict-shrink mid-run"] = any(
+            a["action"] == "evict-shrink" and 1 in a.get("ranks", [])
+            for a in actions
+        )
+        try:
+            out, _ = proc.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            # a wedged job is a FAILED check, not a raw traceback: kill,
+            # drain, and fall through so the evidence table still prints
+            proc.kill()
+            out, _ = proc.communicate(timeout=30)
+            out = (out or "") + "\n[recover smoke] job timed out"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            out = ""
+    checks["launch rc == 0 (recovered job is a success)"] = (
+        proc.returncode == 0
+    )
+    checks["supervisor journaled the eviction"] = (
+        "[supervise] action=evict-shrink" in out
+    )
+    checks["survivor resumed at world=1"] = "world=1" in out
+    checks["survivor finished every step"] = "done steps=40" in out
+    checks["no rollback on a single recoverable death"] = (
+        "action=rollback" not in out
+        and "[supervise] rollback" not in out
+    )
+
+    analyze = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.telemetry.analyze",
+         str(tel)],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    checks["analyzer reports desync: none"] = (
+        analyze.returncode == 0 and "desync: none" in analyze.stdout
+    )
+    report = {}
+    try:
+        report = json.loads((tel / "analysis.json").read_text())
+    except (OSError, ValueError):
+        pass
+    rz = report.get("resize", {})
+    checks["live shrink committed (resize epochs, all entered)"] = (
+        rz.get("status") == "ok" and bool(rz.get("epochs"))
+        and not any(i["never_entered"] for i in rz["epochs"].values())
+    )
+
+    failed = [name for name, ok in checks.items() if not ok]
+    for name, ok in checks.items():
+        print(f"  [{'ok' if ok else 'FAIL'}] {name}")
+    if failed:
+        print(out[-4000:])
+        print(f"recover smoke FAILED: {failed}", file=sys.stderr)
+        return 1
+    print("recover smoke OK: SIGKILL'd worker evicted by the "
+          "supervisor, live shrink committed, training resumed, "
+          "desync: none")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

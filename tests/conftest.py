@@ -58,6 +58,10 @@ def _fresh_runtime():
     # process-global autotuner state like constants
     _sched_compiler.clear_plan_overrides()
     _sched_cost.clear_calibration()
+    # the last-checkpoint registry is process-global too
+    from torchmpi_tpu.supervise import checkpoints as _ckpts
+
+    _ckpts._reset_for_tests()
 
 
 def pytest_sessionfinish(session, exitstatus):

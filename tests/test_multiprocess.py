@@ -910,6 +910,104 @@ def test_watchdog_fires_and_dumps_on_induced_ps_hang(tmp_path):
 
 
 @pytest.mark.slow
+def test_supervisor_evicts_killed_worker_and_training_resumes(tmp_path):
+    """The self-healing acceptance path, live: a 2-proc
+    --elastic --supervise job loses rank 1 to a hard mid-train death
+    (os._exit, no goodbye) and recovers with no operator input — the
+    supervisor's rank-dead verdict evicts the corpse (journaled to
+    stderr and /actions), the live shrink commits, the survivor
+    finishes every step at world=1, and the job exits 0."""
+    tel = tmp_path / "tel"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--elastic", "--supervise",
+            "--telemetry-dir", str(tel),
+            "--set-constant", "elastic_heartbeat_seconds=0.1",
+            "--set-constant", "telemetry_live_interval_s=0.1",
+            "--set-constant", "supervisor_backoff_base_s=0.2",
+            str(_REPO / "examples" / "elastic_live.py"), "--",
+            "--steps", "30", "--step-sleep", "0.1",
+            "--die-at-step", "8", "--die-rank", "1",
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    out = proc.stdout
+    assert "[supervise] action=evict-shrink" in out, out[-3000:]
+    assert "ranks=[1]" in out
+    assert "world=1" in out          # the committed shrink
+    assert "done steps=30" in out    # training resumed to completion
+    # single death stays on the evict rung: no rollback ACTION fired
+    # (the startup budget note mentioning the word doesn't count)
+    assert "action=rollback" not in out
+    assert "[supervise] rollback" not in out
+    # the analyzer agrees the recovered run is healthy
+    import json
+
+    analyze = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.telemetry.analyze",
+         str(tel)],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    assert analyze.returncode == 0, analyze.stdout[-2000:]
+    assert "desync: none" in analyze.stdout
+    report = json.loads((tel / "analysis.json").read_text())
+    assert report["resize"]["status"] == "ok"
+
+
+@pytest.mark.slow
+def test_elastic_restart_beyond_contract_resumes_from_checkpoint(
+    tmp_path,
+):
+    """--elastic composed with --max-restarts (the lifted mutual
+    exclusion): when the WHOLE world dies mid-train — beyond what live
+    elasticity can survive — the launcher relaunches every rank, and
+    the workers resume from the checkpoint_every artifact (params +
+    step), not from step 0."""
+    ck = tmp_path / "ck.npz"
+    tel = tmp_path / "tel"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--elastic", "--max-restarts", "1",
+            "--telemetry-dir", str(tel),
+            "--set-constant", "elastic_heartbeat_seconds=0.1",
+            str(_REPO / "examples" / "elastic_live.py"), "--",
+            "--steps", "16", "--step-sleep", "0.05",
+            "--die-at-step", "11", "--die-rank", "-1",
+            "--checkpoint", str(ck), "--checkpoint-every", "4",
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    out = proc.stdout
+    assert "relaunching the world from the last checkpoint" in out
+    # both relaunched workers resumed at the step-8 artifact — the last
+    # boundary before the deaths (the step-8 async save spawns at the
+    # END of step 7, so it has the three paced steps 8-10 to publish
+    # before the top-of-step-11 deaths) — not step 0
+    assert out.count("resuming from checkpoint step 8 (restart 1)") == 2
+    assert "done steps=16" in out
+    # the artifact itself names the final state of the finished run
+    from torchmpi_tpu.reshard.elastic import load_zero1_checkpoint
+
+    got = load_zero1_checkpoint(ck)
+    assert got is not None and got["step"] == 16
+    # ... and the cross-process registry (the file the launcher-resident
+    # supervisor reads, TORCHMPI_TPU_CHECKPOINT_STATE) survived the
+    # restart and names the same artifact
+    import json
+
+    state = json.loads((tel / "last_checkpoint.json").read_text())
+    assert state["step"] == 16
+    assert state["path"].endswith("ck.npz")
+
+
+@pytest.mark.slow
 def test_launcher_max_restarts_budget_exhausted(tmp_path):
     """A rank that keeps dying exhausts the restart budget and the
     launcher exits with the failure code (no infinite loop)."""

@@ -296,3 +296,101 @@ def test_scenario_constants_are_restored(tmp_path):
     prev = constants.get("ps_pending_frame_budget")
     run_scenario("busy_storm", tmp_path)
     assert constants.get("ps_pending_frame_budget") == prev
+
+
+# ---------------------------------------------------------------------------
+# supervised recovery: the same scenarios with the RecoverySupervisor
+# closing the loop (expected.recovery asserted per scenario file)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,ranks",
+    [
+        ("death_wave", 64),
+        ("straggler", None),
+        ("partition", None),
+        ("torn_resize", None),
+        ("busy_storm", None),
+    ],
+)
+def test_supervised_scenario_meets_recovery_contract(tmp_path, name,
+                                                     ranks):
+    """Every packaged scenario carries an expected.recovery block: the
+    verdict-driven ladder must land the named actions (and ONLY those),
+    within the action bound, never before the hysteresis window —
+    including busy_storm (a persistent ps-overload takes NO destructive
+    action) and straggler (quarantine fires only after the verdict
+    persisted N windows, never on a single noisy one)."""
+    res = run_scenario(name, tmp_path, ranks=ranks, supervise=True)
+    assert res["ok"], (name, res["failures"])
+    hyst = constants.get("supervisor_hysteresis_windows")
+    assert all(e["windows"] >= hyst for e in res["recovery"]["journal"])
+
+
+def test_supervised_death_wave_shrinks_and_resumes(tmp_path):
+    """The acceptance ladder in one scenario: hang/rank-dead -> evict
+    the wave (one action, one epoch) -> committed shrink -> training
+    resumed — no rollback, journal byte-identical per seed."""
+    res = run_scenario("death_wave", tmp_path / "a", ranks=64,
+                       supervise=True)
+    assert res["ok"], res["failures"]
+    journal = res["recovery"]["journal"]
+    evicts = [e for e in journal if e["action"] == "evict-shrink"]
+    assert evicts and evicts[0]["ranks"] == [17, 18, 19, 20]
+    assert not res["recovery"]["rolled_back"]
+    shrinks = [r for r in res["stats"]["resizes"]
+               if r["world_old"] > r["world_new"]]
+    assert len(shrinks) == 1  # the wave is ONE membership change
+    assert res["stats"]["steps_completed"] >= 14  # training resumed
+    # byte-identical replay per seed
+    res2 = run_scenario("death_wave", tmp_path / "b", ranks=64,
+                        supervise=True)
+    assert json.dumps(journal, sort_keys=True) == json.dumps(
+        res2["recovery"]["journal"], sort_keys=True
+    )
+
+
+def test_supervised_torn_resize_ends_in_rollback_decision(tmp_path):
+    res = run_scenario("torn_resize", tmp_path, supervise=True)
+    assert res["ok"], res["failures"]
+    assert res["recovery"]["rolled_back"]
+    last = res["recovery"]["journal"][-1]
+    assert last["action"] == "rollback" and last["result"] == "applied"
+    assert res["stats"]["rollback"]["reason"] == "resize-torn"
+
+
+def test_supervised_seed_change_keeps_the_ladder_shape(tmp_path):
+    base = run_scenario("death_wave", tmp_path / "a", ranks=64,
+                        supervise=True)
+    other = run_scenario("death_wave", tmp_path / "b", ranks=64,
+                         seed=4242, supervise=True)
+    assert base["ok"] and other["ok"], (base["failures"],
+                                        other["failures"])
+    assert (
+        [e["action"] for e in base["recovery"]["journal"]]
+        == [e["action"] for e in other["recovery"]["journal"]]
+    )
+
+
+def test_supervised_dry_run_decides_but_never_acts(tmp_path):
+    """supervise_dry_run: the decisions are journaled (result
+    'dry-run') but nobody is evicted — the fleet keeps limping, the
+    dead ranks stay in the membership's hands (heartbeat sweep only)."""
+    scn = dict(
+        __import__("torchmpi_tpu.sim.faults", fromlist=["load_scenario"])
+        .load_scenario("death_wave")
+    )
+    scn["ranks"] = 64
+    scn["supervise_dry_run"] = True
+    scn["expected"] = {"recovery": {}}  # decisions only, no contract
+    res = run_scenario(scn, tmp_path, supervise=True)
+    journal = res["recovery"]["journal"]
+    assert journal and all(e["result"] == "dry-run" for e in journal)
+    assert not res["stats"].get("rollback")
+
+
+def test_supervised_recovery_bench_gate_passes():
+    from torchmpi_tpu.sim.bench import check_supervised_recovery
+
+    assert check_supervised_recovery(ranks=128) == []

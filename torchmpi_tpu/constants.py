@@ -263,6 +263,30 @@ class _Constants:
     # of barrier skew and state-transfer time.
     elastic_barrier_timeout_s: float = 300.0
 
+    # --- recovery supervisor (supervise/ subsystem; launch --supervise) ---
+    # Consecutive live-aggregation windows a streaming verdict must
+    # persist before the supervisor acts on it. 1 acts on the first
+    # window (no hysteresis) — a single noisy window can then evict a
+    # healthy rank, so keep >= 2 in production.
+    supervisor_hysteresis_windows: int = 3
+    # Bounded attempts per escalation-ladder rung: after this many
+    # failed/uncleared attempts of a verdict's primary action, the
+    # supervisor escalates (evict -> checkpoint rollback) or holds.
+    supervisor_max_retries: int = 3
+    # Jittered exponential backoff between attempts of one rung:
+    # base * 2^attempt seconds, +-50% seeded jitter, capped below.
+    supervisor_backoff_base_s: float = 1.0
+    supervisor_backoff_cap_s: float = 30.0
+    # Seconds a quarantined (straggler-evicted) rank stays on the
+    # rejoin denylist; grow-back will not re-admit capacity while the
+    # denylist covers it.
+    supervisor_quarantine_cooldown_s: float = 60.0
+    # Opt-in grow-back rung: once the fleet has been clean for the
+    # hysteresis window and the world is below its observed high-water
+    # (minus quarantined ranks), request an elastic grow. Off by
+    # default: shrink-and-continue is the conservative posture.
+    supervisor_grow_back: bool = False
+
     # --- fleet simulation (torchmpi_tpu.sim: modeled network, real
     # --- control plane; see README "Fleet simulation") ---
     # Modeled wall-clock period of one training step in the simulated

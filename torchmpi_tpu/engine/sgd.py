@@ -23,6 +23,7 @@ Reference behaviors preserved, re-designed for XLA:
 
 from __future__ import annotations
 
+import threading
 import time
 import zlib
 from functools import partial
@@ -402,6 +403,12 @@ class AllReduceSGDEngine:
         self._eval_fns: Dict[Any, Callable] = {}
         self._eval_data: Dict[tuple, tuple] = {}
         self._aot_steps: Dict[tuple, Any] = {}  # precompile() executables
+        # checkpoint_every(): the async rollback-artifact hook
+        self._ckpt_every = 0
+        self._ckpt_path = None
+        self._ckpt_counter = 0
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_warned = False
 
     # ------------------------------------------------------------------
     def _accum_value_and_grad(self, params, model_state, batch, split_fn):
@@ -752,6 +759,7 @@ class AllReduceSGDEngine:
             self.params, self.opt_state, self.model_state, loss = (
                 self._call_step(batch)
             )
+            self._maybe_checkpoint()
             return loss
         t0 = time.perf_counter()
         self.params, self.opt_state, self.model_state, aux = self._call_step(
@@ -763,7 +771,90 @@ class AllReduceSGDEngine:
             jax.tree_util.tree_leaves(batch)[0].shape[0],
             t0, time.perf_counter(), gnorm,
         )
+        self._maybe_checkpoint()
         return loss
+
+    # ------------------------------------------------------------------
+    # checkpoint_every: the async rollback-artifact hook
+    # ------------------------------------------------------------------
+    def checkpoint_every(self, steps: int, path,
+                         start_step: int = 0) -> None:
+        """Arm periodic async checkpointing: every ``steps`` calls to
+        :meth:`step`, the engine saves a portable sharded checkpoint
+        (:func:`~..utils.checkpoint.save_engine_sharded`: atomic
+        ``CURRENT`` pointer, any-world restore) to ``path`` on a
+        background thread and registers it as the newest rollback
+        artifact (:mod:`~..supervise.checkpoints`) — the artifact the
+        supervisor's rollback rung and a ``--max-restarts`` relaunch
+        restore from. One save in flight at a time: a boundary reached
+        while the previous save is still writing is skipped, not
+        queued (the registry is a recency floor, not a history).
+        ``steps=0`` disarms. A resumed run passes ``start_step`` (the
+        restored checkpoint's step) so the saved step numbers continue
+        the training trajectory instead of restarting at 0."""
+        if int(steps) < 0:
+            raise ValueError(
+                f"checkpoint_every expects steps >= 0, got {steps}"
+            )
+        self._ckpt_every = int(steps)
+        self._ckpt_path = path
+        self._ckpt_counter = int(start_step)
+
+    def _maybe_checkpoint(self) -> None:
+        if not self._ckpt_every:
+            return
+        self._ckpt_counter += 1
+        if self._ckpt_counter % self._ckpt_every:
+            return
+        t = self._ckpt_thread
+        if t is not None and t.is_alive():
+            return  # previous save still in flight
+        step = self._ckpt_counter
+        # materialize the state to HOST numpy on the step thread: jax
+        # arrays are immutable but not undeletable — the next step()'s
+        # donation consumes the old buffers, so a writer thread holding
+        # device refs races an "Array has been deleted" error. The
+        # device->host copy is the synchronous part; the file I/O (the
+        # slow part) stays on the background thread.
+        state = {"params": self.params, "opt_state": self.opt_state}
+        if self.model_state is not None:
+            state["model_state"] = self.model_state
+        state = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), state
+        )
+        self._ckpt_thread = threading.Thread(
+            target=self._save_checkpoint, args=(step, state),
+            name="tm-engine-ckpt", daemon=True,
+        )
+        self._ckpt_thread.start()
+
+    def _save_checkpoint(self, step: int, state) -> None:
+        import sys
+
+        from ..utils import checkpoint as _ckpt
+
+        try:
+            _ckpt.save_engine_sharded(
+                self._ckpt_path, self, step=step, state=state
+            )
+        except Exception as e:  # noqa: BLE001 - a failed async save must
+            # never take the training loop down, but a save that ALWAYS
+            # fails means no rollback artifact ever exists — say so once
+            if not self._ckpt_warned:
+                self._ckpt_warned = True
+                print(
+                    f"[engine] checkpoint_every save to "
+                    f"{self._ckpt_path} failed: {e!r} (further "
+                    "failures suppressed)",
+                    file=sys.stderr,
+                )
+
+    def flush_checkpoint(self, timeout: float = 60.0) -> None:
+        """Join any in-flight async save (call before a deliberate exit
+        so the newest artifact is published)."""
+        t = self._ckpt_thread
+        if t is not None:
+            t.join(timeout=timeout)
 
     def broadcast_parameters_now(self):
         """One-shot replica equalization (sgdengine.lua:140-144), blocking."""

@@ -105,25 +105,57 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "fabric knobs like the PS replica-chain length are "
                     "deployable without editing the training script.")
     ap.add_argument("--max-restarts", type=int, default=0,
-                    help="elastic full-job restarts: when a rank dies, kill "
-                    "the survivors and relaunch ALL ranks up to this many "
-                    "times (scripts see TORCHMPI_TPU_RESTART_COUNT and "
-                    "should resume from their last checkpoint). Multi-node "
-                    "jobs (--nnodes > 1) negotiate the per-attempt "
-                    "coordinator WITHOUT communication: attempt k uses "
-                    "--coordinator's port + k, so reserve max-restarts "
-                    "consecutive ports above it on the coordinator host.")
+                    help="full-job restarts: when the world dies, relaunch "
+                    "ALL ranks up to this many times (scripts see "
+                    "TORCHMPI_TPU_RESTART_COUNT and should resume from "
+                    "their last checkpoint). Without --elastic, ANY rank "
+                    "death triggers the relaunch (the pre-elastic model). "
+                    "COMPOSED with --elastic, restart is the LAST "
+                    "escalation rung, not an alternative: single deaths "
+                    "are survived live by the membership layer, and the "
+                    "world only relaunches when live recovery is "
+                    "exhausted — every worker dead, or the --supervise "
+                    "policy engine decides a checkpoint rollback "
+                    "(resize-torn, desync, exhausted single-fault "
+                    "contract). Multi-node jobs (--nnodes > 1) negotiate "
+                    "the per-attempt coordinator WITHOUT communication: "
+                    "attempt k uses --coordinator's port + k, so reserve "
+                    "max-restarts consecutive ports above it on the "
+                    "coordinator host.")
     ap.add_argument("--elastic", action="store_true",
-                    help="LIVE elasticity instead of relaunch: run an "
-                    "elastic membership coordinator in the launcher, export "
+                    help="LIVE elasticity: run an elastic membership "
+                    "coordinator in the launcher, export "
                     "TORCHMPI_TPU_ELASTIC=host:port to every worker, and "
                     "keep the job alive across rank deaths — survivors "
                     "redistribute state through torchmpi_tpu.reshard and "
                     "training continues (no world relaunch). An operator "
                     "`python -m torchmpi_tpu.reshard.elastic grow <addr>` "
-                    "spawns one more worker; `shrink` evicts one. The "
-                    "launcher exits when every worker has; the exit code is "
-                    "the LAST worker's. Single-node only.")
+                    "spawns one more worker; `shrink` evicts one; `evict "
+                    "--mid M` removes a specific member. The launcher "
+                    "exits when every worker has; the exit code is the "
+                    "LAST worker's. Composes with --max-restarts (the "
+                    "checkpoint-rollback rung) and --supervise (autonomous "
+                    "recovery). Single-node only.")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the verdict-driven recovery supervisor in "
+                    "the launcher (requires --elastic; implies "
+                    "--telemetry-live): streaming verdicts from the fleet "
+                    "aggregator drive a policy table with hysteresis, "
+                    "bounded jittered retries and an escalation ladder — "
+                    "rank-dead/hang evicts the rank and commits a live "
+                    "shrink, stragglers are quarantined (evict + rejoin "
+                    "denylist), and resize-torn/desync/exhausted-contract "
+                    "roll the world back to the last checkpoint_every "
+                    "artifact (give the job restart budget with "
+                    "--max-restarts). Actions serve on the live plane's "
+                    "/actions endpoint and as tm_supervisor_* metrics; "
+                    "knobs: the supervisor_* constants "
+                    "(--set-constant supervisor_hysteresis_windows=2 ...)")
+    ap.add_argument("--supervise-dry-run", action="store_true",
+                    help="with --supervise: journal every recovery "
+                    "decision (stderr, /actions, metrics) but actuate "
+                    "nothing — the shadow-mode rollout posture. Implies "
+                    "--supervise.")
     ap.add_argument("--elastic-addr-file", default=None,
                     help="write the elastic coordinator's host:port here "
                     "(atomic), for operators and tests")
@@ -152,9 +184,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
     if args.elastic and args.nnodes > 1:
         ap.error("--elastic requires a single-node job (nnodes == 1)")
-    if args.elastic and args.max_restarts:
-        ap.error("--elastic and --max-restarts are alternative recovery "
-                 "models; pick one")
+    if args.supervise_dry_run:
+        args.supervise = True
+    if args.supervise and not args.elastic:
+        ap.error("--supervise requires --elastic (the supervisor drives "
+                 "the elastic membership coordinator)")
+    if args.supervise:
+        # the supervisor consumes the launcher-resident aggregator's
+        # streaming verdicts: the live plane IS its sensor
+        args.telemetry_live = True
     if args.watchdog_timeout < 0:
         ap.error(
             f"--watchdog-timeout must be >= 0, got {args.watchdog_timeout}"
@@ -174,7 +212,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra = extra[1:]
 
     if args.elastic:
-        return _run_elastic(args, target, extra)
+        # Live elasticity first, full-job restart LAST: single deaths
+        # are survived in place by the membership layer, so an elastic
+        # attempt only ends nonzero when live recovery is exhausted —
+        # every worker dead, or the supervisor's rollback rung killed
+        # the world on purpose. THAT is what --max-restarts now buys
+        # under --elastic: relaunch from the last registered checkpoint
+        # (scripts read TORCHMPI_TPU_RESTART_COUNT and the
+        # TORCHMPI_TPU_CHECKPOINT_STATE registry to resume).
+        # the cross-process last-checkpoint registry root is chosen ONCE,
+        # outside the attempt loop: the registered artifact must survive
+        # the very restart it exists to serve. A run-scoped temp root
+        # (no --telemetry-dir) holds only the registry POINTER, not the
+        # artifacts, so it is removed once the job is over.
+        import shutil
+        import tempfile
+
+        tmp_root = None
+        if args.telemetry_dir:
+            state_root = Path(args.telemetry_dir)
+        else:
+            tmp_root = tempfile.mkdtemp(prefix="tm-elastic-state-")
+            state_root = Path(tmp_root)
+        try:
+            for restart in range(args.max_restarts + 1):
+                rc = _run_elastic(args, target, extra, restart,
+                                  state_root)
+                if rc == 0 or rc == 130 or restart == args.max_restarts:
+                    return rc
+                print(
+                    f"[launch] elastic attempt {restart} ended with "
+                    f"rc={rc}; relaunching the world from the last "
+                    f"checkpoint ({args.max_restarts - restart} "
+                    "restart(s) left)",
+                    file=sys.stderr,
+                )
+            return rc
+        finally:
+            if tmp_root is not None:
+                shutil.rmtree(tmp_root, ignore_errors=True)
 
     # Restart-style recovery = full-job relaunch from the last
     # checkpoint (a controller process cannot rejoin a running
@@ -289,12 +365,20 @@ def _close_live_aggregator(agg, telemetry_dir) -> None:
     agg.close()
 
 
-def _run_elastic(args, target, extra) -> int:
+def _run_elastic(args, target, extra, restart: int,
+                 state_root) -> int:
     """Live-elastic supervision: one membership coordinator in THIS
     process, workers that survive each other's deaths, and an operator
     grow surface that spawns additional workers into the running job.
     Exits when every worker has; returns the last worker's exit code
-    (survivors of tolerated deaths exit last, so a recovered job is 0)."""
+    (survivors of tolerated deaths exit last, so a recovered job is 0).
+
+    With ``--supervise``, a :class:`~.supervise.RecoverySupervisor`
+    consumes the launcher aggregator's streaming verdicts and acts:
+    evict (SIGKILL + the membership sweep commits the live shrink),
+    grow, or — the last rung — kill the world so the surrounding
+    ``--max-restarts`` loop relaunches attempt ``restart + 1`` from the
+    last registered checkpoint."""
     from .analysis import lockmon as _lockmon
     from .reshard.elastic import ElasticCoordinator
 
@@ -324,30 +408,48 @@ def _run_elastic(args, target, extra) -> int:
     telemetry_dir = Path(args.telemetry_dir) if args.telemetry_dir else None
     if telemetry_dir is not None:
         telemetry_dir.mkdir(parents=True, exist_ok=True)
-        for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json",
-                        "dead_rank_*.json"):
-            for stale in telemetry_dir.glob(pattern):
-                try:
-                    stale.unlink()
-                except OSError:
-                    pass
+        # clear liveness/hang artifacts from a PREVIOUS LAUNCH only
+        # (attempt 0): on a restart attempt they are the failed
+        # attempt's post-mortem — the evidence that explains the very
+        # failure that consumed the restart
+        if restart == 0:
+            for pattern in ("heartbeat_rank_*.json", "hang_rank_*.json",
+                            "dead_rank_*.json"):
+                for stale in telemetry_dir.glob(pattern):
+                    try:
+                        stale.unlink()
+                    except OSError:
+                        pass
+    # the cross-process last-checkpoint registry: workers register
+    # every checkpoint_every artifact here; the supervisor's rollback
+    # rung and a relaunched attempt both read it. The root comes from
+    # main()'s restart loop (chosen once, so the registry SURVIVES
+    # restart attempts — the artifact is the whole point of the
+    # restart); exported into THIS process's env too, or the
+    # launcher-resident supervisor could never see what the workers
+    # registered.
+    ckpt_state = Path(state_root) / "last_checkpoint.json"
+    os.environ["TORCHMPI_TPU_CHECKPOINT_STATE"] = str(ckpt_state)
     live_agg = _start_live_aggregator(args, telemetry_dir)
 
     def spawn_locked(addr: str) -> None:
         rank = next_rank[0]
         next_rank[0] += 1
-        env = _worker_env(args, rank)
+        env = _worker_env(args, rank, restart)
         env["TORCHMPI_TPU_ELASTIC"] = addr
         env["TORCHMPI_TPU_ELASTIC_RANK"] = str(rank)
+        env["TORCHMPI_TPU_CHECKPOINT_STATE"] = str(ckpt_state)
         if rank >= args.nproc:
             # spawned by an operator grow INTO a running job: the worker
             # must attach to the live membership, not wait for formation
             env["TORCHMPI_TPU_ELASTIC_JOINER"] = "1"
         if telemetry_dir is not None:
-            env["TORCHMPI_TPU_TELEMETRY"] = "1"
-            env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(
-                telemetry_dir / f"telemetry_rank_{rank}.json"
+            tname = (
+                f"telemetry_rank_{rank}.json" if restart == 0
+                else f"telemetry_rank_{rank}.restart{restart}.json"
             )
+            env["TORCHMPI_TPU_TELEMETRY"] = "1"
+            env["TORCHMPI_TPU_TELEMETRY_DUMP"] = str(telemetry_dir / tname)
         if live_agg is not None:
             # elastic workers piggyback their live frames on the
             # membership heartbeat instead of opening another socket;
@@ -394,6 +496,120 @@ def _run_elastic(args, target, extra) -> int:
         tmp.write_text(coord_box["addr"])
         os.replace(tmp, args.elastic_addr_file)
 
+    rollback_box: dict = {}
+    sup_stop = threading.Event()
+    sup_thread = None
+    if args.supervise:
+        from . import constants
+        from .supervise import RecoverySupervisor
+        from .supervise import checkpoints as _ckpts
+
+        class _Actuator:
+            """The supervisor's levers over THIS launcher's job."""
+
+            def evict(self, ranks, reason):
+                with lock:
+                    live = [r for r, p in procs.items()
+                            if p.poll() is None]
+                doomed = [r for r in ranks if r in live]
+                if doomed and len(live) - len(doomed) < 1:
+                    # cannot evict below 1 (the coordinator's own rule):
+                    # a FAILED attempt — the bounded retries escalate to
+                    # rollback instead of beheading the job
+                    return False
+                for r in ranks:
+                    with lock:
+                        p = procs.get(r)
+                    if p is not None and p.poll() is None:
+                        # SIGKILL, not SIGTERM: a wedged worker (the
+                        # hang verdict) won't honor polite signals, and
+                        # membership eviction follows from the silence
+                        # (heartbeat sweep -> epoch bump -> live shrink)
+                        p.kill()
+                    # a deliberately evicted rank leaves the fleet view:
+                    # the verdict must stop charging the job with it
+                    live_agg.mark_evicted(r)
+                return True
+
+            def grow(self, reason):
+                on_grow()
+                return True
+
+            def rollback(self, reason):
+                if restart >= args.max_restarts:
+                    # no restart budget left: killing the world would be
+                    # a job death, not a rollback. Refuse (a counted
+                    # FAILED attempt, journaled and bounded) — the
+                    # survivors keep limping, which beats nothing.
+                    print(
+                        f"[supervise] rollback ({reason}) REFUSED: no "
+                        "restart budget (give the job --max-restarts)",
+                        file=sys.stderr,
+                    )
+                    return False
+                rollback_box["reason"] = reason
+                print(
+                    f"[supervise] rollback ({reason}): killing the "
+                    f"world — {_ckpts.describe_last()}",
+                    file=sys.stderr,
+                )
+                with lock:
+                    victims = list(procs.values())
+                for p in victims:
+                    if p.poll() is None:
+                        p.kill()
+                return True
+
+        def _print_action(entry):
+            print(
+                "[supervise] action={action} verdict={verdict} "
+                "ranks={ranks} windows={windows} attempt={attempt} "
+                "result={result}".format(**entry),
+                file=sys.stderr,
+            )
+
+        sup = RecoverySupervisor(
+            _Actuator(), dry_run=args.supervise_dry_run,
+            on_action=_print_action,
+        )
+        live_agg.attach_supervisor(sup)
+        sup_interval = float(constants.get("telemetry_live_interval_s"))
+
+        def _sup_loop():
+            warned = False
+            while not sup_stop.wait(sup_interval):
+                try:
+                    sup.observe(live_agg.evaluate())
+                except Exception as e:  # noqa: BLE001 - one bad window
+                    # must not end supervision, but a PERSISTENTLY
+                    # broken sensor must not fail silent either
+                    if not warned:
+                        warned = True
+                        print(
+                            f"[supervise] verdict evaluation failed: "
+                            f"{e!r} (supervision degraded; further "
+                            "failures suppressed)",
+                            file=sys.stderr,
+                        )
+        sup_thread = threading.Thread(
+            target=_sup_loop, name="tm-supervisor", daemon=True
+        )
+        sup_thread.start()
+        print(
+            "[launch] recovery supervisor armed"
+            + (" (dry-run)" if args.supervise_dry_run else "")
+            + f" — actions at http://127.0.0.1:{live_agg.http_port}"
+            "/actions",
+            file=sys.stderr,
+        )
+        if not args.max_restarts and not args.supervise_dry_run:
+            print(
+                "[launch] note: --supervise without --max-restarts "
+                "has no rollback budget — the rollback rung will "
+                "refuse to fire (evict/quarantine still act)",
+                file=sys.stderr,
+            )
+
     with lock:
         for _ in range(args.nproc):
             spawn_locked(coord_box["addr"])
@@ -437,12 +653,20 @@ def _run_elastic(args, target, extra) -> int:
             except subprocess.TimeoutExpired:
                 p.kill()
     finally:
+        sup_stop.set()
+        if sup_thread is not None:
+            sup_thread.join(timeout=5)
         coord.close()
         for reader in readers:
             reader.join(timeout=5)
         for f in logs:
             f.close()
         _close_live_aggregator(live_agg, telemetry_dir)
+    if rollback_box.get("reason") and rc == 0:
+        # every worker exited 0 despite a rollback kill (a race on the
+        # way down): the attempt must still read as failed so the
+        # restart loop relaunches from the checkpoint
+        rc = 1
     return rc
 
 

@@ -51,6 +51,7 @@ import numpy as np
 
 from .. import constants
 from ..analysis import lockmon as _lockmon
+from ..supervise import checkpoints as _checkpoints
 from ..telemetry import flightrecorder as _flight
 from .core import Layout, chunk_spans, chunk_elems_for, plan_transfers
 
@@ -89,7 +90,10 @@ class Evicted(Exception):
 
 class DataLoss(RuntimeError):
     """A shard's primary AND its ring replica died in one epoch — the
-    single-fault contract is exhausted; restore from checkpoint."""
+    single-fault contract is exhausted. The message names the last
+    registered rollback artifact (:mod:`~..supervise.checkpoints`):
+    the supervisor's rollback rung and the operator both need the
+    checkpoint path and step, not a bare "restore from checkpoint"."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -114,15 +118,17 @@ def _json_roundtrip(addr: Tuple[str, int], req: dict,
         return json.loads(_recv_exact(s, n))
 
 
-def operator_request(addr, op: str, timeout: float = 60.0) -> dict:
-    """Operator surface: ``grow`` (spawn + admit one member) or
-    ``shrink`` (evict the highest-id member). ``addr`` is
-    ``(host, port)`` or ``"host:port"`` (what ``launch --elastic``
-    prints / writes to ``--elastic-addr-file``)."""
+def operator_request(addr, op: str, timeout: float = 60.0,
+                     **extra) -> dict:
+    """Operator surface: ``grow`` (spawn + admit one member),
+    ``shrink`` (evict the highest-id member), or ``evict`` (evict a
+    SPECIFIC member, ``mid=``  — the supervisor's targeted-removal
+    primitive). ``addr`` is ``(host, port)`` or ``"host:port"`` (what
+    ``launch --elastic`` prints / writes to ``--elastic-addr-file``)."""
     if isinstance(addr, str):
         h, _, p = addr.rpartition(":")
         addr = (h, int(p))
-    return _json_roundtrip(addr, {"op": op}, timeout=timeout)
+    return _json_roundtrip(addr, {"op": op, **extra}, timeout=timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -277,6 +283,28 @@ class ElasticCoordinator:
                 del self._members[victim]
                 self._bump_epoch_locked()
                 return {"ok": True, "evicted": victim,
+                        "epoch": self.epoch}
+            if op == "evict":
+                # targeted eviction (the supervisor's remediation for
+                # named members), ``mid`` or ``mids``: the whole wave is
+                # ONE membership change — one epoch bump, one resize —
+                # exactly like sweep_dead (per-corpse epochs would leave
+                # barrier-less epoch gaps the analyzer reads as desync).
+                # Idempotent: evicting an absent member is success, the
+                # goal state ("not a member") already holds.
+                want = req.get("mids")
+                if want is None:
+                    want = [req.get("mid")]
+                victims = [m for m in want if m in self._members]
+                if not victims:
+                    return {"ok": True, "evicted": [],
+                            "epoch": self.epoch}
+                if len(victims) >= len(self._members):
+                    return {"ok": False, "error": "cannot evict below 1"}
+                for m in victims:
+                    del self._members[m]
+                self._bump_epoch_locked()
+                return {"ok": True, "evicted": sorted(victims),
                         "epoch": self.epoch}
             if op == "barrier":
                 return self._barrier_locked(req)
@@ -895,13 +923,13 @@ class ElasticMember:
             raise DataLoss(
                 f"epoch {epoch}: survivors hold mixed resize layouts "
                 f"(committed epochs {sorted(was)}) after an aborted "
-                "resize — restore from checkpoint"
+                f"resize — {_checkpoints.describe_last()}"
             )
         if summary.get("src_unresolved"):
             raise DataLoss(
                 f"epoch {epoch}: survivors' committed layout (epoch "
                 f"{was[0]}) predates the coordinator's membership "
-                "history — restore from checkpoint"
+                f"history — {_checkpoints.describe_last()}"
             )
         prev = [int(m) for m in summary.get("src_members", [])] or view.prev
         k_old, k_new = len(prev), len(mids)
@@ -912,7 +940,8 @@ class ElasticMember:
         anchor = summary.get("anchor")
         if anchor is None:
             raise DataLoss(
-                f"epoch {epoch}: no stateful survivor from {prev}"
+                f"epoch {epoch}: no stateful survivor from {prev} — "
+                f"{_checkpoints.describe_last()}"
             )
         anchor = int(anchor)
         agreed = int(summary.get("step", 0))
@@ -933,7 +962,8 @@ class ElasticMember:
                 return holder, True
             raise DataLoss(
                 f"shard {old_rank}: primary {m} and replica holder "
-                f"{prev[(old_rank + 1) % k_old]} both gone in epoch {epoch}"
+                f"{prev[(old_rank + 1) % k_old]} both gone in epoch "
+                f"{epoch} — {_checkpoints.describe_last()}"
             )
 
         # STAGED commit: nothing overwrites a source buffer until every
@@ -1193,6 +1223,43 @@ def from_env(state: ElasticState) -> ElasticMember:
 
 
 # ---------------------------------------------------------------------------
+# host-zero1 checkpointing: the rollback artifact checkpoint_every keeps
+# fresh (atomic single-file .npz; registered with supervise.checkpoints)
+# ---------------------------------------------------------------------------
+
+
+def save_zero1_checkpoint(path, params: np.ndarray, step: int) -> None:
+    """Atomically persist ``{params, step}`` to ``path`` (a ``.npz``
+    file: temp + rename, so a death mid-save leaves the previous
+    artifact intact) and register it as the newest rollback artifact
+    (:func:`~..supervise.checkpoints.register_checkpoint`) — which is
+    what DataLoss messages and the supervisor's rollback rung name."""
+    import pathlib
+
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_name(p.name + f".tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        np.savez(f, params=np.asarray(params), step=np.int64(step))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, p)
+    _checkpoints.register_checkpoint(p, int(step))
+
+
+def load_zero1_checkpoint(path) -> Optional[Dict[str, Any]]:
+    """``{"params", "step"}`` from :func:`save_zero1_checkpoint`, or
+    None when no artifact exists yet (cold start)."""
+    import pathlib
+
+    p = pathlib.Path(path)
+    if not p.exists():
+        return None
+    with np.load(p) as z:
+        return {"params": np.array(z["params"]), "step": int(z["step"])}
+
+
+# ---------------------------------------------------------------------------
 # host-zero1 elastic trainer
 # ---------------------------------------------------------------------------
 
@@ -1226,7 +1293,86 @@ class ElasticZero1:
         self.lr, self.mu = float(lr), float(momentum)
         self.step_idx = 0
         self._stash: Optional[dict] = None
+        self._ckpt_every = 0
+        self._ckpt_path = None
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_warned = False
+        self._ckpt_saved_step = -1
         member.on_agreed_step = self._apply_stash
+
+    def checkpoint_every(self, steps: int, path) -> None:
+        """Arm the async rollback-artifact hook: every ``steps``
+        committed steps, the member currently at rank 0 saves
+        ``{params, step}`` to ``path`` on a background thread
+        (:func:`save_zero1_checkpoint`: atomic replace + registry).
+        One save in flight at a time — when a save is still running at
+        the next due step, that step is skipped, not queued (the
+        artifact is a recency floor, not a history;
+        :meth:`flush_checkpoint` makes the FINAL state durable).
+        ``steps=0`` disarms (the engine hook's convention)."""
+        if int(steps) < 0:
+            raise ValueError(f"checkpoint_every expects steps >= 0, "
+                             f"got {steps}")
+        self._ckpt_every = int(steps)
+        self._ckpt_path = path
+
+    def _maybe_checkpoint(self, rank: int) -> None:
+        if (
+            not self._ckpt_every
+            or rank != 0
+            or self.step_idx % self._ckpt_every != 0
+        ):
+            return
+        t = self._ckpt_thread
+        if t is not None and t.is_alive():
+            return  # previous save still in flight: skip this boundary
+        # snapshot on the step thread — the training loop may mutate
+        # params while the writer thread serializes
+        params = self.params.copy()
+        step = self.step_idx
+        self._ckpt_thread = threading.Thread(
+            target=self._save_checkpoint, args=(params, step),
+            name="tm-zero1-ckpt", daemon=True,
+        )
+        self._ckpt_thread.start()
+
+    def _save_checkpoint(self, params: np.ndarray, step: int) -> None:
+        try:
+            save_zero1_checkpoint(self._ckpt_path, params, step)
+            self._ckpt_saved_step = step
+        except Exception as e:  # noqa: BLE001 - a failed save must never
+            # kill training (nor die as a silent daemon-thread
+            # traceback) — but a save that ALWAYS fails means no
+            # rollback artifact: say so once
+            if not self._ckpt_warned:
+                self._ckpt_warned = True
+                import sys
+
+                print(
+                    f"[elastic] checkpoint_every save to "
+                    f"{self._ckpt_path} failed: {e!r} (further "
+                    "failures suppressed)",
+                    file=sys.stderr,
+                )
+
+    def flush_checkpoint(self, timeout: float = 30.0) -> None:
+        """Make the CURRENT state durable before a deliberate exit:
+        join any in-flight async save, then — when this member is rank
+        0 and the last boundary was skipped (a save was in flight) or
+        hasn't been reached — save synchronously, so the artifact never
+        trails a clean shutdown."""
+        t = self._ckpt_thread
+        if t is not None:
+            t.join(timeout=timeout)
+        view = self.member._view
+        if (
+            self._ckpt_every
+            and self._ckpt_path is not None
+            and view is not None
+            and view.rank_of(self.member.mid) == 0
+            and self._ckpt_saved_step != self.step_idx
+        ):
+            self._save_checkpoint(self.params.copy(), self.step_idx)
 
     def _apply_stash(self, agreed: int) -> None:
         """Resize-barrier reconciliation: a step is torn when SOME
@@ -1292,6 +1438,7 @@ class ElasticZero1:
                     st["momentum"].replica[:] = new_replica
                 self._stash = None
                 self.step_idx += 1
+                self._maybe_checkpoint(rank)
                 return float(loss)
             except EpochChanged:
                 continue
@@ -1319,11 +1466,18 @@ def _main(argv=None) -> int:
         prog="python -m torchmpi_tpu.reshard.elastic",
         description="send an operator command to a live elastic job",
     )
-    ap.add_argument("command", choices=["grow", "shrink", "view"])
+    ap.add_argument("command", choices=["grow", "shrink", "evict", "view"])
     ap.add_argument("address", help="coordinator host:port "
                     "(see launch --elastic-addr-file)")
+    ap.add_argument("--mid", type=int, default=None,
+                    help="member id to remove (required with evict)")
     args = ap.parse_args(argv)
-    rep = operator_request(args.address, args.command)
+    extra = {}
+    if args.command == "evict":
+        if args.mid is None:
+            ap.error("evict requires --mid")
+        extra["mid"] = args.mid
+    rep = operator_request(args.address, args.command, **extra)
     print(json.dumps(rep))
     return 0 if rep.get("ok", True) else 1
 

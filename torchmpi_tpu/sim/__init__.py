@@ -54,19 +54,22 @@ from .core import EventLoop  # noqa: F401
 # pay the control-plane import at every subprocess start
 _LAZY = {
     "ModeledNetwork": ".net",
+    "SimActuator": ".fleet",
     "SimFleet": ".fleet",
     "SimPS": ".fleet",
     "WALL_BASE": ".fleet",
     "SCENARIO_DIR": ".faults",
+    "check_recovery": ".faults",
     "load_scenario": ".faults",
     "run_scenario": ".faults",
     "verdict_of": ".faults",
 }
 
 __all__ = [
-    "EventLoop", "ModeledNetwork", "SimFleet", "SimPS", "WALL_BASE",
-    "derive_seed", "rng_for", "wait_until",
-    "SCENARIO_DIR", "load_scenario", "run_scenario", "verdict_of",
+    "EventLoop", "ModeledNetwork", "SimActuator", "SimFleet", "SimPS",
+    "WALL_BASE", "derive_seed", "rng_for", "wait_until",
+    "SCENARIO_DIR", "check_recovery", "load_scenario", "run_scenario",
+    "verdict_of",
 ]
 
 

@@ -39,6 +39,14 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None,
                     help="override every scenario's seed (the analyzer "
                     "verdict must not change with it)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="close the recovery loop: attach the live "
+                    "aggregator AND a RecoverySupervisor (the identical "
+                    "engine `launch --supervise` runs) on the virtual "
+                    "clock; each scenario's expected.recovery block is "
+                    "asserted instead of the unsupervised evidence "
+                    "contract, and the output line carries the action "
+                    "journal")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -57,7 +65,8 @@ def main(argv=None) -> int:
         scn = load_scenario(src)
         out = root / scn["name"]
         res = run_scenario(
-            scn, out, seed=args.seed, ranks=args.ranks
+            scn, out, seed=args.seed, ranks=args.ranks,
+            supervise=args.supervise,
         )
         line = {
             "scenario": res["name"],
@@ -70,6 +79,15 @@ def main(argv=None) -> int:
             "events": res["stats"].get("events"),
             "analysis": res["analysis_path"],
         }
+        if args.supervise:
+            line["recovery"] = {
+                "actions": [
+                    {k: e[k] for k in ("verdict", "action", "ranks",
+                                       "windows", "result")}
+                    for e in res["recovery"]["journal"]
+                ],
+                "rolled_back": res["recovery"]["rolled_back"],
+            }
         print(json.dumps(line), flush=True)
         if not res["ok"]:
             rc = 1

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import time
+from pathlib import Path
 from typing import Any, Dict, List
 
 from .. import constants
@@ -151,4 +152,60 @@ def check_curve(points: List[Dict[str, Any]], seed: int = 17
                 f"world {points[0]['world']}: replay with seed {seed} "
                 "diverged — determinism broken"
             )
+    return failures
+
+
+#: bound on supervised death-wave recovery: the whole episode — evict
+#: the wave, commit the shrink, settle back to clean — must fit in this
+#: many journaled actions (an unbounded remediation loop is the failure
+#: mode the gate exists for)
+MAX_RECOVERY_ACTIONS = 4
+
+
+def check_supervised_recovery(ranks: int = 1024) -> List[str]:
+    """CI gate (``bench.py --sim --check``): supervised death-wave
+    recovery at ``ranks`` must CONVERGE — the supervisor evicts the
+    wave, a shrink commits, training resumes, no rollback — within
+    :data:`MAX_RECOVERY_ACTIONS` actions, and the journal must replay
+    byte-identically per seed. Failures as strings (empty = pass)."""
+    import tempfile
+
+    from .faults import run_scenario
+
+    failures: List[str] = []
+    runs = []
+    for tag in ("a", "b"):
+        out = Path(tempfile.mkdtemp(prefix=f"tm-sim-recover-{tag}-"))
+        try:
+            runs.append(
+                run_scenario("death_wave", out, ranks=ranks,
+                             supervise=True)
+            )
+        finally:
+            import shutil
+
+            shutil.rmtree(out, ignore_errors=True)
+    res, replay = runs
+    if not res["ok"]:
+        failures += [f"supervised death_wave@{ranks}: {f}"
+                     for f in res["failures"]]
+    journal = res["recovery"]["journal"]
+    if len(journal) > MAX_RECOVERY_ACTIONS:
+        failures.append(
+            f"supervised death_wave@{ranks}: recovery took "
+            f"{len(journal)} actions (> {MAX_RECOVERY_ACTIONS}) — "
+            "remediation did not converge"
+        )
+    if res["recovery"]["rolled_back"]:
+        failures.append(
+            f"supervised death_wave@{ranks}: escalated to rollback — "
+            "a single recoverable wave must stay on the evict rung"
+        )
+    if json.dumps(journal, sort_keys=True) != json.dumps(
+        replay["recovery"]["journal"], sort_keys=True
+    ):
+        failures.append(
+            f"supervised death_wave@{ranks}: journal replay diverged "
+            "— recovery determinism broken"
+        )
     return failures

@@ -191,9 +191,80 @@ def check_expectations(expected: dict, report: dict,
     return failures
 
 
+def check_recovery(expected: dict, supervisor, stats: dict) -> List[str]:
+    """The supervised counterpart of :func:`check_expectations`: every
+    failed ``expected.recovery`` assertion as a string. Checked only on
+    supervised runs — the supervisor changes the run's course (early
+    evictions, a rollback that ends the world), so the recovery
+    contract is asserted on the JOURNAL and the fleet stats, not on the
+    unsupervised evidence shape."""
+    failures: List[str] = []
+
+    def need(cond: bool, msg: str) -> None:
+        if not cond:
+            failures.append(msg)
+
+    journal = supervisor.journal
+    actions = [e["action"] for e in journal]
+    for name in expected.get("actions_include", []):
+        need(name in actions, f"recovery: expected a {name!r} action, "
+             f"journal has {actions}")
+    for name in expected.get("actions_exclude", []):
+        need(name not in actions, f"recovery: forbidden {name!r} action "
+             f"fired: {journal}")
+    if "max_actions" in expected:
+        need(
+            len(journal) <= expected["max_actions"],
+            f"recovery: {len(journal)} actions > bound "
+            f"{expected['max_actions']} (unbounded remediation): "
+            f"{actions}",
+        )
+    if "min_windows_before_action" in expected:
+        # the hysteresis contract: no action on a single noisy window
+        bad = [e for e in journal
+               if e["windows"] < expected["min_windows_before_action"]]
+        need(
+            not bad,
+            "recovery: action(s) fired before the verdict persisted "
+            f"{expected['min_windows_before_action']} windows: {bad}",
+        )
+    if "evicts_include" in expected:
+        want = {int(r) for r in expected["evicts_include"]}
+        got = {int(r) for e in journal for r in e.get("ranks", [])}
+        need(
+            want <= got,
+            f"recovery: evicted ranks expected ⊇ {sorted(want)}, "
+            f"got {sorted(got)}",
+        )
+    if "rollback" in expected:
+        rolled = bool(stats.get("rollback")) or supervisor.rolled_back
+        need(
+            rolled == bool(expected["rollback"]),
+            f"recovery: rollback decided={rolled}, expected "
+            f"{bool(expected['rollback'])}",
+        )
+    if expected.get("shrink_committed"):
+        shrunk = any(
+            r["world_old"] > r["world_new"]
+            for r in stats.get("resizes", [])
+        )
+        need(shrunk, "recovery: no committed shrink in "
+             f"{stats.get('resizes', [])}")
+    if "resumed_steps_min" in expected:
+        need(
+            stats.get("steps_completed", 0)
+            >= expected["resumed_steps_min"],
+            "recovery: training did not resume — steps completed "
+            f"{stats.get('steps_completed', 0)} < "
+            f"{expected['resumed_steps_min']}",
+        )
+    return failures
+
+
 def run_scenario(src, out_dir, seed: Optional[int] = None,
                  ranks: Optional[int] = None,
-                 live: bool = False) -> Dict[str, Any]:
+                 live: bool = False,
+                 supervise: bool = False) -> Dict[str, Any]:
     """Run one scenario end to end; returns ``{name, verdict, ok,
     failures, report, stats, analysis_path}``. ``seed``/``ranks``
     override the scenario file (the determinism tests re-run with a
@@ -205,7 +276,16 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
     ``live_verdicts`` — the streaming verdict transitions, each stamped
     with the virtual time it was reached — and ``live`` (the aggregator
     itself), so tests can assert the named verdict appeared WHILE the
-    scenario was still running and replays byte-identically per seed."""
+    scenario was still running and replays byte-identically per seed.
+
+    ``supervise=True`` (implies ``live``) additionally closes the loop:
+    a :class:`~..supervise.RecoverySupervisor` consumes every verdict
+    window through a :class:`~.fleet.SimActuator` — the identical
+    engine ``launch --supervise`` runs, on the virtual clock. The run's
+    COURSE changes (early evictions, a rollback ends the world), so the
+    scenario's ``expected.recovery`` block is asserted INSTEAD of the
+    unsupervised evidence expectations; the result carries
+    ``recovery`` (journal, counters, rollback flag) and ``supervisor``."""
     scn = load_scenario(src)
     seed = scn.get("seed", 0) if seed is None else seed
     world = int(ranks if ranks is not None else scn.get("ranks", 64))
@@ -241,7 +321,8 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
             else:
                 raise ValueError(f"unknown scenario event kind {kind!r}")
         aggregator = None
-        if live:
+        supervisor = None
+        if live or supervise:
             from ..telemetry.live import FleetAggregator
 
             hb = float(constants.get("elastic_heartbeat_seconds"))
@@ -249,6 +330,17 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
                 clock=lambda: fleet.wall(), stale_after_s=3.0 * hb
             )
             fleet.attach_live(aggregator, interval_s=hb)
+        if supervise:
+            from ..supervise import RecoverySupervisor
+            from .fleet import SimActuator
+
+            supervisor = RecoverySupervisor(
+                SimActuator(fleet),
+                clock=lambda: fleet.wall(),
+                seed=seed,
+                dry_run=bool(scn.get("supervise_dry_run", False)),
+            )
+            fleet.attach_supervisor(supervisor)
         if "ps" in scn:
             ps = dict(scn["ps"])
             SimPS(
@@ -276,9 +368,16 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
             json.dumps(report, indent=2, default=str, sort_keys=True)
         )
         verdict = verdict_of(report)
-        failures = check_expectations(
-            scn.get("expected", {}), report, verdict, stats
-        )
+        expected = dict(scn.get("expected", {}))
+        if supervisor is not None:
+            failures = check_recovery(
+                expected.get("recovery", {}), supervisor, stats
+            )
+        else:
+            failures = check_expectations(
+                {k: v for k, v in expected.items() if k != "recovery"},
+                report, verdict, stats,
+            )
         result = {
             "name": scn.get("name", "scenario"),
             "verdict": verdict,
@@ -291,6 +390,14 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
         if aggregator is not None:
             result["live"] = aggregator
             result["live_verdicts"] = list(aggregator.verdict_history)
+        if supervisor is not None:
+            result["supervisor"] = supervisor
+            result["recovery"] = {
+                "journal": list(supervisor.journal),
+                "counters": dict(supervisor.counters),
+                "quarantined": dict(supervisor.quarantined),
+                "rolled_back": supervisor.rolled_back,
+            }
         return result
     finally:
         for k, v in prev.items():

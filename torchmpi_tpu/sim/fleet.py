@@ -129,6 +129,7 @@ class SimFleet:
         self._finished = False
         self._step_token = 0
         self.live = None  # FleetAggregator fed on virtual time
+        self.supervisor = None  # RecoverySupervisor on the live verdicts
         self._live_interval = 0.0
         hb = float(constants.get("elastic_heartbeat_seconds"))
         self.loop.after(hb, self._beat_tick)
@@ -260,6 +261,22 @@ class SimFleet:
         self._live_interval = float(interval_s)
         self.loop.after(self._live_interval, self._live_tick)
 
+    def attach_supervisor(self, supervisor) -> None:
+        """Close the loop on the simulated fleet: every live tick's
+        verdict document feeds the :class:`~..supervise
+        .RecoverySupervisor` at the same virtual instant, and its
+        actions come back through a :class:`SimActuator` — the
+        identical decision engine the launcher runs, at 1k-10k ranks,
+        byte-identical per seed. Requires :meth:`attach_live` first
+        (the supervisor's sensor is the aggregator)."""
+        if self.live is None:
+            raise RuntimeError(
+                "attach_live must come first: the supervisor consumes "
+                "the live aggregator's verdict stream"
+            )
+        self.supervisor = supervisor
+        self.live.attach_supervisor(supervisor)
+
     def _live_tick(self) -> None:
         agg = self.live
         if agg is None:
@@ -289,7 +306,9 @@ class SimFleet:
                     if sr.committed_epoch is not None else 0
                 ),
             })
-        agg.evaluate(now=self.wall())
+        doc = agg.evaluate(now=self.wall())
+        if self.supervisor is not None:
+            self.supervisor.observe(doc, now=self.wall())
         if not self._finished:
             self.loop.after(self._live_interval, self._live_tick)
 
@@ -622,6 +641,59 @@ class SimFleet:
                 json.dumps(hang, indent=1, default=str)
             )
         return out
+
+
+class SimActuator:
+    """The supervisor's levers over a simulated fleet — the exact
+    semantics of the launcher's actuator, on the virtual clock:
+
+    - ``evict``: kill the rank (its heartbeats/frames stop, as a
+      SIGKILL's would), remove its membership through the REAL
+      coordinator ``evict`` op (the epoch bump drives the live shrink),
+      and drop its fleet view (``mark_evicted``) so verdicts stop
+      charging the job with a buried corpse;
+    - ``grow``: unsupported — the simulator cannot spawn hosts; the
+      failure is a counted attempt, exactly what a launcher whose spawn
+      hook fails would journal;
+    - ``rollback``: record the decision in ``fleet.stats['rollback']``
+      and kill the world (in production the launcher's
+      ``--max-restarts`` loop then relaunches from the registered
+      checkpoint; the simulated run ends here, decision journaled).
+    """
+
+    def __init__(self, fleet: SimFleet):
+        self.fleet = fleet
+
+    def evict(self, ranks, reason: str) -> bool:
+        mids = []
+        for r in ranks:
+            sr = self.fleet._by_rank(int(r))
+            if sr is None:
+                continue
+            sr.alive = False  # the kill happens regardless, as the
+            mids.append(sr.mid)  # launcher's SIGKILL would
+            if self.fleet.live is not None:
+                self.fleet.live.mark_evicted(sr.rank)
+        if not mids:
+            return True
+        # the whole wave is ONE membership change (one resize), the
+        # sweep_dead contract — and a membership refusal (evicting the
+        # last member) is an honest FAILED attempt, not silent success
+        rep = self.fleet.coord._handle({"op": "evict", "mids": mids})
+        return bool(rep.get("ok", True))
+
+    def grow(self, reason: str) -> bool:
+        return False
+
+    def rollback(self, reason: str) -> bool:
+        self.fleet.stats["rollback"] = {
+            "reason": reason,
+            "t": round(self.fleet.loop.now, 6),
+        }
+        for sr in self.fleet.ranks.values():
+            sr.alive = False
+        self.fleet._finished = True
+        return True
 
 
 def reform_copies(old_owners, old_chains, new_owners, new_chains,

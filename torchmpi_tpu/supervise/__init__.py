@@ -1,0 +1,43 @@
+"""Self-healing fleet: the verdict-driven recovery supervisor.
+
+The stack's sensing half (flight recorder -> analyzer -> live
+streaming verdicts) meets its acting half here: a policy engine that
+maps each streaming verdict to a bounded remediation —
+
+    rank-dead / hang      -> evict + live shrink (ElasticCoordinator)
+    straggler             -> quarantine (evict + rejoin denylist)
+    resize-incomplete     -> evict the ranks that never entered
+    desync / resize-torn  -> checkpoint rollback (kill the world,
+                             relaunch from the last registered
+                             checkpoint_every artifact)
+    clean (persisting)    -> grow back (opt-in)
+
+with hysteresis, jittered bounded retries, and an escalation ladder.
+``launch --elastic --supervise`` runs it against the real job;
+``SimFleet.attach_supervisor`` replays the identical decisions at
+1k-10k simulated ranks, byte-identically per seed. See
+:mod:`.core` (engine), :mod:`.policy` (the declarative table), and
+:mod:`.checkpoints` (the last-good-checkpoint registry rollbacks
+restore from).
+"""
+
+from .checkpoints import (  # noqa: F401
+    describe_last,
+    last_checkpoint,
+    register_checkpoint,
+)
+from .core import Actuator, RecoverySupervisor  # noqa: F401
+from .policy import (  # noqa: F401
+    A_EVICT,
+    A_GROW,
+    A_QUARANTINE,
+    A_ROLLBACK,
+    PolicyRule,
+    default_policy,
+)
+
+__all__ = [
+    "Actuator", "RecoverySupervisor", "PolicyRule", "default_policy",
+    "register_checkpoint", "last_checkpoint", "describe_last",
+    "A_EVICT", "A_GROW", "A_QUARANTINE", "A_ROLLBACK",
+]

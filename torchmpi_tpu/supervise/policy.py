@@ -1,0 +1,99 @@
+"""The declarative recovery policy: verdict -> remediation, bounded.
+
+One :class:`PolicyRule` per streaming verdict (the
+:data:`~..telemetry.live.VERDICT_PRIORITY` names), each carrying the
+four numbers that keep an autonomous supervisor SAFE:
+
+- ``hysteresis`` — consecutive aggregation windows the verdict must
+  persist before any action fires (a single noisy window acts on
+  nobody);
+- ``max_retries`` — bounded attempts per ladder rung;
+- ``backoff_base_s`` / ``backoff_cap_s`` — jittered exponential backoff
+  between attempts (base * 2^attempt, +-50% jitter, capped);
+- ``escalate`` — the next rung when the bounded retries are exhausted
+  and the verdict still stands (evictions that did not clear the
+  verdict escalate to a checkpoint rollback).
+
+The default table (:func:`default_policy`) is built from the
+``supervisor_*`` constants so ``launch --set-constant`` deploys a
+different temperament without code:
+
+==================  =============  ==========================
+verdict             action         escalation
+==================  =============  ==========================
+desync              rollback       (terminal)
+resize-torn         rollback       (terminal)
+hang                evict-shrink   rollback
+rank-dead           evict-shrink   rollback
+resize-incomplete   evict-shrink   rollback
+straggler           quarantine     (none: advisory eviction)
+ps-overload         (observe)      (none: admission control
+                                   already sheds the load)
+clean               grow-back      (opt-in via
+                                   supervisor_grow_back)
+==================  =============  ==========================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from .. import constants
+
+# action names (the journal/metrics vocabulary)
+A_EVICT = "evict-shrink"
+A_QUARANTINE = "quarantine"
+A_ROLLBACK = "rollback"
+A_GROW = "grow-back"
+
+
+@dataclass(frozen=True)
+class PolicyRule:
+    action: str
+    hysteresis: int
+    max_retries: int
+    backoff_base_s: float
+    backoff_cap_s: float
+    escalate: Optional[str] = None
+
+
+def default_policy() -> Dict[str, PolicyRule]:
+    """The shipped table, parameterized by the ``supervisor_*`` knobs
+    (read at construction: the launcher builds the supervisor after
+    applying ``--set-constant`` overrides)."""
+    hyst = int(constants.get("supervisor_hysteresis_windows"))
+    retries = int(constants.get("supervisor_max_retries"))
+    base = float(constants.get("supervisor_backoff_base_s"))
+    cap = float(constants.get("supervisor_backoff_cap_s"))
+
+    def rule(action: str, escalate: Optional[str] = None,
+             hysteresis: Optional[int] = None) -> PolicyRule:
+        return PolicyRule(
+            action=action,
+            hysteresis=hyst if hysteresis is None else hysteresis,
+            max_retries=retries,
+            backoff_base_s=base,
+            backoff_cap_s=cap,
+            escalate=escalate,
+        )
+
+    table: Dict[str, PolicyRule] = {
+        # a cross-rank collective divergence cannot be repaired by
+        # membership surgery: the streams already disagree
+        "desync": rule(A_ROLLBACK),
+        # a torn resize means the redistribution sources are suspect
+        "resize-torn": rule(A_ROLLBACK),
+        "hang": rule(A_EVICT, escalate=A_ROLLBACK),
+        "rank-dead": rule(A_EVICT, escalate=A_ROLLBACK),
+        "resize-incomplete": rule(A_EVICT, escalate=A_ROLLBACK),
+        "straggler": rule(A_QUARANTINE),
+        # ps-overload is absent on purpose: BUSY/backoff admission
+        # control is the load-shedding mechanism; killing servers under
+        # load would amplify the storm
+    }
+    if bool(constants.get("supervisor_grow_back")):
+        # grow back only after the fleet has been CLEAN for the same
+        # hysteresis the destructive rungs require
+        table["clean"] = rule(A_GROW)
+    return table

@@ -36,7 +36,11 @@ This module makes the same evidence STREAM while the job runs:
   ``rank="r"`` plus ``tm_fleet_*`` gauges), ``/health`` (per-rank JSON:
   ages, seq high-waters/lags, step time, BUSY rate, resize epoch,
   dominant PS term), ``/verdicts`` (the streaming verdict JSON with an
-  analyzer-style summary), ``/calibration`` (the sample store).
+  analyzer-style summary), ``/calibration`` (the sample store), and —
+  with a :class:`~..supervise.RecoverySupervisor` attached
+  (``launch --supervise``) — ``/actions`` (the recovery journal,
+  quarantine denylist and ladder state) plus ``tm_supervisor_*``
+  lines on ``/metrics``.
 
 The aggregator is deterministic by construction — ``ingest``/
 ``evaluate`` are plain synchronous calls with an injectable clock — so
@@ -416,6 +420,29 @@ class FleetAggregator:
         self._closed = False
         self.ingest_port: Optional[int] = None
         self.http_port: Optional[int] = None
+        # an attached RecoverySupervisor (launch --supervise): its
+        # journal serves on /actions and its tm_supervisor_* lines ride
+        # the /metrics passthrough
+        self.supervisor = None
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Expose a :class:`~..supervise.RecoverySupervisor` on the
+        scrape surface (``/actions`` + ``tm_supervisor_*`` metrics).
+        The supervisor's observe loop stays outside: whoever owns the
+        cadence (launcher thread, simulator tick) feeds it verdicts."""
+        self.supervisor = supervisor
+
+    def mark_evicted(self, rank: int) -> None:
+        """A deliberate eviction (supervisor or operator): drop the
+        rank's view so the fleet verdicts stop charging the job with a
+        corpse it already buried — an evicted member is OUT of the job,
+        not a dead rank forever. A rejoining member re-creates the view
+        with its next frame. Clears the dead-rank marker too (the
+        watchdogs must not keep attributing 'peer dead' to a member the
+        membership already dropped)."""
+        with self._lock:
+            self.ranks.pop(rank, None)
+        self._clear_dead_marker(rank)
 
     # -- ingestion ---------------------------------------------------------
     def ingest(self, frame: dict) -> None:
@@ -792,6 +819,9 @@ class FleetAggregator:
                 f'tm_fleet_rank_report_age_seconds{{rank="{rv["rank"]}"}} '
                 f"{max(0.0, round(now - rv['last_time'], 3))}"
             )
+        sup = self.supervisor
+        if sup is not None:
+            out.extend(sup.prometheus_lines())
         # per-rank family passthrough, rank-labelled
         typed: Dict[str, str] = {}
         lines: List[str] = []
@@ -883,6 +913,18 @@ class FleetAggregator:
                         ctype = "application/json"
                     elif path == "/calibration":
                         body = agg.calibration_json().encode()
+                        ctype = "application/json"
+                    elif path == "/actions":
+                        sup = agg.supervisor
+                        if sup is None:
+                            self.send_error(
+                                404, "no supervisor attached"
+                            )
+                            return
+                        body = json.dumps(
+                            sup.actions_doc(), indent=1, sort_keys=True,
+                            default=str,
+                        ).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

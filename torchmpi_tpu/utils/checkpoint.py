@@ -98,13 +98,16 @@ def _tree_fingerprint(state: Dict[str, Any]) -> str:
     return hashlib.sha1(repr(desc).encode()).hexdigest()[:12]
 
 
-def _layout_meta(engine, step: int, extra: Optional[Dict]) -> Dict[str, Any]:
+def _layout_meta(engine, step: int, extra: Optional[Dict],
+                 state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     return {
         "step": int(step),
         "mode": engine.mode,
         "world": int(engine.comm.size),
         "sharding": engine.param_sharding,
-        "fingerprint": _tree_fingerprint(_engine_state(engine)),
+        "fingerprint": _tree_fingerprint(
+            _engine_state(engine) if state is None else state
+        ),
         **(extra or {}),
     }
 
@@ -282,7 +285,7 @@ def read_sharded_meta(path) -> Dict[str, Any]:
 
 def save_engine_sharded(
     path, engine, step: int = 0, extra: Optional[Dict] = None,
-    world: Optional[int] = None,
+    world: Optional[int] = None, state: Optional[Dict[str, Any]] = None,
 ) -> Path:
     """Save the engine's state as a portable sharded checkpoint.
 
@@ -297,6 +300,13 @@ def save_engine_sharded(
 
     Single-controller only (every leaf must be addressable); multi-host
     jobs use the orbax format and reshape offline.
+
+    ``state`` overrides the engine's live trees: an async caller (the
+    engine's ``checkpoint_every`` hook) passes the reference snapshot
+    it took on the step thread, so a save never serializes a tree the
+    next step() already half-replaced. Every published checkpoint is
+    registered as the newest rollback artifact
+    (:func:`~..supervise.checkpoints.register_checkpoint`).
     """
     from ..reshard import Layout
 
@@ -309,14 +319,15 @@ def save_engine_sharded(
     path = Path(path).resolve()
     path.mkdir(parents=True, exist_ok=True)
     world = int(world or engine.comm.size)
+    live_state = _engine_state(engine) if state is None else state
     state = jax.tree_util.tree_map(
-        lambda a: np.asarray(jax.device_get(a)), _engine_state(engine)
+        lambda a: np.asarray(jax.device_get(a)), live_state
     )
     kinds = _sharded_trees(engine)
     records = _leaf_records(state, kinds)
     meta = {
         "format": SHARDED_FORMAT,
-        **_layout_meta(engine, step, extra),
+        **_layout_meta(engine, step, extra, state=live_state),
         "world": world,
         "leaves": records,
     }
@@ -350,6 +361,11 @@ def save_engine_sharded(
     except (OSError, ValueError):
         pass
     _atomic_write_text(path / "CURRENT", data_dir.name)
+    # the artifact is published: register it as the newest rollback
+    # target (what DataLoss messages and the supervisor's rollback name)
+    from ..supervise import checkpoints as _registry
+
+    _registry.register_checkpoint(path, step)
     # GC the superseded payload (and any orphaned temp dirs from saves
     # that died before publishing) only AFTER the pointer swung
     import shutil
