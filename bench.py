@@ -945,6 +945,75 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     )
     cal_report = cal["report"]
 
+    # ---- pipelined-vs-unpipelined (the chunk-pipeline gate) ----------
+    # The depth>1 plan must beat its depth-1 twin on the large-payload
+    # set. Two legs, per the PR 9 absolute-budget discipline:
+    # (1) the stage-overlap cost model must price the chosen depth
+    #     strictly below depth 1 (deterministic — this is the depth-
+    #     selection evidence production dispatch acts on), and the
+    #     pipelined output must be BITWISE identical to the twin's;
+    # (2) measured median-of-laps: on a real accelerator the pipelined
+    #     median itself must win; on this CI box the 8 "devices" are
+    #     one sequential CPU — stage overlap cannot physically appear
+    #     in wall clock — so cpu gates an ABSOLUTE regression budget
+    #     instead (a gross regression like an accidental sync or an
+    #     O(depth^2) layout blows it; relative thresholds flaked).
+    pipe_nelem = 1 << 20  # 4 MiB f32: the bandwidth-path payload
+    pipe_wire = "int8"    # quantize/dequantize is the compute to hide
+    from torchmpi_tpu.schedule import estimate_us as plan_estimate_us
+    from torchmpi_tpu.schedule import pipeline as pipeline_mod
+    from torchmpi_tpu.schedule.generators import (
+        gen_flat, pipelined_variant,
+    )
+    from torchmpi_tpu.schedule.topology import Topology as PlanTopology
+
+    pipe_topo = PlanTopology.from_communicator(comm)
+    pipe_base = gen_flat("allreduce", pipe_nelem, 4, pipe_topo, "ring",
+                         pipe_wire)
+    depth_costs = {1: plan_estimate_us(pipe_base)}
+    for d in pipeline_mod.depth_candidates(pipe_nelem * 4):
+        depth_costs[d] = plan_estimate_us(pipelined_variant(pipe_base, d))
+    pipe_depth = min(depth_costs, key=depth_costs.get)
+    pipe_modeled_beats = (
+        pipe_depth > 1 and depth_costs[pipe_depth] < depth_costs[1]
+    )
+
+    def _pipe_laps(depth: int):
+        constants.set("plan_pipeline_depth", depth)
+        ep = schedule_mod.compile_collective(
+            "allreduce", (p, pipe_nelem), jnp.float32, comm,
+            generator="flat", impl="ring", wire_override=pipe_wire,
+        )
+        big = jax.device_put(
+            jnp.ones((p, pipe_nelem), jnp.float32), sharding
+        )
+        jax.block_until_ready(big)
+        laps, out = [], None
+        for it in range(2 + 6):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(ep.execute(big))
+            if it >= 2:
+                laps.append(time.perf_counter() - t0)
+        return float(np.median(laps)), np.asarray(out), ep.plan.plan_id
+
+    prev_pipe = constants.get("plan_pipeline_depth")
+    try:
+        unpipe_s, unpipe_out, unpipe_id = _pipe_laps(1)
+        pipe_s, pipe_out, pipe_id = _pipe_laps(max(pipe_depth, 2))
+    finally:
+        constants.set("plan_pipeline_depth", prev_pipe)
+    pipe_bitwise = bool(np.array_equal(unpipe_out, pipe_out))
+    pipe_delta_ms = (pipe_s - unpipe_s) * 1e3
+    pipe_on_accel = comm._devices[0].platform != "cpu"
+    # absolute budget for the sequential-CPU leg: the d-segment layout
+    # costs tens of ms on 32 MiB here; 250 ms catches a gross
+    # regression while staying above this box's lap noise
+    pipe_cpu_budget_ms = 250.0
+    if pipe_on_accel:
+        pipe_measured_ok = pipe_s < unpipe_s
+    else:
+        pipe_measured_ok = pipe_delta_ms < pipe_cpu_budget_ms
+
     fused_us = warm_fused_s / n_tensors * 1e6
     unfused_us = warm_unfused_s / n_tensors * 1e6
     line = {
@@ -980,6 +1049,27 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             "calibrated_err_pct": cal_report["calibrated_err_pct"],
             "path": cal.get("path"),
         },
+        "pipeline": {
+            "payload_bytes": pipe_nelem * 4,
+            "wire": pipe_wire,
+            "chosen_depth": pipe_depth,
+            "modeled_us_by_depth": {
+                str(d): round(us, 1) for d, us in sorted(depth_costs.items())
+            },
+            "modeled_beats": pipe_modeled_beats,
+            "unpipelined_plan": unpipe_id,
+            "pipelined_plan": pipe_id,
+            "unpipelined_ms": round(unpipe_s * 1e3, 3),
+            "pipelined_ms": round(pipe_s * 1e3, 3),
+            "delta_ms": round(pipe_delta_ms, 3),
+            "bitwise_identical": pipe_bitwise,
+            # on cpu the 8 virtual devices execute sequentially, so
+            # stage overlap cannot appear in wall clock: the measured
+            # leg gates an absolute regression budget there and the
+            # win claim rides the modeled (calibratable) number
+            "measured_gate": "beats" if pipe_on_accel
+            else f"abs_budget<{pipe_cpu_budget_ms}ms",
+        },
     }
     print(json.dumps(line), flush=True)
     mpi.stop()
@@ -1000,6 +1090,11 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             and cal_report["calibrated_err_pct"]
             < cal_report["modeled_err_pct"]
         )
+        # pipelined gate: the depth>1 plan must beat its twin in the
+        # stage-overlap model, reproduce it bitwise, and clear the
+        # measured leg (beats on accelerators; absolute budget on the
+        # sequential-cpu CI box)
+        pipe_ok = pipe_modeled_beats and pipe_bitwise and pipe_measured_ok
         ok = (
             fused_us <= unfused_us
             and compiles_after == 0
@@ -1007,6 +1102,7 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             and overhead_ok
             and cal_ok
             and live_frames > 0
+            and pipe_ok
         )
         if not ok:
             print(
@@ -1020,7 +1116,11 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
                 f"calibration modeled {cal_report['modeled_err_pct']}% vs "
                 f"calibrated {cal_report['calibrated_err_pct']}% "
                 f"(calibrated must be strictly smaller), "
-                f"{live_frames} live frames streamed",
+                f"{live_frames} live frames streamed, "
+                f"pipeline depth {pipe_depth}: modeled_beats="
+                f"{pipe_modeled_beats} bitwise={pipe_bitwise} "
+                f"measured delta {pipe_delta_ms:+.1f}ms "
+                f"(gate: {'beats' if pipe_on_accel else 'abs budget'})",
                 file=sys.stderr,
                 flush=True,
             )

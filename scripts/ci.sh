@@ -74,7 +74,12 @@ run_perf_smoke() {
     # samples must beat the hand-set plan_cost_* constants
     # (calibrated error strictly smaller) — the calibration table is
     # persisted to a temp cache as the CI artifact of the persistence
-    # path start() re-applies.
+    # path start() re-applies. The chunk-pipeline gate rides the same
+    # run: the depth>1 plan must beat its depth-1 twin in the
+    # stage-overlap cost model AND reproduce it bitwise, with the
+    # measured median inside an absolute regression budget (this box's
+    # virtual devices run sequentially, so the wall-clock win itself is
+    # an accelerator-only assertion).
     echo "=== perf-smoke (eager dispatch microbench + live plane, CPU) ==="
     calfile="$(mktemp -u).calibration.json"
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \

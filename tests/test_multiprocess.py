@@ -1102,3 +1102,95 @@ def test_live_plane_marks_killed_member_and_watchdog_attributes_peer_dead(
             report = json.loads(p.read_text())
     assert report is not None
     assert [b["rank"] for b in report["detail"]["peers"]] == [1]
+
+
+# ---------------------------------------------------------------------------
+# chunk-pipelined plans across processes (ISSUE 15): a pipelined run's
+# flight streams — depth-stamped plan_ids on the shared comm, per-chunk
+# sub-entries on the rank-local "chunks" stream — must diff clean.
+# ---------------------------------------------------------------------------
+
+_PIPELINED_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+    pid = int(os.environ["TORCHMPI_TPU_PROCESS_ID"])
+    import numpy as np
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import constants
+
+    mpi.start(
+        plan_pipeline_depth=4,
+        plan_pipeline_min_chunk_bytes=64,
+        small_allreduce_size_cpu=1,
+        use_hierarchical_collectives=False,
+    )
+    p = mpi.size()
+    # pipelined ring allreduces: every rank compiles the same @p4 plan
+    for i in range(4):
+        mpi.ring.allreduce_tensor(np.ones((p, 2048), np.float32))
+    # a chunked reshard: per-chunk sub-entries on the rank-local
+    # "chunks" stream (chunk COUNTS differ per rank's payload — the
+    # analyzer must not diff them)
+    from torchmpi_tpu.reshard import Layout, redistribute_arrays
+    n = 512 + pid * 256
+    src, dst = Layout(4), Layout(2)
+    shards = {{
+        r: np.arange(s, e, dtype=np.float32)
+        for r, (s, e) in enumerate(src.intervals(n))
+    }}
+    redistribute_arrays(shards, n, src, dst, chunk_bytes=128)
+    mpi.stop()
+    print(f"pipelined rank {{pid}} ok")
+    """
+).format(repo=str(_REPO))
+
+
+@pytest.mark.slow
+def test_pipelined_run_reports_desync_none(tmp_path):
+    """A 2-proc run on depth-4 pipelined plans (plus chunked reshards
+    with per-rank DIFFERENT chunk counts) must analyze to
+    `desync: none`: the @p4 plan_ids agree across ranks and the chunk
+    sub-entry stream is excluded like the rank-local handles stream."""
+    import json
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_PIPELINED_WORKER)
+    tel = tmp_path / "tel"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.launch",
+            "--nproc", "2", "--cpu-devices", "2",
+            "--telemetry-dir", str(tel), str(worker),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    analyze = subprocess.run(
+        [
+            sys.executable, "-m", "torchmpi_tpu.telemetry.analyze",
+            str(tel),
+        ],
+        cwd=str(_REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    assert analyze.returncode == 0, analyze.stdout[-2000:]
+    assert "desync: none" in analyze.stdout, analyze.stdout
+    report = json.loads((tel / "analysis.json").read_text())
+    assert report["desync"]["status"] == "none"
+    assert "chunks" not in report["desync"]["comms"]
+    # the pipelined plans actually ran and were stamped with the depth
+    dumps = [json.loads(p.read_text())
+             for p in sorted(tel.glob("telemetry_rank_*.json"))
+             if "trace" not in p.name]
+    assert len(dumps) == 2
+    for snap in dumps:
+        entries = snap["flight_recorder"]["entries"]
+        assert any("@p4" in e.get("plan", "") for e in entries), \
+            "no pipelined plan_id in the flight stream"
+        chunk_entries = [e for e in entries if e["comm"] == "chunks"]
+        assert chunk_entries and all(
+            e["routing"] == "chunk" for e in chunk_entries
+        )

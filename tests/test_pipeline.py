@@ -1,0 +1,488 @@
+"""Chunk-pipelined plan execution: depth as an IR dimension.
+
+Three contracts, each tested here:
+
+1. **Bitwise equivalence matrix** — a depth-pinned pipelined plan
+   produces BITWISE identical results to its depth-1 twin across
+   routing (flat / hier / staged / tree) x wire (full / bf16 / int8)
+   x fusion, because segments interleave at ring-chunk granularity
+   (reduction start ranks preserved) on the int8 block grid
+   (quantization scales preserved).
+2. **Depth policy** — the stage-overlap cost model prices pipelined
+   candidates per-chunk (fill + (d-1) * bottleneck, alphas not
+   divided), the candidate enumeration gates depths on the per-chunk
+   payload floor, `plan_pipeline_depth` pins, `tune_pipeline_depth`
+   persists, and --explain shows the depth candidates + timeline.
+3. **Chunk sub-entries** — host-side chunk streams (PS frames, reshard
+   transfers) run through the shared ChunkPipeline, stamping
+   `(plan_id, chunk_idx)` flight sub-entries on the rank-local
+   "chunks" stream that the desync diff, straggler spread and
+   calibration sampling all exclude.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import constants
+from torchmpi_tpu.collectives import eager
+from torchmpi_tpu.schedule import (
+    Topology,
+    candidate_plans,
+    compiler as sched,
+    depth_candidates,
+    estimate_us,
+    explain,
+    pipeline_stage_us,
+    pipeline_timeline,
+    split_spans,
+)
+from torchmpi_tpu.schedule.generators import gen_flat, pipelined_variant
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+
+
+def _payload(p, n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(p, n).astype(np.float32))
+
+
+def _engage(wire, depth):
+    constants.set("wire_quant_min_elements", 1)
+    constants.set("wire_dtype", wire)
+    constants.set("small_allreduce_size_cpu", 1)
+    constants.set("plan_pipeline_min_chunk_bytes", 64)
+    constants.set("plan_pipeline_depth", depth)
+
+
+# ---------------------------------------------------------------------------
+# 1. bitwise equivalence matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wire", ["full", "bf16", "int8"])
+@pytest.mark.parametrize("routing", ["flat", "hier", "staged", "tree"])
+def test_pipelined_bitwise_equivalence_matrix(routing, wire):
+    """depth-4 == depth-1, bitwise, for every routing x wire cell (the
+    acceptance matrix: pipelining must never change a byte)."""
+    p = mpi.size()
+    if routing == "tree":
+        if p < 4:
+            pytest.skip("needs >= 4 ranks")
+        keys = ["a"] + ["b"] * (p - 1)
+        mpi.push_communicator(lambda r: keys[r], name="pipe-r")
+        comm = mpi.current_communicator()
+    elif routing == "flat":
+        comm = mpi.current_communicator()
+        constants.set("use_hierarchical_collectives", False)
+    else:
+        if p < 4:
+            pytest.skip("needs >= 4 ranks")
+        mpi.push_communicator(lambda r: str(r % 2), name="pipe-h")
+        comm = mpi.current_communicator()
+        if routing == "staged":
+            constants.set("use_staged_collectives", True)
+    from torchmpi_tpu.sim.clock import derive_seed
+
+    x = _payload(p, seed=derive_seed("pipe", routing, wire) % 1000)
+    kw = {
+        "flat": dict(),
+        "hier": dict(impl="ring"),
+        "staged": dict(impl="staged", staged_intra="ring"),
+        "tree": dict(),
+    }[routing]
+
+    def run_at(depth):
+        _engage(wire, depth)
+        if routing == "flat":
+            return np.asarray(eager.run("allreduce", x, comm,
+                                        backend="ring"))
+        if routing == "tree":
+            return np.asarray(
+                eager.run_tree_hierarchical_allreduce(x, comm, wire=wire)
+            )
+        return np.asarray(
+            eager.run_hierarchical_allreduce(x, comm, wire=wire, **kw)
+        )
+
+    base = run_at(1)
+    piped = run_at(4)
+    np.testing.assert_array_equal(base, piped)
+    # and the depth actually engaged (distinct plan identity)
+    ep = sched.compile_collective(
+        "allreduce", tuple(x.shape), jnp.float32, comm,
+        **({"backend": "ring"} if routing == "flat" else
+           {"generator": routing if routing != "staged" else "staged",
+            "impl": "ring", "wire_override": wire}),
+    )
+    assert ep.plan.pipeline == 4 and "@p4" in ep.plan_id
+
+
+def test_pipelined_fused_flush_bitwise():
+    p = mpi.size()
+    comm = mpi.current_communicator()
+    constants.set("use_hierarchical_collectives", False)
+    rng = np.random.RandomState(7)
+    ns = (64, 640, 1344)
+    flats = [jnp.asarray(rng.randn(p, n).astype(np.float32)) for n in ns]
+    _engage("int8", 1)
+    base = np.asarray(eager.run_fused("allreduce", flats, comm,
+                                      backend="ring"))
+    _engage("int8", 4)
+    piped = np.asarray(eager.run_fused("allreduce", flats, comm,
+                                       backend="ring"))
+    np.testing.assert_array_equal(base, piped)
+
+
+def test_pipelined_primitive_odd_sizes_bitwise():
+    """Ragged element counts (chunk not divisible by depth, tail blocks)
+    keep bitwise identity — the interleave pads inside ring chunks."""
+    from torchmpi_tpu.collectives import primitives as prim
+
+    comm = mpi.current_communicator()
+    p = comm.size
+    constants.set("use_hierarchical_collectives", False)
+    constants.set("small_allreduce_size_cpu", 1)
+    constants.set("wire_quant_min_elements", 1)
+    for n in (37, 1000, 2048 + 3):
+        x = _payload(p, n, seed=n)
+        for wire in (None, "int8"):
+            constants.set("plan_pipeline_depth", 1)
+            constants.set("wire_dtype", wire or "full")
+            a = np.asarray(eager.run("allreduce", x, comm, backend="ring"))
+            constants.set("plan_pipeline_depth", 3)
+            constants.set("plan_pipeline_min_chunk_bytes", 1)
+            b = np.asarray(eager.run("allreduce", x, comm, backend="ring"))
+            np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# 2. depth policy: cost model, candidates, pinning, tuning, explain
+# ---------------------------------------------------------------------------
+
+
+def test_stage_overlap_pricing_prefers_depth_on_codec_heavy_plans():
+    """int8 wire on a single-island ring: quantize/dequantize hide under
+    wire time, so some depth > 1 must price below depth 1; full-precision
+    has nothing to hide and keeps depth 1."""
+    topo = Topology(platform="cpu", group_sizes=(8,))
+    int8 = gen_flat("allreduce", 1 << 20, 4, topo, "ring", "int8")
+    full = gen_flat("allreduce", 1 << 20, 4, topo, "ring", "full")
+    int8_costs = {d: estimate_us(pipelined_variant(int8, d))
+                  for d in (1, 2, 4, 8)}
+    full_costs = {d: estimate_us(pipelined_variant(full, d))
+                  for d in (1, 2, 4, 8)}
+    assert min(int8_costs, key=int8_costs.get) > 1
+    assert min(full_costs, key=full_costs.get) == 1
+    # stage classes: every step kind is classified, timeline rows exist
+    v = pipelined_variant(int8, 4)
+    stages = pipeline_stage_us(v)
+    assert set(stages) == {"encode", "wire", "decode"}
+    rows = pipeline_timeline(v)
+    assert len(rows) == 4 * 3
+    assert rows[0]["start_us"] == 0.0
+
+
+def test_depth_candidates_gated_by_chunk_floor():
+    assert depth_candidates(1 << 22, max_depth=8,
+                            min_chunk_bytes=1 << 18) == [2, 4, 8]
+    assert depth_candidates(1 << 19, max_depth=8,
+                            min_chunk_bytes=1 << 18) == [2]
+    assert depth_candidates(1 << 17, max_depth=8,
+                            min_chunk_bytes=1 << 18) == []
+
+
+def test_candidate_enumeration_has_depth_variants_and_floor_reasons():
+    topo = Topology(platform="tpu", group_sizes=(4, 4), cartesian=True)
+    cands = candidate_plans("allreduce", 8 << 20, 4, topo, "ring",
+                            wire="int8")
+    depths = {c.plan.pipeline for c in cands if c.feasible}
+    assert {1, 2, 4, 8} <= depths
+    # a small payload gates depths out with the floor reason
+    small = candidate_plans("allreduce", 1 << 16, 4, topo, "ring",
+                            wire="int8")
+    assert all(c.plan.pipeline == 1 for c in small if c.feasible)
+    # xla candidates never spawn variants
+    assert all(c.plan.backend != "xla" or c.plan.pipeline == 1
+               for c in cands)
+
+
+def test_pinned_depth_overrides_model_choice():
+    comm = mpi.current_communicator()
+    p = comm.size
+    _engage("full", 2)  # full wire: the model would keep depth 1
+    ep = sched.compile_collective(
+        "allreduce", (p, 4096), jnp.float32, comm, backend="ring"
+    )
+    assert ep.plan.pipeline == 2
+    # pinning depth 1 turns pipelining off outright
+    _engage("full", 1)
+    ep = sched.compile_collective(
+        "allreduce", (p, 4096), jnp.float32, comm, backend="ring"
+    )
+    assert ep.plan.pipeline == 1
+
+
+def test_measured_depth1_coverage_survives_unmeasured_twins():
+    """A calibration table that fully covers the depth-1 feasible set
+    must keep its measured authority even though unmeasured pipelined
+    twins joined the candidate list (PR 12's coverage rule, applied to
+    the depth-1 set); a twin joins the measured pool — and can win —
+    once it has samples of its own."""
+    from torchmpi_tpu.schedule import set_calibration
+    from torchmpi_tpu.telemetry.calibrate import sample_key
+
+    comm = mpi.current_communicator()
+    p = comm.size
+    nelem = 1 << 20
+    _engage("int8", 0)  # model free to choose: analytic pick is @p2
+    topo = Topology.from_communicator(comm)
+    cands, _ = None, None
+    plan, cands = sched.select_plan(
+        "allreduce", nelem, 4, topo, "ring", "int8", True, comm=comm
+    )
+    assert plan.pipeline > 1  # the analytic stage-overlap pick
+    by_depth = {c.plan.pipeline: c.plan for c in cands if c.feasible}
+    bucket = sched.payload_bucket(nelem * 4)
+
+    def calibrate(entries):
+        set_calibration({
+            sample_key("allreduce", "g", "int8", bucket, pid): {"us": us}
+            for pid, us in entries
+        })
+
+    # depth-1 fully measured, twins unmeasured: measured authority holds
+    # and the unmeasured twins cannot win on their analytic estimate
+    calibrate([(by_depth[1].plan_id, 100.0)])
+    plan, _ = sched.select_plan(
+        "allreduce", nelem, 4, topo, "ring", "int8", True, comm=comm
+    )
+    assert plan.pipeline == 1
+    # a measured twin beats the measured depth-1 incumbent
+    calibrate([(by_depth[1].plan_id, 100.0), (by_depth[2].plan_id, 50.0)])
+    plan, _ = sched.select_plan(
+        "allreduce", nelem, 4, topo, "ring", "int8", True, comm=comm
+    )
+    assert plan.pipeline == 2
+
+
+def test_plan_id_depth_marker_and_stability():
+    topo = Topology(platform="tpu", group_sizes=(8,))
+    base = gen_flat("allreduce", 1 << 20, 4, topo, "ring", "int8")
+    v4 = pipelined_variant(base, 4)
+    assert v4.plan_id != base.plan_id
+    assert "@p4" in v4.plan_id and "@p" not in base.plan_id
+    # depth-1 ids are the PRE-pipeline hashes (persisted calibration
+    # tables stay valid): replacing with depth 1 is a no-op identity
+    assert pipelined_variant(base, 1).plan_id == base.plan_id
+    assert "pipeline=4" in v4.describe()
+
+
+def test_explain_shows_depth_candidates_and_timeline():
+    topo = Topology(platform="tpu", group_sizes=(4,) * 8, cartesian=True)
+    text = explain(op="allreduce", nbytes=32 << 20, topo=topo,
+                   backend="ring", wire="int8")
+    assert "pipeline: depth" in text
+    assert "per-chunk stage timeline" in text
+    assert "depth  1" in text and "@p" in text
+
+
+def test_tune_pipeline_depth_persists_and_reloads(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "TORCHMPI_TPU_TUNING_CACHE", str(tmp_path / "autotune.json")
+    )
+    from torchmpi_tpu.utils import autotune
+
+    comm = mpi.current_communicator()
+    winner, results = autotune.tune_pipeline_depth(
+        comm, nelem=1 << 14, warmup=1, timed=1
+    )
+    assert winner >= 1
+    assert any(r[1] is not None for r in results), results
+    assert constants.get("plan_pipeline_depth") == winner
+    path = autotune.save_tuning(comm)
+    entry = json.loads(path.read_text())[f"cpu:{comm.size}"]
+    assert entry["plan_pipeline_depth"] == winner
+    constants.set("plan_pipeline_depth", 0)
+    autotune.load_tuning(comm)
+    assert constants.get("plan_pipeline_depth") == winner
+
+
+def test_sim_fleet_prices_pipelined_plans_at_scale():
+    """The simulated fleet's plan pick runs the REAL candidate
+    generation + stage-overlap pricing, so depth selection is testable
+    at fleet scale: a 256-rank single-island fleet with an int8 wire
+    picks a pipelined plan (codec hides under wire time), while a
+    1024-rank multi-island flat ring correctly keeps depth 1 (per-hop
+    chunks are tiny — alpha-dominated, overlap cannot out-earn the
+    extra launches) even though the pipelined candidates WERE priced."""
+    from torchmpi_tpu.schedule import candidate_plans as cand_fn
+    from torchmpi_tpu.sim.fleet import SimFleet
+
+    fleet = SimFleet(256, seed=3, group_size=256, steps=1,
+                     payload_elems=32 << 20, wire="int8")
+    plan_id, coll_s = fleet._plan(256)
+    assert "@p" in plan_id, plan_id
+    assert coll_s > 0
+    # depth-1 twin prices higher (the sim would never pick it)
+    prev = constants.get("plan_pipeline_depth")
+    constants.set("plan_pipeline_depth", 1)
+    try:
+        fleet_d1 = SimFleet(256, seed=3, group_size=256, steps=1,
+                            payload_elems=32 << 20, wire="int8")
+        plan_d1, coll_d1 = fleet_d1._plan(256)
+        assert "@p" not in plan_d1
+        assert coll_s < coll_d1
+    finally:
+        constants.set("plan_pipeline_depth", prev)
+    # 1k multi-island: pipelined candidates priced, depth 1 wins
+    big = SimFleet(1024, seed=3, group_size=8, steps=1,
+                   payload_elems=8 << 20, wire="int8")
+    plan_big, _ = big._plan(1024)
+    assert "@p" not in plan_big
+    topo = Topology(platform="cpu", group_sizes=(8,) * 128,
+                    cartesian=True, nodes=128, name="sim")
+    cands = cand_fn("allreduce", 8 << 20, 4, topo, backend="ring",
+                    wire="int8", route_small=False)
+    piped = [c for c in cands if c.plan.pipeline > 1 and c.feasible]
+    assert piped and all(c.cost_us is not None for c in piped)
+
+
+# ---------------------------------------------------------------------------
+# 3. chunk sub-entries: shared primitive + exclusions
+# ---------------------------------------------------------------------------
+
+
+def test_split_spans_block_alignment_and_edges():
+    assert list(split_spans(10, 0)) == [(0, 10)]
+    assert list(split_spans(0, 4)) == []
+    assert list(split_spans(10, 4)) == [(0, 4), (4, 4), (8, 2)]
+    # block alignment: boundaries stay on the grid, never exceed chunk
+    spans = list(split_spans(1000, 300, align=128))
+    assert all(off % 128 == 0 for off, _ in spans)
+    assert sum(n for _, n in spans) == 1000
+    assert max(n for _, n in spans) <= 300
+    # a payload just over an UNALIGNED chunk budget still splits on the
+    # grid (alignment applies before the single-span shortcut): one
+    # over-budget chunk would defeat the chunk-size bound the PS knob
+    # exists to enforce
+    assert list(split_spans(33, 33, align=8)) == [(0, 32), (32, 1)]
+
+
+def test_ps_plan_chunks_delegates_to_shared_rule():
+    from torchmpi_tpu.parameterserver import wire as psw
+
+    chunks = psw.plan_chunks(100000, psw.WIRE_INT8, 128, 1 << 16)
+    assert all(off % 128 == 0 for off, _ in chunks)
+    assert sum(n for _, n in chunks) == 100000
+    assert psw.plan_chunks(0, psw.WIRE_INT8, 128, 1 << 16) == [(0, 0)]
+    assert psw.plan_chunks(64, psw.WIRE_FULL, 128, 0) == [(0, 64)]
+
+
+def test_reshard_chunks_stamp_flight_sub_entries():
+    from torchmpi_tpu.reshard import Layout, redistribute_arrays
+    from torchmpi_tpu.telemetry import flightrecorder as flight
+
+    n = 1024
+    src, dst = Layout(4), Layout(2)
+    shards = {
+        r: np.arange(s, e, dtype=np.float32)
+        for r, (s, e) in enumerate(src.intervals(n))
+    }
+    flight.enable()
+    try:
+        flight.recorder.reset()
+        out, rd = redistribute_arrays(shards, n, src, dst,
+                                      chunk_bytes=256)
+        entries = [e for e in flight.recorder.entries()
+                   if e["comm"] == "chunks"]
+        assert entries, "no chunk sub-entries recorded"
+        assert all(e["routing"] == "chunk" for e in entries)
+        assert all(e["status"] == "completed" for e in entries)
+        # stamped (plan_id, chunk_idx)
+        assert all("#" in e["plan"] for e in entries)
+        assert entries[0]["plan"].startswith(rd.plan.plan_id)
+        idxs = [int(e["plan"].rpartition("#")[2]) for e in entries]
+        assert idxs == list(range(len(entries)))
+    finally:
+        flight.disable()
+    # the bounded-memory contract is untouched
+    assert 0 < rd.peak_scratch_bytes <= 256
+    np.testing.assert_array_equal(
+        np.concatenate([out[r] for r in sorted(out)]),
+        np.arange(n, dtype=np.float32),
+    )
+
+
+def _chunk_entry(rank):
+    return {
+        "seq": 0, "comm": "chunks", "op": "reshard", "payload": "256B",
+        "wire": "", "backend": "", "routing": "chunk",
+        "plan": f"reshard-host-full:abcd{rank}#0",
+        "t_issue": 1000.0 + rank * 5, "t_complete": 1000.1 + rank * 5,
+        "status": "completed",
+    }
+
+
+def test_chunk_stream_excluded_from_desync_and_stragglers():
+    """Two ranks with wildly different chunk streams must still diff
+    clean: the 'chunks' comm is rank-local, like 'handles'."""
+    from torchmpi_tpu.telemetry.analyze import detect_desync, rank_stragglers
+
+    def entries_for(rank):
+        shared = {
+            "seq": 0, "comm": "g[2]", "op": "allreduce",
+            "payload": "(2, 8):float32", "wire": "full",
+            "backend": "ring", "routing": "flat",
+            "plan": "flat-ring-full@p4:aaaa1111",
+            "t_issue": 1000.0, "t_complete": 1000.5,
+            "status": "completed",
+        }
+        # rank 1 emits extra chunk sub-entries at skewed times
+        chunks = [_chunk_entry(rank)] * (1 + rank * 3)
+        return [shared] + chunks
+
+    ranks = {
+        r: {"snapshot": {"flight_recorder": {
+            "dropped": 0, "seq_high_water": {"g[2]": 0, "chunks": 3},
+            "entries": entries_for(r),
+        }}}
+        for r in (0, 1)
+    }
+    report = detect_desync(ranks)
+    assert report["status"] == "none"
+    assert "chunks" not in report["comms"]
+    stragglers = rank_stragglers(ranks)
+    # only the shared collective stream is timed
+    assert stragglers["samples"] == 1
+
+
+def test_chunk_entries_excluded_from_calibration_sampling():
+    """A chunk sub-entry must never become a calibration sample (it
+    would land in the chunk-size bucket and bias the medians); the
+    parent pipelined dispatch samples at the LOGICAL payload with its
+    depth in the plan_id."""
+    from torchmpi_tpu.telemetry.calibrate import SampleStore, split_key
+
+    store = SampleStore()
+    assert not store.add_entry(_chunk_entry(0))
+    parent = {
+        "seq": 4, "comm": "global[8]", "op": "allreduce",
+        "payload": "(8, 1048576):float32", "wire": "int8",
+        "backend": "ring", "routing": "flat",
+        "plan": "flat-ring-int8@p4:deadbeef",
+        "t_issue": 1000.0, "t_complete": 1000.01, "status": "completed",
+    }
+    assert store.add_entry(parent)
+    (key,) = store.samples
+    parts = split_key(key)
+    # logical payload bucket (4 MiB), depth rides the plan_id
+    assert parts["bucket"] == (1048576 * 4).bit_length()
+    assert parts["plan_id"].endswith("@p4:deadbeef")

@@ -403,15 +403,21 @@ def _kernels(op: str, backend: str, root: int, extra: Tuple,
     ``collectives.cpp:58-64``'s 4MB switch) plus the pipelined chunk count;
     ``tuning`` carries (min_bytes, max_bytes, num_buffers) for byte-bounded
     ring chunking; ``wire`` the resolved wire format for the bandwidth-path
-    reductions."""
+    reductions. A ``('pipeline', d)`` marker in ``extra`` (the schedule
+    compiler's plan depth, already part of the executable cache key via
+    ``static``) threads the chunk-pipeline depth into the ppermute ring."""
     minb, maxb, nbuf = tuning if tuning else (None, None, 1)
     wire_arg = wire if wire != "full" else None
+    pipe = next(
+        (e[1] for e in extra if isinstance(e, tuple) and e[0] == "pipeline"),
+        1,
+    )
 
     def _ring_allreduce(b):
         return prim.ring_allreduce(
             b, _AXIS,
             max_bytes_per_step=maxb, min_bytes_per_step=minb,
-            num_buffers=nbuf, wire_dtype=wire_arg,
+            num_buffers=nbuf, wire_dtype=wire_arg, pipeline_depth=pipe,
         )
 
     def _ring_reduce(b):

@@ -233,34 +233,80 @@ def _ring_phases(chunks, axis: str, p: int, r, perm, nb: int):
 
 
 def _ring_phases_wire(chunks, axis: str, p: int, r, perm, wire: str,
-                      block: int):
+                      block: int, nb: int = 1):
     """Reduce-scatter + all-gather ring phases with a compressed wire
     format: every hop encodes its outgoing chunk (int8 + per-block f32
     scales, or a bf16 cast), the RS phase accumulates the DECODED values
     into the f32 partials, and the AG phase forwards reduced chunks the
     same way — re-encoding a just-decoded chunk reproduces the same code
     points, so AG forwarding is lossless up to fp rounding. ``chunks``:
-    [p, chunk] f32; same fori_loop step structure as :func:`_ring_phases`
-    so the two schedules can be compared line for line."""
+    [nb, p, chunk] f32 — ``nb`` independent pipeline segments whose
+    encode / ppermute / decode chains are issued per step like
+    :func:`_ring_phases`'s buffers, so XLA's scheduler can overlap
+    quantize(k+1) with the DMA of chunk k; same fori_loop step structure
+    as :func:`_ring_phases` so the two schedules can be compared line
+    for line."""
 
     def rs_step(s, ch):
         send_idx = (r - s) % p
         recv_idx = (r - s - 1) % p
-        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
-        recv = _wire_send_recv(buf, axis, perm, wire, block)
-        upd = lax.dynamic_index_in_dim(ch, recv_idx, keepdims=False) + recv
-        return lax.dynamic_update_index_in_dim(ch, upd, recv_idx, 0)
+        outs = []
+        for j in range(nb):
+            buf = lax.dynamic_index_in_dim(ch[j], send_idx, keepdims=False)
+            recv = _wire_send_recv(buf, axis, perm, wire, block)
+            upd = lax.dynamic_index_in_dim(ch[j], recv_idx,
+                                           keepdims=False) + recv
+            outs.append(
+                lax.dynamic_update_index_in_dim(ch[j], upd, recv_idx, 0)
+            )
+        return jnp.stack(outs)
 
     chunks = lax.fori_loop(0, p - 1, rs_step, chunks)
 
     def ag_step(s, ch):
         send_idx = (r + 1 - s) % p
         recv_idx = (r - s) % p
-        buf = lax.dynamic_index_in_dim(ch, send_idx, keepdims=False)
-        recv = _wire_send_recv(buf, axis, perm, wire, block)
-        return lax.dynamic_update_index_in_dim(ch, recv, recv_idx, 0)
+        outs = []
+        for j in range(nb):
+            buf = lax.dynamic_index_in_dim(ch[j], send_idx, keepdims=False)
+            recv = _wire_send_recv(buf, axis, perm, wire, block)
+            outs.append(
+                lax.dynamic_update_index_in_dim(ch[j], recv, recv_idx, 0)
+            )
+        return jnp.stack(outs)
 
     return lax.fori_loop(0, p - 1, ag_step, chunks)
+
+
+def _pipeline_segments(flat, p: int, chunk: int, depth: int,
+                       align: int = 1):
+    """Reshape a ring-padded flat buffer ``[p * chunk]`` into ``depth``
+    interleaved pipeline segments ``[d, p, sub]`` — segment j holds
+    sub-span j of EVERY ring chunk, so an element keeps its ring-chunk
+    index (= its reduction start rank) and the per-element accumulation
+    order is bit-identical to the unpipelined ring. ``align`` (the int8
+    quantization block) keeps every sub-span boundary on the block grid,
+    so chunked quantization reproduces the unchunked scales exactly.
+    Returns ``(segments, d, sub)`` with ``d`` clamped to the spans that
+    actually exist."""
+    sub = -(-chunk // max(1, depth))
+    if align > 1:
+        sub = -(-sub // align) * align
+    sub = max(1, sub)
+    d = max(1, -(-chunk // sub))
+    a = flat.reshape(p, chunk)
+    pad = d * sub - chunk
+    if pad:
+        a = jnp.concatenate([a, jnp.zeros((p, pad), a.dtype)], axis=1)
+    return jnp.transpose(a.reshape(p, d, sub), (1, 0, 2)), d, sub
+
+
+def _pipeline_unsegment(segs, p: int, chunk: int):
+    """Inverse of :func:`_pipeline_segments`: ``[d, p, sub]`` back to the
+    flat ``[p * chunk]`` ring layout (intra-chunk padding dropped)."""
+    d, _, sub = segs.shape
+    a = jnp.transpose(segs, (1, 0, 2)).reshape(p, d * sub)
+    return a[:, :chunk].reshape(-1)
 
 
 def ring_allreduce(
@@ -272,6 +318,7 @@ def ring_allreduce(
     num_buffers: int = 1,
     wire_dtype: Optional[str] = None,
     wire_block: Optional[int] = None,
+    pipeline_depth: int = 1,
 ):
     """Chunked ring allreduce: (p-1) reduce-scatter steps then (p-1)
     all-gather steps, the schedule memoized by the reference as a "plan"
@@ -298,6 +345,19 @@ def ring_allreduce(
     quantized path keeps f32 accumulation and takes the unsegmented
     route (one chunk per ring step — the encode/decode already bounds
     the per-step wire bytes).
+
+    ``pipeline_depth`` > 1 is the schedule IR's chunk pipeline: the
+    payload is split into that many INTERLEAVED segments (sub-span j of
+    every ring chunk — block-aligned under a compressed wire), and every
+    ring step issues the segments' independent encode / ppermute /
+    decode-accumulate chains so quantize(k+1) can overlap send(k) and
+    dequantize/reduce(k-1) under recv(k). The interleaving keeps each
+    element's ring-chunk index — and therefore its floating-point
+    accumulation order and its quantization block grid — identical to
+    depth 1: the pipelined result is BITWISE equal to its unpipelined
+    twin (tests/test_pipeline.py pins the matrix). On the byte-bounded
+    segmented path (``max_bytes_per_step`` exceeded) the depth is
+    ignored — ``num_buffers`` already pipelines the waves there.
     """
     p = axis_size or lax.axis_size(axis)
     if p == 1:
@@ -313,13 +373,32 @@ def ring_allreduce(
 
         block = wire_block or constants.get("wire_quant_block_size")
         flat, n, chunk = _flatten_pad(x, p)
+        if pipeline_depth > 1:
+            segs, d, _sub = _pipeline_segments(
+                flat, p, chunk, pipeline_depth, align=block
+            )
+            if d > 1:
+                out = _ring_phases_wire(
+                    segs, axis, p, r, perm, wire_dtype, block, nb=d
+                )
+                return _pipeline_unsegment(out, p, chunk)[:n].reshape(
+                    x.shape
+                )
         out = _ring_phases_wire(
-            flat.reshape(p, chunk), axis, p, r, perm, wire_dtype, block
+            flat.reshape(1, p, chunk), axis, p, r, perm, wire_dtype, block
         )
-        return out.reshape(-1)[:n].reshape(x.shape)
+        return _pipeline_unsegment(out, p, chunk)[:n].reshape(x.shape)
 
     if max_bytes_per_step is None or chunk * itemsize <= max_bytes_per_step:
         flat, n, chunk = _flatten_pad(x, p)
+        if pipeline_depth > 1:
+            segs, d, _sub = _pipeline_segments(flat, p, chunk,
+                                               pipeline_depth)
+            if d > 1:
+                out = _ring_phases(segs, axis, p, r, perm, d)
+                return _pipeline_unsegment(out, p, chunk)[:n].reshape(
+                    x.shape
+                )
         chunks = _ring_phases(flat.reshape(1, p, chunk), axis, p, r, perm, 1)
         return chunks.reshape(-1)[:n].reshape(x.shape)
 

@@ -234,6 +234,22 @@ class _Constants:
     plan_cost_quantize_us_per_mib: float = 8.0
     plan_cost_dispatch_us: float = 5.0
 
+    # --- chunk-pipelined plan execution (schedule IR pipeline depth) ---
+    # Pipeline depth policy for the ppermute-ring plan families: 0 lets
+    # the (calibrated) cost model choose the depth per request among
+    # power-of-two candidates; 1 pins pipelining OFF; >1 pins that depth
+    # for every eligible plan. tune_pipeline_depth measures the depths
+    # on the live communicator and persists the winner here (re-applied
+    # by start(), like every tuned knob).
+    plan_pipeline_depth: int = 0
+    # Largest depth the compiler's candidate enumeration considers
+    # (depths are 2, 4, ... up to this cap).
+    plan_pipeline_max_depth: int = 8
+    # Per-chunk LOGICAL payload floor (bytes): a depth whose chunks
+    # would fall below this is not a candidate — small chunks are
+    # alpha-dominated and the per-hop launch overhead eats the overlap.
+    plan_pipeline_min_chunk_bytes: int = 1 << 18
+
     # --- live elastic resharding (reshard/ subsystem) ---
     # Chunk size (BYTES) for redistribution transfers: the reshard
     # executor moves state between (world size, sharding) layouts

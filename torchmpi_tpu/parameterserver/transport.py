@@ -47,6 +47,7 @@ import numpy as np
 
 from .. import constants, telemetry as _telemetry
 from ..analysis import lockmon as _lockmon
+from ..schedule import pipeline as _sched_pipeline
 from ..telemetry import flightrecorder as _flight
 from . import wire as _wire
 
@@ -1760,12 +1761,25 @@ class _PeerChannel:
                 _try_send(w.frame)
             else:
                 # pipelined chunk stream: encode chunk k+1 while the
-                # kernel drains chunk k; the header rides with chunk 0
+                # kernel drains chunk k; the header rides with chunk 0.
+                # Driven by the schedule IR's shared ChunkPipeline so
+                # every chunk gets a (frame-id, chunk_idx) flight
+                # sub-entry on the rank-local "chunks" stream.
                 pending_bufs = list(w.frame)
-                for bufs in chunk_iter:
+
+                def send_stage(idx: int, bufs) -> None:
+                    nonlocal pending_bufs
                     w.frame.extend(bufs)
                     _try_send(pending_bufs + bufs)
                     pending_bufs = []
+
+                _sched_pipeline.ChunkPipeline(
+                    f"ps:{self.proc}:{seq}",
+                    _KIND_NAMES.get(kind, str(kind)),
+                    nbytes_of=lambda bufs: sum(
+                        len(memoryview(b).cast("B")) for b in bufs
+                    ),
+                ).run(chunk_iter, send_stage)
         return w
 
     def complete(self, w: _Waiter):

@@ -199,18 +199,24 @@ def plan_chunks(n: int, wire: int, block: int, chunk_bytes: int,
     """Split an ``n``-element payload into [(offset, nelem)] chunks whose
     encoded size approximates ``chunk_bytes`` (block-aligned for int8 so
     every chunk quantizes on its own grid). ``chunk_bytes <= 0`` or a
-    payload that fits one chunk yields a single chunk."""
+    payload that fits one chunk yields a single chunk.
+
+    The encoded-size policy (how many elements fit ``chunk_bytes``)
+    lives here; the span math is the schedule IR's shared chunk rule
+    (:func:`~..schedule.pipeline.split_spans`), so the PS wire, the
+    reshard executor and the pipelined plan families cut payloads
+    identically."""
+    from ..schedule.pipeline import split_spans
+
     if n <= 0:
-        return [(0, 0)]
+        return [(0, 0)]  # the empty-shard frame still carries one header
     if chunk_bytes <= 0:
         return [(0, n)]
     per_elem = max(1, enc_nbytes(block, wire, block, itemsize) // block)
     elems = max(1, chunk_bytes // per_elem)
-    if wire == WIRE_INT8:
-        elems = max(block, (elems // block) * block)
-    if elems >= n:
-        return [(0, n)]
-    return [(off, min(elems, n - off)) for off in range(0, n, elems)]
+    return list(split_spans(
+        n, elems, align=block if wire == WIRE_INT8 else 1
+    ))
 
 
 def container_nbytes(n: int, wire: int, block: int, chunk_bytes: int,

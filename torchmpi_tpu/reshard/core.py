@@ -40,6 +40,7 @@ import numpy as np
 
 from .. import constants
 from ..schedule import cost as _cost
+from ..schedule import pipeline as _sched_pipeline
 from ..schedule.ir import Plan, Step
 
 
@@ -161,15 +162,15 @@ def wire_elements(transfers: List[Transfer]) -> int:
 
 
 def chunk_spans(n: int, chunk: int) -> Iterator[Tuple[int, int]]:
-    """Cut ``[0, n)`` into spans of at most ``chunk`` elements. The one
-    chunking rule everywhere reshard bytes move — the elastic exchange,
-    the checkpoint reshaper and the PS re-formation copy all bound their
-    peak memory with it."""
-    if n <= 0:
-        return
-    chunk = max(1, int(chunk))
-    for off in range(0, n, chunk):
-        yield off, min(off + chunk, n)
+    """Cut ``[0, n)`` into ``(start, end)`` spans of at most ``chunk``
+    elements. The one chunking rule everywhere reshard bytes move — the
+    elastic exchange, the checkpoint reshaper and the PS re-formation
+    copy all bound their peak memory with it. The span math is the
+    schedule IR's shared chunk-pipeline rule
+    (:func:`~..schedule.pipeline.split_spans`), so reshard, the PS wire
+    codec and the pipelined plan families cut payloads identically."""
+    for off, ln in _sched_pipeline.split_spans(n, max(1, int(chunk))):
+        yield off, off + ln
 
 
 def chunk_transfers(
@@ -344,13 +345,26 @@ class Redistributor:
     ) -> None:
         """Run every (chunked) transfer; ``ranks`` restricts execution to
         transfers whose source AND target live in the given rank set (the
-        in-process case passes None = all)."""
-        for t in chunk_transfers(self.transfers, self.chunk_elems):
-            if ranks is not None and (t.src not in ranks or t.dst not in ranks):
-                continue
+        in-process case passes None = all). Execution flows through the
+        shared :class:`~..schedule.pipeline.ChunkPipeline` driver — the
+        read/write stages reuse one scratch buffer (the bounded-memory
+        contract) and every chunk's flight sub-entry is stamped
+        ``(plan_id, chunk_idx)`` on the rank-local ``chunks`` stream."""
+        pieces = (
+            t for t in chunk_transfers(self.transfers, self.chunk_elems)
+            if ranks is None or (t.src in ranks and t.dst in ranks)
+        )
+        itemsize = self.dtype.itemsize
+
+        def stage(idx: int, t: Transfer) -> None:
             buf = self._scratch_for(t.n)
             read(t.src, t.src_off, buf)
             write(t.dst, t.dst_off, buf)
+
+        _sched_pipeline.ChunkPipeline(
+            self.plan.plan_id, self.plan.op,
+            nbytes_of=lambda t: t.n * itemsize,
+        ).run(pieces, stage)
 
 
 def redistribute_arrays(

@@ -45,21 +45,31 @@ from .compiler import (  # noqa: F401
     set_plan_override,
 )
 from .cost import (  # noqa: F401
+    PIPELINE_STAGES,
     calibrated_plan_us,
     calibration_epoch,
     clear_calibration,
     cost_breakdown,
     estimate_us,
+    pipeline_stage_us,
+    pipeline_timeline,
     set_calibration,
 )
 from .generators import (  # noqa: F401
     GENERATORS,
     HIER_OPS,
+    PIPELINE_OPS,
     TREE_OPS,
     Candidate,
     candidate_plans,
+    pipelined_variant,
 )
 from .ir import STEP_KINDS, Plan, Step  # noqa: F401
+from .pipeline import (  # noqa: F401
+    ChunkPipeline,
+    depth_candidates,
+    split_spans,
+)
 from .topology import Topology  # noqa: F401
 
 
@@ -115,6 +125,9 @@ __all__ = [
     "Plan", "Step", "STEP_KINDS", "Topology",
     "compile_collective", "compile_fused", "explain",
     "candidate_plans", "Candidate", "GENERATORS", "HIER_OPS", "TREE_OPS",
+    "PIPELINE_OPS", "PIPELINE_STAGES", "pipelined_variant",
+    "pipeline_stage_us", "pipeline_timeline",
+    "ChunkPipeline", "depth_candidates", "split_spans",
     "estimate_us", "cost_breakdown",
     "set_plan_override", "apply_plan_overrides", "plan_overrides",
     "clear_plan_overrides", "override_key", "payload_bucket",

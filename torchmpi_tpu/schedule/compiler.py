@@ -188,6 +188,31 @@ def _nelem(shape: Tuple[int, ...]) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _apply_pinned_depth(chosen, feasible):
+    """A pinned ``plan_pipeline_depth`` (tune_pipeline_depth's persisted
+    winner, or an operator force) overrides the model's DEPTH choice
+    within the chosen family — the family choice itself stays with the
+    override/cost logic. One helper for ``select_plan`` AND ``explain``
+    so dispatch and its introspection can never drift; the swap matches
+    the whole plan family (generator + backend + op), never just the
+    generator name."""
+    if chosen is None:
+        return chosen
+    pinned_d = int(constants.get("plan_pipeline_depth"))
+    if pinned_d > 1 and chosen.plan.pipeline != pinned_d:
+        alt = next(
+            (c for c in feasible
+             if c.plan.generator == chosen.plan.generator
+             and c.plan.backend == chosen.plan.backend
+             and c.plan.op == chosen.plan.op
+             and c.plan.pipeline == pinned_d),
+            None,
+        )
+        if alt is not None:
+            return alt
+    return chosen
+
+
 def _plan_cache(comm):
     cache = getattr(comm, "_plan_cache", None)
     if cache is None:
@@ -244,23 +269,40 @@ def select_plan(
         )
     if chosen is None and feasible:
         # measured (calibrated) costs re-order candidates only when the
-        # WHOLE feasible set was timed: wall-clock microseconds and
-        # idealized analytic estimates are incommensurable scales, and
-        # mixing them in one min() flips selection on measurement
+        # WHOLE feasible depth-1 set was timed: wall-clock microseconds
+        # and idealized analytic estimates are incommensurable scales,
+        # and mixing them in one min() flips selection on measurement
         # coverage, not merit (the timed incumbent looks expensive next
-        # to an untimed candidate's optimistic estimate). A partially-
-        # measured set keeps the analytic ordering; tune_plan overrides
-        # (checked above) remain the measured-search authority.
+        # to an untimed candidate's optimistic estimate). Pipelined
+        # twins join the measured pool only once they have samples of
+        # their own (a depth variant executes — and so gets timed —
+        # after the analytic model or a pinned depth first picks it);
+        # an unmeasured twin must neither win on an optimistic analytic
+        # estimate against measured rivals NOR invalidate a calibration
+        # table that fully covered the depth-1 set (depth-1 plan_ids
+        # are hash-stable across this feature for exactly that reason).
+        # A partially-measured depth-1 set keeps the analytic ordering;
+        # tune_plan overrides (checked above) remain the
+        # measured-search authority.
         measured = {
             c.plan.plan_id: _cost.calibrated_plan_us(
                 op, bucket, wire, c.plan.plan_id
             )
             for c in feasible
         }
-        if all(v is not None for v in measured.values()):
-            chosen = min(feasible, key=lambda c: measured[c.plan.plan_id])
+        base_covered = all(
+            measured[c.plan.plan_id] is not None
+            for c in feasible if c.plan.pipeline == 1
+        )
+        if base_covered:
+            pool = [
+                c for c in feasible
+                if measured[c.plan.plan_id] is not None
+            ]
+            chosen = min(pool, key=lambda c: measured[c.plan.plan_id])
         else:
             chosen = min(feasible, key=lambda c: c.cost_us or float("inf"))
+    chosen = _apply_pinned_depth(chosen, feasible)
     if chosen is None:
         # defensive: the gate algebra always leaves one feasible flat
         # candidate, but a plan must exist even if it ever does not
@@ -282,7 +324,9 @@ def pinned_plan(generator: str, op: str, nelem: int, itemsize: int,
     """Build the plan a generator-pinning wrapper demanded, bypassing
     the policy gates (a direct ``run_hierarchical_*`` call runs its
     composition exactly like the legacy entry point did) but never
-    structural impossibility."""
+    structural impossibility. A pinned ``plan_pipeline_depth`` still
+    applies — a pinned FAMILY earns the tuned pipeline like the policy
+    path does."""
     eager = _eager()
     if generator == "hier":
         if not (topo.two_level and topo.cartesian):
@@ -290,22 +334,24 @@ def pinned_plan(generator: str, op: str, nelem: int, itemsize: int,
                 "hierarchical collectives need a cartesian communicator "
                 "with multiple intra groups of size > 1"
             )
-        return _generators.gen_hier(op, nelem, itemsize, topo, impl, wire)
-    if generator == "staged":
+        plan = _generators.gen_hier(op, nelem, itemsize, topo, impl, wire)
+    elif generator == "staged":
         if not (topo.two_level and topo.cartesian):
             raise eager.CollectiveArgumentError(
                 "staged hierarchical allreduce needs a cartesian "
                 "communicator with multiple intra groups of size > 1"
             )
-        return _generators.gen_staged(op, nelem, itemsize, topo, impl, wire)
-    if generator == "tree":
+        plan = _generators.gen_staged(op, nelem, itemsize, topo, impl, wire)
+    elif generator == "tree":
         if not topo.two_level:
             raise eager.CollectiveArgumentError(
                 "hierarchical allreduce needs a communicator with both "
                 "levels"
             )
-        return _generators.gen_tree(op, nelem, itemsize, topo, impl, wire)
-    return _generators.gen_flat(op, nelem, itemsize, topo, impl, wire)
+        plan = _generators.gen_tree(op, nelem, itemsize, topo, impl, wire)
+    else:
+        plan = _generators.gen_flat(op, nelem, itemsize, topo, impl, wire)
+    return _generators.maybe_pin_depth(plan, nelem, itemsize)
 
 
 # ---------------------------------------------------------------------------
@@ -431,7 +477,8 @@ def _bind(plan: Plan, comm, shape: Tuple[int, ...], dtype, wire: str,
     nelem = _nelem(shape)
     if plan.generator == "flat":
         fn, hit = lower.lower_flat(
-            comm, op, plan.backend, shape, dtype, wire, root, src, dst
+            comm, op, plan.backend, shape, dtype, wire, root, src, dst,
+            pipeline=plan.pipeline,
         )
         records = plan.backend in ("ring", "pallas") and op in \
             eager._WIRE_OPS
@@ -447,7 +494,8 @@ def _bind(plan: Plan, comm, shape: Tuple[int, ...], dtype, wire: str,
         # jit two conflicting device orders and it rejects the mix
         if op == "allreduce":
             fn, hit = lower.lower_hier_allreduce(comm, impl, shape, dtype,
-                                                 wire)
+                                                 wire,
+                                                 pipeline=plan.pipeline)
             return ExecutablePlan(
                 plan, fn, comm, "hier_allreduce", impl, wire, nelem,
                 dtype, "hier", hit, impl in ("ring", "pallas"),
@@ -460,9 +508,11 @@ def _bind(plan: Plan, comm, shape: Tuple[int, ...], dtype, wire: str,
             "hier", hit, False, place_input=False,
         )
     if plan.generator == "staged":
+        depth = plan.pipeline
+
         def fn(a):
             return lower.run_staged_hierarchical_allreduce(
-                a, comm, impl, wire
+                a, comm, impl, wire, pipeline=depth
             )
 
         return ExecutablePlan(
@@ -471,7 +521,8 @@ def _bind(plan: Plan, comm, shape: Tuple[int, ...], dtype, wire: str,
         )
     # tree
     if op == "allreduce":
-        fn, hit = lower.lower_tree_allreduce(comm, shape, dtype, wire)
+        fn, hit = lower.lower_tree_allreduce(comm, shape, dtype, wire,
+                                             pipeline=plan.pipeline)
         return ExecutablePlan(
             plan, fn, comm, "tree_hier_allreduce", "ring", wire, nelem,
             dtype, "tree", hit, True, place_input=False,
@@ -601,7 +652,8 @@ def compile_fused(
 
     if plan.generator == "flat":
         fn, hit = lower.lower_fused_flat(comm, op, plan.backend, tuple(ns),
-                                         dtype, wire)
+                                         dtype, wire,
+                                         pipeline=plan.pipeline)
         ep = FusedExecutablePlan(
             plan, fn, comm, plan.backend, wire, tuple(ns), total, dtype,
             hit, plan.backend in ("ring", "pallas"),
@@ -694,6 +746,9 @@ def explain(
     how = "autotuned (tune_plan)" if chosen is not None else "cost model"
     if chosen is None and feasible:
         chosen = min(feasible, key=lambda c: c.cost_us or float("inf"))
+    # the same pinned-depth rule select_plan applies, so explain shows
+    # the decision production dispatch would make
+    chosen = _apply_pinned_depth(chosen, feasible)
     lines = [
         f"request: {op} {_generators_fmt_bytes(nbytes)} {dtype} "
         f"backend={backend} wire={resolved_wire}",
@@ -720,6 +775,8 @@ def explain(
                     f"{k}={v:.1f}us" for k, v in sorted(bd.items())
                 )
             )
+        lines.extend(_explain_pipeline(chosen, cands, op, bucket,
+                                       resolved_wire))
     lines.append("")
     lines.append("candidates:")
     order = sorted(
@@ -737,6 +794,56 @@ def explain(
             f"  {mark} {c.plan.plan_id:<32} {est}{reason}"
         )
     return "\n".join(lines)
+
+
+def _explain_pipeline(chosen, cands, op: str, bucket: int,
+                      wire: str) -> List[str]:
+    """The pipeline-depth panel of ``explain``: the chosen depth, the
+    per-chunk stage timeline, and every rejected depth candidate of the
+    chosen family with its modeled (or measured, when calibrated) cost —
+    the why-this-depth evidence operators asked for."""
+    family = [
+        c for c in cands
+        if c.plan.generator == chosen.plan.generator
+        and c.plan.backend == chosen.plan.backend
+        and c.plan.op == chosen.plan.op
+    ]
+    if all(c.plan.pipeline == 1 for c in family):
+        return []
+    pinned = int(constants.get("plan_pipeline_depth"))
+    how = (
+        f"pinned (plan_pipeline_depth={pinned})" if pinned > 0
+        else "cost model (stage-overlap accounting)"
+    )
+    lines = ["", f"pipeline: depth {chosen.plan.pipeline} [{how}]"]
+    for c in sorted(family, key=lambda c: c.plan.pipeline):
+        measured = _cost.calibrated_plan_us(op, bucket, wire,
+                                            c.plan.plan_id)
+        est = (
+            f"{measured:9.1f}us measured" if measured is not None
+            else (f"{c.cost_us:9.1f}us modeled" if c.cost_us is not None
+                  else "       --")
+        )
+        mark = "CHOSEN  " if c.plan.plan_id == chosen.plan.plan_id else (
+            "ok      " if c.feasible else "rejected"
+        )
+        reason = f"  ({c.reason})" if c.reason and not c.feasible else ""
+        lines.append(f"  {mark} depth {c.plan.pipeline:>2}  {est}{reason}")
+    if chosen.plan.pipeline > 1:
+        lines.append("  per-chunk stage timeline (us):")
+        stages = _cost.pipeline_stage_us(chosen.plan)
+        lines.append(
+            "    " + ", ".join(
+                f"{s}={stages[s]:.1f}" for s in _cost.PIPELINE_STAGES
+                if stages.get(s)
+            )
+        )
+        for row in _cost.pipeline_timeline(chosen.plan):
+            lines.append(
+                f"    chunk {row['chunk']:>2} {row['stage']:<7} "
+                f"@{row['start_us']:>9.1f} for {row['us']:.1f}"
+            )
+    return lines
 
 
 def _generators_fmt_bytes(n: int) -> str:

@@ -35,11 +35,12 @@ This module is jax-free: candidates can be generated offline.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import List, Optional, Tuple
 
 from .. import constants
 from . import cost as _cost
+from . import pipeline as _pipeline
 from .ir import Plan, Step
 from .topology import (
     LINK_DCN,
@@ -61,6 +62,42 @@ TREE_OPS = ("allreduce", "broadcast")
 
 #: ops with an autotuned latency-path crossover constant
 _CUTOFF_OPS = ("allreduce", "broadcast")
+
+#: ops whose ppermute-ring lowerings accept a pipeline depth (the
+#: chunk-pipelined execution dimension; see gen-family docstrings)
+PIPELINE_OPS = ("allreduce",)
+
+
+def pipelined_variant(plan: Plan, depth: int) -> Plan:
+    """The depth-``depth`` software-pipelined twin of ``plan``: same
+    steps (they describe the full logical volume — the cost model prices
+    per-chunk shares), distinct ``plan_id``."""
+    return replace(plan, pipeline=int(depth))
+
+
+def _pipeline_eligible(plan: Plan) -> bool:
+    """Whether a plan's executor can thread a pipeline depth: the
+    ppermute-ring lowerings of the PIPELINE_OPS families. The Pallas
+    RDMA kernels schedule their own multi-buffer DMA pipeline and the
+    fused XLA path is a single vendor collective — neither takes an IR
+    depth."""
+    return plan.op in PIPELINE_OPS and plan.backend == "ring" and (
+        not plan.impl or plan.impl == "ring"
+    )
+
+
+def maybe_pin_depth(plan: Plan, nelem: int, itemsize: int) -> Plan:
+    """Apply a pinned ``plan_pipeline_depth`` (> 1: the tuned or
+    operator-forced depth) to an eligible plan, respecting the per-chunk
+    payload floor. Used by the generator-pinning wrappers so a pinned
+    family still earns the tuned pipeline."""
+    pinned = int(constants.get("plan_pipeline_depth"))
+    if pinned <= 1 or not _pipeline_eligible(plan):
+        return plan
+    nbytes = nelem * itemsize
+    if nbytes // pinned < int(constants.get("plan_pipeline_min_chunk_bytes")):
+        return plan
+    return pipelined_variant(plan, pinned)
 
 
 def wire_bytes(nelem: int, itemsize: int, wire: str) -> int:
@@ -497,5 +534,30 @@ def candidate_plans(
                 "below the measured XLA crossover (latency path)")
         else:
             add(tree_plan, True)
+
+    # chunk-pipelined variants: every feasible ppermute-ring candidate of
+    # a PIPELINE_OPS family spawns depth-d twins (same steps, the cost
+    # model prices per-chunk stage overlap). plan_pipeline_depth pins one
+    # depth (1 = pipelining tuned off); 0 lets the model race the depths.
+    if op in PIPELINE_OPS:
+        nbytes = nelem * itemsize
+        pinned = int(constants.get("plan_pipeline_depth"))
+        min_chunk = int(constants.get("plan_pipeline_min_chunk_bytes"))
+        if pinned > 1:
+            depths = [pinned]
+        elif pinned == 1:
+            depths = []
+        else:
+            depths = _pipeline.depth_candidates(nbytes)
+        for base in [c for c in out
+                     if c.feasible and _pipeline_eligible(c.plan)]:
+            for d in depths:
+                variant = pipelined_variant(base.plan, d)
+                if nbytes // d < min_chunk:
+                    add(variant, False,
+                        f"chunks below plan_pipeline_min_chunk_bytes "
+                        f"({min_chunk}B) at depth {d}")
+                else:
+                    add(variant, True)
 
     return out

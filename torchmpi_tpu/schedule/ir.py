@@ -81,6 +81,13 @@ class Plan:
     steps: Tuple[Step, ...] = ()
     impl: str = ""
     meta: Tuple[Tuple[str, Any], ...] = field(default=())
+    #: software-pipeline depth: the payload is split into this many
+    #: interleaved chunks whose quantize/send-recv/dequantize-reduce
+    #: stages overlap (1 = the unpipelined twin). A first-class plan
+    #: dimension: it participates in plan_id, the cost model prices it
+    #: with stage-overlap accounting, and the lowering threads it into
+    #: the executors' segment machinery byte-identically.
+    pipeline: int = 1
 
     @property
     def plan_id(self) -> str:
@@ -88,14 +95,17 @@ class Plan:
         Identical requests on identical topologies under identical
         constants produce the identical plan_id on every rank — which is
         what lets the desync analyzer diff *plans*, not just ops."""
-        h = hashlib.sha1(
-            repr((self.op, self.generator, self.backend, self.wire,
-                  self.impl, self.topology_fp, self.steps,
-                  self.meta)).encode()
-        ).hexdigest()[:8]
+        ident = (self.op, self.generator, self.backend, self.wire,
+                 self.impl, self.topology_fp, self.steps, self.meta)
+        if self.pipeline > 1:
+            # depth-1 plans keep their pre-pipeline hash (persisted
+            # calibration tables and plan overrides stay valid)
+            ident = ident + (self.pipeline,)
+        h = hashlib.sha1(repr(ident).encode()).hexdigest()[:8]
         tail = f"+{self.impl}" if self.impl and self.impl != self.backend \
             else ""
-        return f"{self.generator}-{self.backend}{tail}-{self.wire}:{h}"
+        depth = f"@p{self.pipeline}" if self.pipeline > 1 else ""
+        return f"{self.generator}-{self.backend}{tail}-{self.wire}{depth}:{h}"
 
     # ------------------------------------------------------------------
     def total_steps(self) -> int:
@@ -113,7 +123,8 @@ class Plan:
             f"plan {self.plan_id}  op={self.op} generator={self.generator}"
             f" backend={self.backend}"
             + (f" impl={self.impl}" if self.impl else "")
-            + f" wire={self.wire}",
+            + f" wire={self.wire}"
+            + (f" pipeline={self.pipeline}" if self.pipeline > 1 else ""),
             f"  topology {self.topology_fp}",
         ]
         for s in self.steps:

@@ -48,14 +48,20 @@ def _eager():
 
 
 def lower_flat(comm: Communicator, op: str, backend: str, shape: Tuple,
-               dtype, wire: str, root: int, src: int, dst: int):
+               dtype, wire: str, root: int, src: int, dst: int,
+               pipeline: int = 1):
     """The flat executable: exactly the legacy ``run()`` terminal path —
     bidir marker, ring tuning, broadcast tree/pipeline decision and the
-    wire key all participate in the executable-cache key as before."""
+    wire key all participate in the executable-cache key as before. A
+    plan ``pipeline`` depth > 1 rides ``extra`` into the kernel table
+    (and thus the cache key — the PR 9 key discipline: a depth change is
+    a different executable)."""
     eager = _eager()
     platform = comm._devices[0].platform
     nelem = int(np.prod((1,) + tuple(shape[1:])))
     extra: Tuple = (src, dst) if op == "sendreceive" else ()
+    if pipeline > 1 and backend == "ring" and op == "allreduce":
+        extra = extra + (("pipeline", int(pipeline)),)
     if (
         backend == "pallas"
         and op == "allreduce"
@@ -83,10 +89,12 @@ def lower_flat(comm: Communicator, op: str, backend: str, shape: Tuple,
 
 
 def lower_fused_flat(comm: Communicator, op: str, backend: str,
-                     ns: Tuple[int, ...], dtype, wire: str):
+                     ns: Tuple[int, ...], dtype, wire: str,
+                     pipeline: int = 1):
     """The coalesced flat executable: pack-concat + collective compiled
     as ONE plan per (op, layout, dtype, routing) — legacy ``run_fused``'s
-    terminal path, cache key preserved (``"_fused"``)."""
+    terminal path, cache key preserved (``"_fused"``; a pipeline depth
+    appends a marker, so depth-1 keys are unchanged)."""
     eager = _eager()
     platform = comm._devices[0].platform
     extra: Tuple = ()
@@ -96,6 +104,8 @@ def lower_fused_flat(comm: Communicator, op: str, backend: str,
         and wire == "full"
     ):
         extra = ("bidir",)
+    if pipeline > 1 and backend == "ring" and op == "allreduce":
+        extra = extra + (("pipeline", int(pipeline)),)
     tuning: Tuple = ()
     if backend in ("ring", "pallas"):
         tuning = eager.ring_tuning(platform)
@@ -191,12 +201,15 @@ def _hier_compile(comm: Communicator, key, ndim: int, donate: bool, kernel,
 
 
 def lower_hier_allreduce(comm: Communicator, impl: str, shape: Tuple,
-                         dtype, wire: str):
+                         dtype, wire: str, pipeline: int = 1):
     """Two-level allreduce over a cartesian communicator: ring within
     each intra group, ring across the inter dimension — the reference's
     ``allreducep2pHierarchicalImpl`` (``collectives_cuda.cpp:501-581``),
     cartesian shortcut included. Cache key shape preserved
-    (``"hier_allreduce"``)."""
+    (``"hier_allreduce"``; a plan pipeline depth > 1 appends a marker).
+    The chunk pipeline applies to BOTH ppermute ring phases — the inter
+    ring rides the slowest fabric, exactly where hiding the codec under
+    wire time pays most."""
     eager = _eager()
     donate = constants.get("donate_eager_buffers")
     tuning = (
@@ -212,12 +225,13 @@ def lower_hier_allreduce(comm: Communicator, impl: str, shape: Tuple,
         and wire == "full"
     )
     wire_arg = wire if wire != "full" else None
+    depth = int(pipeline) if impl == "ring" else 1
     key = (
         "hier_allreduce", impl, tuple(shape), dtype, donate,
         tuning, bidir,
         (wire, constants.get("wire_quant_block_size"))
         if wire != "full" else ("full",),
-    )
+    ) + ((("pipeline", depth),) if depth > 1 else ())
 
     if impl == "pallas":
         # intra = ICI: the Pallas RDMA ring (uni- or bidirectional per
@@ -244,11 +258,13 @@ def lower_hier_allreduce(comm: Communicator, impl: str, shape: Tuple,
                 b, "intra",
                 max_bytes_per_step=maxb, min_bytes_per_step=minb,
                 num_buffers=nbuf, wire_dtype=wire_arg,
+                pipeline_depth=depth,
             )
             return prim.ring_allreduce(
                 b, "inter",
                 max_bytes_per_step=maxb, min_bytes_per_step=minb,
                 num_buffers=nbuf, wire_dtype=wire_arg,
+                pipeline_depth=depth,
             )
     else:
         def kernel(b):
@@ -368,7 +384,8 @@ _staged_exchange_epochs: dict = {}
 
 
 def run_staged_hierarchical_allreduce(
-    x, comm: Communicator, intra_impl: str = "ring", wire: str = "full"
+    x, comm: Communicator, intra_impl: str = "ring", wire: str = "full",
+    pipeline: int = 1,
 ):
     """Host-staged cross-group allreduce — the TPU analog of
     ``allreducep2pCrossNodesViaCPU`` (staged-via-pinned-CPU,
@@ -386,6 +403,11 @@ def run_staged_hierarchical_allreduce(
     The staged hop trades device-collective bandwidth for not needing any
     inter-group device link — exactly the reference's rationale when GDR
     was unavailable.
+
+    A plan ``pipeline`` depth applies to the INTRA device ring only; the
+    host hop is a single blob exchange whose own chunk pipeline is the
+    PS transport's (``ps_chunk_bytes``) — the split the PARITY
+    stage-overlap contract documents.
     """
     eager = _eager()
     cache = eager._resource_cache(comm)
@@ -396,12 +418,13 @@ def run_staged_hierarchical_allreduce(
         and constants.get("ring_implementation") == "pallas_bidir"
         and wire_arg is None
     )
+    depth = int(pipeline) if intra_impl == "ring" else 1
     key = (
         "staged_allreduce", intra_impl, bidir, tuple(x.shape),
         jnp.result_type(x), tuning,
         (wire, constants.get("wire_quant_block_size"))
         if wire_arg else ("full",),
-    )
+    ) + ((("pipeline", depth),) if depth > 1 else ())
     entry = cache.get(key)
     if entry is None:
         perm = np.concatenate(comm._groups).astype(np.int32)
@@ -420,6 +443,7 @@ def run_staged_hierarchical_allreduce(
                     b, "intra",
                     max_bytes_per_step=maxb, min_bytes_per_step=minb,
                     num_buffers=nbuf, wire_dtype=wire_arg,
+                    pipeline_depth=depth,
                 )
 
         shmapped = jax.shard_map(
@@ -537,7 +561,7 @@ def _binomial_reduce_steps(groups, p: int):
 
 
 def lower_tree_allreduce(comm: Communicator, shape: Tuple, dtype,
-                         wire: str):
+                         wire: str, pipeline: int = 1):
     """Hierarchical allreduce on a NON-cartesian (ragged/tree)
     communicator — the reference's non-cartesian path (intra reduce to
     group root, inter exchange among roots, final intra broadcast,
@@ -553,16 +577,26 @@ def lower_tree_allreduce(comm: Communicator, shape: Tuple, dtype,
     A compressed ``wire`` encodes every binomial exchange hop (partials
     quantized on send, f32 accumulate — non-target ranks receive zeros,
     which decode to exact zeros); only the final one-hop gather broadcast
-    ships full precision. Cache key preserved (``"tree_hier_allreduce"``)."""
+    ships full precision. Cache key preserved (``"tree_hier_allreduce"``;
+    a pipeline depth > 1 appends a marker).
+
+    A plan ``pipeline`` depth > 1 splits every binomial hop into that
+    many block-aligned sub-buffers whose encode / ppermute / accumulate
+    chains are issued independently (quantize of chunk k+1 can hide
+    under the permute of chunk k). Block alignment keeps each chunk's
+    quantization grid identical to the whole-buffer encode, and the
+    masked accumulate is elementwise — the pipelined result is bitwise
+    equal to depth 1."""
     eager = _eager()
     cache = eager._resource_cache(comm)
     donate = constants.get("donate_eager_buffers")
     wire_arg = wire if wire != "full" else None
     block = constants.get("wire_quant_block_size")
+    depth = int(pipeline)
     key = (
         "tree_hier_allreduce", tuple(shape), dtype, donate,
         (wire, block) if wire_arg else ("full",),
-    )
+    ) + ((("pipeline", depth),) if depth > 1 else ())
     fn = cache.get(key)
     hit = fn is not None
     if fn is None:
@@ -575,20 +609,48 @@ def lower_tree_allreduce(comm: Communicator, shape: Tuple, dtype,
         mesh = eager._flat_mesh(comm)
         spec = eager._rank_spec(len(shape))
 
+        def hop(buf, perm):
+            if wire_arg:
+                # non-targets receive zero q/scales -> decode to 0
+                return prim._wire_send_recv(buf, _AXIS, perm, wire_arg,
+                                            block)
+            return lax.ppermute(buf, _AXIS, perm)  # non-targets: 0
+
         def kernel(b):
-            for perm, mask in schedule:
-                if wire_arg:
-                    # non-targets receive zero q/scales -> decode to 0
-                    recv = prim._wire_send_recv(
-                        b, _AXIS, perm, wire_arg, block
+            if depth <= 1:
+                for perm, mask in schedule:
+                    recv = hop(b, perm)
+                    receives = jnp.take(
+                        jnp.asarray(mask), lax.axis_index(_AXIS)
                     )
-                else:
-                    recv = lax.ppermute(b, _AXIS, perm)  # non-targets: 0
+                    b = jnp.where(receives, b + recv, b)
+                return b
+            # chunk-pipelined hops: contiguous block-aligned sub-buffers
+            shape_b = b.shape
+            flatb = b.reshape(-1)
+            nloc = flatb.shape[0]
+            sub = -(-nloc // depth)
+            if wire_arg:
+                sub = -(-sub // block) * block
+            sub = max(1, sub)
+            d = max(1, -(-nloc // sub))
+            pad = d * sub - nloc
+            if pad:
+                flatb = jnp.concatenate(
+                    [flatb, jnp.zeros((pad,), flatb.dtype)]
+                )
+            segs = flatb.reshape(d, sub)
+            for perm, mask in schedule:
                 receives = jnp.take(
                     jnp.asarray(mask), lax.axis_index(_AXIS)
                 )
-                b = jnp.where(receives, b + recv, b)
-            return b
+                parts = []
+                for j in range(d):
+                    buf = segs[j]
+                    recv = hop(buf, perm)
+                    parts.append(jnp.where(receives, buf + recv, buf))
+                segs = jnp.stack(parts)
+            return segs.reshape(-1)[:nloc].reshape(shape_b)
 
         shmapped = jax.shard_map(
             kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False

@@ -88,7 +88,7 @@ class SimFleet:
                  steps: int = 8, state_elems: int = 1 << 20,
                  payload_elems: int = 1 << 20,
                  arrival_spread_s: float = 0.0,
-                 hang_reporters: int = 4):
+                 hang_reporters: int = 4, wire: str = "full"):
         self.loop = EventLoop()
         self.net = ModeledNetwork(group_size, rng_for(seed, "net"))
         self.rng = rng_for(seed, "fleet")
@@ -97,6 +97,12 @@ class SimFleet:
         self.steps_total = int(steps)
         self.state_elems = int(state_elems)
         self.payload_elems = int(payload_elems)
+        # wire encoding the modeled training collective is priced with:
+        # int8/bf16 add the quantize/dequantize steps whose overlap the
+        # pipelined plan candidates must out-earn — depth selection at
+        # 1k-10k simulated ranks is testable because the REAL candidate
+        # generation and stage-overlap cost model run here
+        self.wire = str(wire)
         self.arrival_spread_s = float(arrival_spread_s)
         self.hang_reporters = int(hang_reporters)
         # the REAL membership/epoch/barrier state machine on virtual time
@@ -165,7 +171,7 @@ class SimFleet:
         )
         cands = candidate_plans(
             "allreduce", self.payload_elems, 4, topo, backend="ring",
-            wire="full", route_small=False,
+            wire=self.wire, route_small=False,
         )
         feasible = [
             c for c in cands if c.feasible and c.cost_us is not None

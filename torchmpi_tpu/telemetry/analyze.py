@@ -64,9 +64,12 @@ _RANK_RE = re.compile(
 # straggler spread, which both assume one shared stream per comm key.
 # "handles" (SyncHandle.wait blocking regions) is likewise rank-local:
 # which waits run depends on timing (prefetch, backpressure drains), not
-# on the program's collective schedule.
+# on the program's collective schedule. "chunks" is the chunk-pipeline
+# sub-entry stream (schedule.pipeline.CHUNK_COMM): per-chunk events of a
+# parent dispatch whose count and timing vary with payload split and
+# socket pacing, not with the program — a pipelined run must diff clean.
 _PS_PREFIX = "ps:"
-_LOCAL_COMMS = ("handles",)
+_LOCAL_COMMS = ("handles", "chunks")
 
 # synthetic tid for the flight-recorder track merged under each rank's pid
 _FLIGHT_TID = 0xF11

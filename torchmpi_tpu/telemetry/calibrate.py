@@ -136,8 +136,18 @@ class SampleStore:
 
     def add_entry(self, entry: dict) -> bool:
         """Ingest one flight-recorder entry dict; returns whether it was
-        a calibration sample (completed, planned, payload parseable)."""
+        a calibration sample (completed, planned, payload parseable).
+
+        Chunk sub-entries of a pipelined dispatch (``routing="chunk"`` /
+        the rank-local ``chunks`` stream) are NOT samples: their
+        per-chunk timings would land in the *chunk-size* payload bucket
+        and bias the medians the fit consumes. The parent dispatch entry
+        carries the logical payload, and its plan_id carries the depth
+        (``...@p4``), so pipelined and unpipelined samples stay
+        comparable within one logical bucket."""
         if entry.get("status") != "completed" or not entry.get("plan"):
+            return False
+        if entry.get("routing") == "chunk" or entry.get("comm") == "chunks":
             return False
         op = entry.get("op", "")
         if not op.startswith(_SAMPLED_PREFIXES):

@@ -24,6 +24,10 @@ measurement on the *actual* communicator —
   compiler — every structurally possible schedule family is run on the
   live topology and the winner persists as a plan override per
   plan-cache key, overriding the analytic cost model's pick.
+- :func:`tune_pipeline_depth`: measured chunk-pipeline depth for the
+  ring plan families (the schedule IR's pipeline dimension) — the
+  winner pins ``plan_pipeline_depth``, overriding the stage-overlap
+  cost model's depth choice.
 
 :func:`tune_all` runs everything; results persist per
 ``(platform, world size)`` in a JSON cache
@@ -68,6 +72,7 @@ _TUNABLE = (
     "wire_dtype",
     "fusion_buffer_bytes",
     "ps_chunk_bytes",
+    "plan_pipeline_depth",
 )
 
 #: canonical LeNet gradient leaf element counts (conv1 w/b, conv2 w/b,
@@ -460,6 +465,74 @@ def tune_plan(
     return winner, results
 
 
+def tune_pipeline_depth(
+    comm: Optional[Communicator] = None,
+    nelem: int = 1 << 20,
+    warmup: int = 2,
+    timed: int = 4,
+    apply: bool = True,
+) -> Tuple[int, List]:
+    """Measure the chunk-pipeline depths (1, 2, 4, ... per the
+    ``plan_pipeline_*`` knobs) for the large flat ring allreduce on THIS
+    communicator and pin the fastest CORRECT one as
+    ``plan_pipeline_depth`` — persisted per (platform, world size) and
+    re-applied by ``start()`` like every tuned knob. The pipeline must
+    EARN its depth: on fabrics where per-hop launch overhead beats the
+    stage overlap (tiny chunks, alpha-dominated rings) the tuner keeps
+    depth 1, which PINS pipelining off; the analytic stage-overlap model
+    only decides where no measurement has spoken (the default 0).
+
+    Requires unfrozen constants even with ``apply=False``: the sweep
+    pins each depth by temporarily setting ``plan_pipeline_depth``."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    comm = _comm(comm)
+    _check_unfrozen(apply, measure_mutates=True)
+    from ..collectives import eager
+    from ..schedule import compiler as _sched
+    from ..schedule import pipeline as _pipe
+
+    wire = eager.resolve_wire_dtype("allreduce", nelem, jnp.float32, None)
+    depths = [1] + _pipe.depth_candidates(nelem * 4)
+    p = comm.size
+    x = jnp.ones((p, nelem), jnp.float32)
+    jax.block_until_ready(x)
+    prev = constants.get("plan_pipeline_depth")
+    results: List = []
+    best = (float("inf"), 1)
+    try:
+        for d in depths:
+            constants.set("plan_pipeline_depth", int(d))
+            ep = _sched.compile_collective(
+                "allreduce", (p, nelem), jnp.float32, comm,
+                generator="flat", impl="ring", wire_override=wire,
+            )
+            laps = []
+            out = None
+            for it in range(warmup + timed):
+                t0 = _time.perf_counter()
+                out = jax.block_until_ready(ep.execute(x))
+                if it >= warmup:
+                    laps.append(_time.perf_counter() - t0)
+            if not _np.allclose(_np.asarray(out), float(p), rtol=1e-4):
+                results.append((d, None, "incorrect"))
+                continue
+            mean_us = 1e6 * sum(laps) / max(1, len(laps))
+            results.append((d, mean_us))
+            if mean_us < best[0]:
+                best = (mean_us, d)
+    finally:
+        constants.set("plan_pipeline_depth", prev)
+    if apply:
+        constants.set("plan_pipeline_depth", int(best[1]))
+    _audit_decision("plan_pipeline_depth", int(best[1]), apply, results)
+    return int(best[1]), results
+
+
 def tune_fusion_threshold(
     comm: Optional[Communicator] = None,
     leaf_sizes: Optional[Tuple[int, ...]] = None,
@@ -613,6 +686,9 @@ def tune_all(
     )[0]
     out["wire_dtype"] = tune_wire_dtype(comm, nelem=big, apply=apply)[0]
     out["plan"] = tune_plan(
+        comm, nelem=big, timed=3 if quick else 5, apply=apply
+    )[0]
+    out["plan_pipeline_depth"] = tune_pipeline_depth(
         comm, nelem=big, timed=3 if quick else 5, apply=apply
     )[0]
     out["fusion_buffer_bytes"] = tune_fusion_threshold(
