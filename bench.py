@@ -1856,6 +1856,192 @@ def _sim_bench(check: bool = False, worlds: str = ""):
     return 0
 
 
+def _serve_bench(check: bool = False) -> int:
+    """``--serve``: the serving tier under a 10x open-loop swing. One
+    real listener + :class:`~torchmpi_tpu.serve.InferenceServer` answers
+    REQUEST frames through the exact admission/apply path training
+    frames ride; an open-loop arrival schedule (baseline -> 10x surge ->
+    baseline, arrivals stamped by their SCHEDULED time, so queueing
+    delay is charged to latency the way a real caller experiences it)
+    drives it with a rotating QoS mix. Rates are sized off the
+    listener's measured worker pool so the surge overloads by
+    construction on any host. Prints one JSON line with per-phase
+    offered QPS and p50/p95/p99 latency plus the exactly-once audit:
+    every request carries its index and must come back exactly once as
+    either a correct ``ok`` answer or an explicit ``shed`` retry-after —
+    silent drops and wrong answers both count. ``check`` gates (CI):
+
+    - zero dropped and zero wrong replies at every phase;
+    - the brownout ladder engaged DURING the surge (shed > 0) while
+      drops stayed zero — degradation, not collapse;
+    - high-QoS requests kept being answered during the surge;
+    - baseline p95 within ``serve_slo_ms`` (the SLO holds when the
+      fleet is sized to the load).
+
+    Pure host path — no jax backend, survives a dead TPU tunnel."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import threading
+
+    import numpy as np
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.serve import InferenceServer
+
+    service_s = 0.008
+    workers = max(
+        4, int(constants.get("parameterserver_thread_pool_size")) * 2
+    )
+    capacity = workers / service_s
+    base_qps = 0.15 * capacity
+    surge_qps = 10.0 * base_qps  # 1.5x the pool's service capacity
+    phases = [
+        ("base", base_qps, 1.0),
+        ("surge", surge_qps, 1.5),
+        ("recover", base_qps, 1.0),
+    ]
+    budget = 32
+    bias = np.float32(7.0)
+
+    def model_fn(w, x):
+        time.sleep(service_s)  # a fixed-cost kernel: capacity is known
+        return x + w[0]
+
+    prev_budget = constants.get("serve_queue_budget")
+    constants.set("serve_queue_budget", budget)
+    srv = InferenceServer(model_fn, weights=np.array([bias], np.float32))
+    lst = T._Listener(lambda i: None)
+    lst.request_handler = srv.handle
+    ch = T._PeerChannel({0: ("127.0.0.1", lst.port)}, 0)
+    qos_levels = int(constants.get("serve_qos_levels"))
+
+    # the open-loop schedule: arrival offsets + phase tags, fixed
+    # before the clock starts
+    schedule = []
+    t = 0.0
+    for name, qps, dur in phases:
+        end, gap = t + dur, 1.0 / qps
+        while t < end:
+            schedule.append((t, name))
+            t += gap
+    inflight = []  # (waiter, index, sched_t, phase, qos) in FIFO order
+    results = []
+    done = threading.Event()
+
+    # FIFO drain without a queue class: completions come back in submit
+    # order on one channel, so a plain index walk is enough
+    def drain():
+        k = 0
+        while not (done.is_set() and k >= len(inflight)):
+            if k >= len(inflight):
+                time.sleep(0.001)
+                continue
+            w, i, t_sched, name, qos = inflight[k]
+            k += 1
+            rrule, out = ch.complete(w)
+            results.append(
+                (i, name, qos, time.perf_counter() - t_sched, rrule, out)
+            )
+
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+    t0 = time.perf_counter()
+    try:
+        for i, (dt, name) in enumerate(schedule):
+            now = time.perf_counter()
+            if t0 + dt > now:
+                time.sleep(t0 + dt - now)
+            qos = i % qos_levels
+            w = ch.submit(
+                T._KIND_REQUEST, 0, qos, 0, rule="infer",
+                payload_raw=np.array([i], np.float32).tobytes(),
+            )
+            inflight.append((w, i, t0 + dt, name, qos))
+        done.set()
+        drainer.join(timeout=60)
+    finally:
+        ch.close()
+        lst.close()
+        constants.set("serve_queue_budget", prev_budget)
+    sent = len(schedule)
+    bad = drops = 0
+    by_phase = {name: {"sent": 0, "ok": [], "shed": 0}
+                for name, _, _ in phases}
+    for i, name, qos, lat, rrule, out in results:
+        ph = by_phase[name]
+        if rrule == "ok":
+            if out is None or abs(float(out[0]) - (i + bias)) > 1e-4:
+                bad += 1
+            ph["ok"].append(lat)
+        elif str(rrule).startswith("shed:"):
+            ph["shed"] += 1
+        else:
+            bad += 1
+        ph["sent"] += 1
+    drops = sent - len(results)
+
+    def pcts(lats):
+        if not lats:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        return {
+            f"p{p}_ms": round(float(np.percentile(lats, p)) * 1e3, 2)
+            for p in (50, 95, 99)
+        }
+
+    points = []
+    for name, qps, dur in phases:
+        ph = by_phase[name]
+        points.append({
+            "phase": name,
+            "offered_qps": round(qps, 1),
+            "sent": ph["sent"],
+            "ok": len(ph["ok"]),
+            "shed": ph["shed"],
+            **pcts(ph["ok"]),
+        })
+    line = {
+        "metric": "serving tier under a 10x open-loop surge (REQUEST "
+        "frames through the real admission path, brownout ladder armed)",
+        "unit": "ms p95 baseline",
+        "platform": "cpu",
+        "service_ms": service_s * 1e3,
+        "pool_workers": workers,
+        "queue_budget": budget,
+        "points": points,
+        "sent": sent,
+        "dropped": drops,
+        "wrong_replies": bad,
+        "shed_total": sum(p["shed"] for p in points),
+        "value": points[0]["p95_ms"],
+    }
+    print(json.dumps(line), flush=True)
+    if not check:
+        return 0
+    base, surge = points[0], points[1]
+    slo_ms = float(constants.get("serve_slo_ms"))
+    failures = []
+    if drops or bad:
+        failures.append(f"audit: dropped={drops} wrong={bad}")
+    if surge["shed"] <= 0:
+        failures.append("brownout never engaged during the surge")
+    if base["shed"]:
+        failures.append(f"baseline shed {base['shed']} requests")
+    if surge["ok"] <= 0:
+        failures.append("no requests answered during the surge")
+    if base["p95_ms"] is None or base["p95_ms"] > slo_ms:
+        failures.append(
+            f"baseline p95 {base['p95_ms']}ms over the {slo_ms}ms SLO"
+        )
+    if failures:
+        print(
+            "# serve smoke FAILED: " + "; ".join(failures),
+            file=sys.stderr,
+            flush=True,
+        )
+        return 1
+    return 0
+
+
 def main(argv=None):
     import argparse
 
@@ -1933,6 +2119,16 @@ def main(argv=None):
         help="with --sim: comma-separated world sizes for the curve",
     )
     ap.add_argument(
+        "--serve",
+        action="store_true",
+        help="serving-tier surge bench: a real InferenceServer answers "
+        "REQUEST frames through the real admission path while an "
+        "open-loop arrival schedule swings 10x (baseline/surge/recover); "
+        "prints one JSON line with per-phase QPS + p50/p95/p99 latency "
+        "and an exactly-once/zero-drop audit — pure host path, no jax "
+        "backend",
+    )
+    ap.add_argument(
         "--check",
         action="store_true",
         help="with --microbench: exit 1 unless fused dispatch <= unfused "
@@ -1944,9 +2140,14 @@ def main(argv=None):
         "half the 32-client point, or server thread growth with client "
         "count (CI perf-smoke); with --sim: exit 1 on a missed resize, "
         "super-linear control payloads, re-formation hotspots, or a "
-        "non-deterministic replay",
+        "non-deterministic replay; with --serve: exit 1 on any silent "
+        "drop or wrong reply, a surge with no brownout shedding, or a "
+        "baseline p95 over serve_slo_ms",
     )
     args = ap.parse_args(argv)
+
+    if args.serve:
+        return _serve_bench(check=args.check)
 
     if args.sim:
         return _sim_bench(check=args.check, worlds=args.sim_worlds)

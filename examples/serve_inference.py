@@ -1,0 +1,187 @@
+"""Serving under training: answer inference over the PS listener while a
+background downpour trainer keeps publishing fresh weights.
+
+Run under the launcher (two processes, real sockets between them):
+
+    python -m torchmpi_tpu.launch --nproc 2 --cpu-devices 1 \
+        examples/serve_inference.py -- --rdv-dir /tmp/rdv --steps 12
+
+Process 0 is the serving tier: an
+:class:`~torchmpi_tpu.serve.InferenceServer` answers REQUEST frames on a
+PS listener (the same event-multiplexed admission/BUSY machinery
+training traffic rides) while its background refresher keeps the
+:class:`~torchmpi_tpu.serve.WeightCache` fresh — a swap is a
+version-vector compare + reference swap, so a refresh never pauses
+serving. A downpour-style trainer thread in the same process publishes
+through the :class:`~torchmpi_tpu.parameterserver.ParameterServer`
+every step, bumping the shard versions the refresher notices. Process 1
+is the traffic source: a :class:`~torchmpi_tpu.serve.ServeClient`
+driving REQUEST round trips over a real peer channel, observing the
+reply bias move as weight swaps land.
+
+Each process stays a single-process jax runtime (cross-process CPU
+collectives are not available on every jax build CI runs against —
+the telemetry smoke makes the same choice); the processes rendezvous at
+the SOCKET level through ``--rdv-dir``, because the socket fabric is
+exactly what is under test. Prints parseable evidence lines —
+``swaps=N`` on the serving rank (weight freshness), ``ok=N shed=N
+biases=N`` on the client rank (every request answered or shed with a
+retry hint, never dropped; ``biases>=2`` means the client saw the
+weights change mid-run) — that ``scripts/serve_smoke.py`` asserts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# single-process jax per rank: the PS fabric, not jax.distributed, is
+# the transport under test here (see module docstring)
+os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+
+import torchmpi_tpu as mpi  # noqa: E402
+from torchmpi_tpu import constants  # noqa: E402
+from torchmpi_tpu.parameterserver import ParameterServer  # noqa: E402
+from torchmpi_tpu.parameterserver import transport as T  # noqa: E402
+from torchmpi_tpu.serve import InferenceServer, ServeClient  # noqa: E402
+
+
+class _ChannelTransport:
+    """`serve_request` over one raw peer channel — what
+    ``Transport.serve_request`` does, minus the jax-multihost address
+    exchange this 2-proc smoke topology cannot use."""
+
+    def __init__(self, channel, client: int):
+        self._ch = channel
+        self._client = client
+
+    def serve_request(self, proc, rule, payload, qos=0):
+        raw = np.ascontiguousarray(
+            np.asarray(payload, np.float32)
+        ).tobytes()
+        return self._ch.request(
+            T._KIND_REQUEST, 0, int(qos), self._client,
+            rule=rule, payload_raw=raw,
+        )
+
+
+def _serve(args, rank: int) -> int:
+    """Rank 0: PS + downpour trainer thread + serving listener."""
+    ps = ParameterServer(np.zeros(args.dim, np.float32))
+    constants.set("serve_refresh_interval_s", args.refresh_interval)
+
+    def model_fn(weights, x):
+        # toy model: bias by the weight sum, so replies move as the
+        # trainer publishes (freshness observable from the client)
+        return x + np.float32(weights.sum())
+
+    srv = InferenceServer(model_fn, ps).start()
+    lst = T._Listener(lambda i: None)
+    lst.request_handler = srv.handle
+    port_file = os.path.join(args.rdv_dir, "port")
+    with open(port_file + ".tmp", "w") as f:
+        f.write(f"127.0.0.1:{lst.port}")
+    os.replace(port_file + ".tmp", port_file)
+    print(f"[serve {rank}] listening on {lst.port}", flush=True)
+
+    def train():
+        for _ in range(args.steps):
+            ps.send(
+                np.ones(args.dim, np.float32), rule="add", client=0,
+                scale=args.lr,
+            ).wait()
+            time.sleep(args.step_sleep)
+
+    trainer = threading.Thread(target=train, name="tm-example-trainer")
+    trainer.start()
+    done_file = os.path.join(args.rdv_dir, "done")
+    deadline = time.monotonic() + args.timeout
+    while not os.path.exists(done_file):
+        if time.monotonic() > deadline:
+            print(f"[serve {rank}] TIMEOUT waiting for client",
+                  file=sys.stderr)
+            return 1
+        time.sleep(0.05)
+    trainer.join()
+    srv.refresh_once()  # pick up any publish the drain raced
+    srv.stop()
+    lst.close()
+    print(f"[serve {rank}] swaps={srv.cache.swaps} served={srv.served} "
+          f"shed={srv.shed} version={sum(srv.cache.versions)} done",
+          flush=True)
+    ps.free()
+    return 0
+
+
+def _drive(args, rank: int) -> int:
+    """Rank 1: open-loop inference traffic over the wire."""
+    port_file = os.path.join(args.rdv_dir, "port")
+    deadline = time.monotonic() + args.timeout
+    while not os.path.exists(port_file):
+        if time.monotonic() > deadline:
+            print(f"[serve {rank}] TIMEOUT waiting for server",
+                  file=sys.stderr)
+            return 1
+        time.sleep(0.05)
+    host, _, port = open(port_file).read().partition(":")
+    ch = T._PeerChannel({0: (host, int(port))}, 0)
+    client = ServeClient(_ChannelTransport(ch, client=1), 0)
+    ok = shed = 0
+    biases = set()
+    for i in range(args.requests):
+        x = np.array([float(i)], np.float32)
+        status, result = client.infer_once(x, qos=i % 3)
+        if status == "ok":
+            bias = float(result[0] - x[0])
+            assert bias >= -1e-4, bias  # "add" publishes only grow it
+            biases.add(round(bias, 4))
+            ok += 1
+        elif status.startswith("shed:"):
+            shed += 1
+        else:
+            raise RuntimeError(f"unexpected reply {status!r}")
+        time.sleep(args.request_sleep)
+    ch.close()
+    with open(os.path.join(args.rdv_dir, "done"), "w") as f:
+        f.write("done")
+    dropped = args.requests - ok - shed
+    print(f"[serve {rank}] ok={ok} shed={shed} dropped={dropped} "
+          f"biases={len(biases)} done", flush=True)
+    return 0 if dropped == 0 else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rdv-dir", required=True,
+                    help="shared dir for the port/done rendezvous files")
+    ap.add_argument("--steps", type=int, default=12,
+                    help="trainer steps (one ps.send publish per step)")
+    ap.add_argument("--requests", type=int, default=48,
+                    help="inference round trips from the client")
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--step-sleep", type=float, default=0.2,
+                    help="trainer pacing so the refresher observes "
+                    "several distinct versions")
+    ap.add_argument("--request-sleep", type=float, default=0.05)
+    ap.add_argument("--refresh-interval", type=float, default=0.25,
+                    help="serve_refresh_interval_s for this run")
+    ap.add_argument("--timeout", type=float, default=90.0)
+    args = ap.parse_args()
+
+    rank = int(os.environ.get("TORCHMPI_TPU_PROCESS_ID", "0"))
+    mpi.start()
+    rc = _serve(args, rank) if rank == 0 else _drive(args, rank)
+    mpi.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

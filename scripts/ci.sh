@@ -59,6 +59,16 @@ run_sim_smoke() {
     JAX_PLATFORMS=cpu python -m torchmpi_tpu.sim --supervise \
         partition --ranks 1024 --out "$simdir"
     rm -rf "$simdir"
+    # traffic_surge SUPERVISED at 1024 ranks: the serving-tier scenario
+    # (diurnal open-loop surge against per-rank capacity) must drive the
+    # load-verdict ladder end to end — overload -> scale-up through the
+    # real coordinator join, brownout shedding with zero silent drops
+    # while saturated, underload -> scale-down after the surge, with the
+    # asymmetric hysteresis + shared cooldown bounding the resize count
+    # (no flapping) — per expected.recovery, deterministically per seed.
+    JAX_PLATFORMS=cpu python -m torchmpi_tpu.sim --supervise \
+        traffic_surge --ranks 1024 --out "$simdir"
+    rm -rf "$simdir"
     python bench.py --sim --check
 }
 
@@ -125,6 +135,14 @@ run_perf_smoke() {
     # analyzer.
     echo "=== recover smoke (2-proc supervised kill -> auto-shrink) ==="
     python scripts/recover_smoke.py
+    # serve smoke: a 2-proc serving job — REQUEST traffic over a real
+    # peer channel against an InferenceServer while a background
+    # downpour trainer publishes — must observe >= 1 weight swap (and
+    # the client >= 2 distinct reply versions ON the wire), answer or
+    # shed-with-retry every request (zero drops), shut down cleanly,
+    # and leave `desync: none` telemetry.
+    echo "=== serve smoke (2-proc serving tier + background downpour) ==="
+    python scripts/serve_smoke.py
 }
 
 run_slow_a() {

@@ -302,6 +302,26 @@ class _Constants:
     # (minus quarantined ranks), request an elastic grow. Off by
     # default: shrink-and-continue is the conservative posture.
     supervisor_grow_back: bool = False
+    # Consecutive overloaded windows before the scale-up rung fires
+    # (the load analog of supervisor_hysteresis_windows; scale-up reacts
+    # faster than scale-down on purpose: adding capacity is cheap to
+    # undo, shedding users is not).
+    supervisor_scale_up_hysteresis: int = 3
+    # Consecutive underloaded windows before the scale-down rung
+    # retires the highest rank. Keep well above the scale-up hysteresis:
+    # asymmetric thresholds are the first line of flap damping.
+    supervisor_scale_down_hysteresis: int = 8
+    # Minimum seconds between ANY two applied scale actions (up or
+    # down): the second line of flap damping. An oscillating arrival
+    # trace can satisfy both hysteresis counters in turn; the cooldown
+    # bounds the resize rate regardless.
+    supervisor_scale_cooldown_s: float = 30.0
+    # Hard ceiling on the world size the scale-up rung will request
+    # (0 = unbounded). At the ceiling the supervisor holds and the
+    # serving tier's brownout ladder degrades instead of collapsing.
+    supervisor_scale_max_world: int = 0
+    # Floor below which scale-down never shrinks the world.
+    supervisor_scale_min_world: int = 1
 
     # --- fleet simulation (torchmpi_tpu.sim: modeled network, real
     # --- control plane; see README "Fleet simulation") ---
@@ -316,6 +336,46 @@ class _Constants:
     # Modeled member<->coordinator control round trip (µs) for joins,
     # barrier arrivals and view fetches in the simulated fleet.
     sim_control_rtt_us: float = 500.0
+
+    # --- serving tier (torchmpi_tpu.serve; README "Serving & autoscaling") ---
+    # Per-server cap on queued inference requests before the local
+    # brownout ladder engages (distinct from ps_pending_frame_budget,
+    # which is the transport-level admission budget shared with
+    # training traffic).
+    serve_queue_budget: int = 256
+    # Service-level objective on per-request latency, milliseconds.
+    # Replies slower than this count as SLO breaches; the load verdict's
+    # burn rate is breaches/requests per aggregation window.
+    serve_slo_ms: float = 50.0
+    # Number of QoS levels carried on REQUEST frames (0 = lowest).
+    # Brownout shedding drops the lowest level first.
+    serve_qos_levels: int = 3
+    # Retry-after hint (ms) carried on shed replies, mirroring
+    # ps_busy_retry_ms for BUSY frames.
+    serve_shed_retry_ms: int = 50
+    # Seconds between background weight-refresh fetches (the PR 5
+    # delta-fetch path); each fetch that lands a newer version swaps
+    # the serving weights atomically.
+    serve_refresh_interval_s: float = 2.0
+    # Staleness bound: a server whose weights are older than this warns
+    # (and the brownout ladder may widen it; see the factor below).
+    serve_refresh_staleness_s: float = 30.0
+    # Brownout level 2 multiplies both the refresh interval and the
+    # staleness bound by this factor: under pressure, serving slightly
+    # staler weights beats missing the latency SLO.
+    serve_brownout_staleness_factor: float = 4.0
+    # Load-verdict thresholds (FleetAggregator): fraction of a window's
+    # requests that breached the SLO before the window counts as
+    # overloaded...
+    serve_slo_burn_threshold: float = 0.1
+    # ...or fleet-wide BUSY/shed rejects per second per rank...
+    serve_overload_busy_rate: float = 1.0
+    # ...or sustained queue growth per second per rank (trend, not
+    # level: a full-but-draining queue is not overload).
+    serve_queue_growth_per_s: float = 1.0
+    # Underload: fleet-wide requests per second per rank below which a
+    # window counts toward scale-down (with zero breaches/rejects).
+    serve_underload_qps: float = 1.0
 
     # --- coalescing dispatch (latency path; GC3-style fused plans) ---
     # Capacity of the flat fusion buffer: pending same-(op, dtype, comm,

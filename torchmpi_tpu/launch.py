@@ -36,6 +36,25 @@ from pathlib import Path
 from typing import List, Optional
 
 
+def arm_supervise_telemetry(args) -> Optional[str]:
+    """``--supervise`` without ``--telemetry-live`` would silently
+    starve the supervisor: its ONLY sensor is the launcher-resident
+    aggregator's streaming verdicts, so a supervised job with the live
+    plane dark observes nothing and never acts — the worst failure
+    mode, an operator who BELIEVES recovery is armed. Auto-arm the
+    plane and return the notice to print (the operator asked for one
+    flag and got two, which must be visible in the job log); ``None``
+    when nothing had to be armed."""
+    if not getattr(args, "supervise", False) or args.telemetry_live:
+        return None
+    args.telemetry_live = True
+    return (
+        "[launch] --supervise needs the live telemetry plane (the "
+        "streaming verdicts are the supervisor's only sensor): "
+        "auto-arming --telemetry-live"
+    )
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -189,10 +208,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.supervise and not args.elastic:
         ap.error("--supervise requires --elastic (the supervisor drives "
                  "the elastic membership coordinator)")
-    if args.supervise:
-        # the supervisor consumes the launcher-resident aggregator's
-        # streaming verdicts: the live plane IS its sensor
-        args.telemetry_live = True
+    notice = arm_supervise_telemetry(args)
+    if notice:
+        print(notice, file=sys.stderr)
     if args.watchdog_timeout < 0:
         ap.error(
             f"--watchdog-timeout must be >= 0, got {args.watchdog_timeout}"

@@ -74,6 +74,16 @@ _KIND_GATHER = 8
 # per peer instead of one per rank — the frame-level analog of the
 # reference's chunked Isend fan-out (parameterserver.cpp:309-353).
 _KIND_UPDATE_MULTI = 7
+# inference-serving RPC pair (torchmpi_tpu.serve): REQUEST rides the
+# same admission/BUSY machinery as UPDATE/TRIGGER (budget exhaustion ->
+# BUSY + retry-after, never unbounded queueing); rank carries the QoS
+# level, rule the request tag. REPLY mirrors SHARD but keeps a distinct
+# kind so serving traffic is separable from shard fetches in telemetry
+# and never confuses the PS client decode path. rule on a REPLY is the
+# status ("ok", or "shed:<retry_ms>" when the server's brownout ladder
+# drops the request).
+_KIND_REQUEST = 10
+_KIND_REPLY = 11
 _MULTI_COUNT = struct.Struct(">I")
 _MULTI_ITEM = struct.Struct(">IQ")
 # the `rank` header field of a multi frame (dedup key sentinel: the frame
@@ -97,6 +107,8 @@ _KIND_NAMES = {
     _KIND_BARRIER: "barrier",
     _KIND_GATHER: "gather",
     _KIND_UPDATE_MULTI: "update_multi",
+    _KIND_REQUEST: "request",
+    _KIND_REPLY: "reply",
 }
 _MET = None
 
@@ -693,6 +705,12 @@ class _Listener:
         self._busy_rejects = 0
         self._accepts = 0
         self._disconnects = 0
+        # inference-serving hook (torchmpi_tpu.serve): when set, REQUEST
+        # frames are admitted through the same budget as updates and
+        # answered by ``handler(rule, qos, payload, pending) ->
+        # (status_rule, result)`` on the apply pool; result may be an
+        # ndarray, bytes, or None. Unset, REQUEST frames get ERROR.
+        self.request_handler = None
         # ONE listener-wide pool for applied-waits and replies, sized
         # from the expected in-flight frames (the PS pool size bounds
         # concurrent applies; 2x covers waits stacked behind them). A
@@ -926,6 +944,32 @@ class _Listener:
             ):
                 self.gather_arrived(rule, client, payload)
             reply(_KIND_ACK, seq)
+            return
+        if kind == _KIND_REQUEST:
+            # serving RPC: rides the UPDATE/TRIGGER admission budget so
+            # inference load and training load shed against the same
+            # bound (the serve tier's own brownout ladder sits above
+            # this, inside the handler)
+            if not self._admit(conn, kind, seq):
+                reply(
+                    _KIND_BUSY, seq,
+                    rule=str(constants.get("ps_busy_retry_ms")),
+                )
+                return
+            fl = None
+            if _flight.enabled():
+                fl = _flight.recorder.record(
+                    f"ps:server:{self.port}", "request",
+                    payload=f"{len(payload)}B", backend="socket",
+                    routing=f"qos={rank},client={client}",
+                )
+            with self._pending_lock:
+                self._pending_frames += 1
+            finish = self._make_finisher(reply, fl)
+            self._submit(
+                self._finish_request, finish, seq, inst_id, rank, client,
+                rule, payload, time.monotonic(),
+            )
             return
         if kind not in (_KIND_UPDATE, _KIND_UPDATE_MULTI, _KIND_TRIGGER):
             reply(_KIND_ERROR, seq, rule=f"bad kind {kind}")
@@ -1295,6 +1339,45 @@ class _Listener:
             dtype=shard.dtype.str, payload=parts, wire=wire_eff,
             nchunks=nchunks,
         )
+
+    def _finish_request(
+        self, finish, seq, inst_id, rank, client, rule, payload, t_admit,
+    ) -> None:
+        """Answer one serving REQUEST on the apply pool. ``rank`` is the
+        QoS level the client put in the header's rank field (serving
+        frames address no shard). The handler sees the listener's live
+        admitted-frame backlog so its brownout ladder can key off queue
+        pressure without a second bookkeeping path."""
+        handler = self.request_handler
+        if handler is None:
+            finish(_KIND_ERROR, seq, rule="no request handler registered")
+            return
+        t_start = time.monotonic()
+        try:
+            with self._pending_lock:
+                pending = self._pending_frames
+            with _telemetry.span("ps.server.apply", kind="request",
+                                 rank=rank):
+                status, result = handler(rule, rank, payload, pending)
+        except Exception as e:  # noqa: BLE001 - reported to the client
+            finish(_KIND_ERROR, seq, rule=f"request handler failed: {e}")
+            return
+        if _telemetry.enabled():
+            met = _srv_metric_handles()
+            met[4].observe(t_start - t_admit, kind="request")
+            met[5].observe(time.monotonic() - t_start, kind="request")
+        if result is None:
+            finish(_KIND_REPLY, seq, inst=inst_id, rank=rank, rule=status)
+        elif isinstance(result, np.ndarray):
+            finish(
+                _KIND_REPLY, seq, inst=inst_id, rank=rank, rule=status,
+                dtype=result.dtype.str, payload=result.tobytes(),
+            )
+        else:
+            finish(
+                _KIND_REPLY, seq, inst=inst_id, rank=rank, rule=status,
+                payload=bytes(result),
+            )
 
     def close(self):
         self._stop.set()
@@ -1827,6 +1910,15 @@ class _PeerChannel:
             _flight.FlightRecorder.complete(w.flight)
         if rkind == _KIND_SHARD:
             return np.frombuffer(rpayload, np.dtype(rdtype)).copy()
+        if rkind == _KIND_REPLY:
+            # serving RPC: (status_rule, decoded result). rrule carries
+            # "ok" / "shed:<retry_ms>"; the result array is absent on a
+            # shed reply.
+            if rdtype:
+                return rrule, np.frombuffer(
+                    rpayload, np.dtype(rdtype)
+                ).copy()
+            return rrule, (bytes(rpayload) if rpayload else None)
         return None  # ACK
 
     def close(self) -> None:
@@ -2241,6 +2333,30 @@ class Transport:
                 )
             out.update(got)
         return out
+
+    def set_request_handler(self, handler) -> None:
+        """Install the serving-tier REQUEST handler on this process's
+        listener (see :attr:`_Listener.request_handler`); ``None``
+        uninstalls it."""
+        self.listener.request_handler = handler
+
+    def serve_request(
+        self, proc: int, rule: str, payload, qos: int = 0,
+    ):
+        """One serving RPC to ``proc``'s request handler: returns
+        ``(status_rule, result)`` where result is an ndarray (array
+        reply), bytes (opaque reply) or None. BUSY backoff/replay is the
+        channel's, same as every other frame kind. Request payloads ship
+        verbatim (no wire codec): the handler contract is raw bytes in,
+        so inference inputs are never quantized by the PS wire dtype."""
+        if isinstance(payload, np.ndarray):
+            raw = np.ascontiguousarray(payload).tobytes()
+        else:
+            raw = bytes(payload) if payload else b""
+        return self.pool.request(
+            proc, _KIND_REQUEST, 0, int(qos), self.process_index,
+            rule=rule, payload_raw=raw,
+        )
 
     def close(self):
         self.pool.close()

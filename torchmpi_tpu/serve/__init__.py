@@ -1,0 +1,34 @@
+"""torchmpi_tpu.serve — replicated inference serving over the PS fabric.
+
+The serving tier closes the loop the seed's training lineage left open:
+replicated workers answer high-QPS inference over the event-multiplexed
+PS transport (REQUEST/REPLY frames riding the same admission/BUSY
+machinery as training traffic) while a background downpour group keeps
+training and publishing weight deltas through the parameter server.
+Servers pick up fresh weights via the delta-fetch path with a
+version-vector swap (:class:`WeightCache`), so a weight refresh never
+pauses serving.
+
+Degradation is a ladder, not a cliff (:func:`brownout_level`): under
+queue pressure a server first sheds its lowest-QoS requests with a
+retry-after hint, then widens the weight-refresh staleness bound, and
+only when the transport admission budget itself is exhausted does the
+listener BUSY everything. The supervisor's scale-up/scale-down rungs
+(``supervise.policy``) react to the same signals fleet-wide; the
+brownout ladder is what holds the line while the fleet is at
+``supervisor_scale_max_world``. See README "Serving & autoscaling".
+"""
+
+from .client import ServeClient, ShedError
+from .server import InferenceServer, brownout_level, shed_qos_floor
+from .weights import WeightCache, version_vector
+
+__all__ = [
+    "InferenceServer",
+    "ServeClient",
+    "ShedError",
+    "WeightCache",
+    "brownout_level",
+    "shed_qos_floor",
+    "version_vector",
+]

@@ -1,0 +1,75 @@
+"""The serving client: REQUEST round trips with shed/retry handling.
+
+BUSY replies (transport admission) are already replayed by the peer
+channel with jittered backoff — a caller never sees them. ``shed:``
+replies are the SERVER's brownout ladder talking: the request was
+admitted but dropped by QoS, and the reply carries the retry-after hint
+the client honors here (bounded; a request shed past the retry budget
+surfaces as :class:`ShedError`, never a silent drop)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class ShedError(RuntimeError):
+    """Raised when a request was brownout-shed past its retry budget."""
+
+    def __init__(self, sheds: int, retry_ms: int):
+        super().__init__(
+            f"request shed {sheds}x by the serving brownout ladder "
+            f"(last retry-after hint {retry_ms}ms)"
+        )
+        self.sheds = sheds
+        self.retry_ms = retry_ms
+
+
+class ServeClient:
+    def __init__(
+        self,
+        transport,
+        proc: int,
+        *,
+        qos: int = 0,
+        tag: str = "infer",
+        rng: Optional[random.Random] = None,
+        sleep=time.sleep,
+    ):
+        self.transport = transport
+        self.proc = proc
+        self.qos = qos
+        self.tag = tag
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+
+    def infer_once(self, x: np.ndarray, qos: Optional[int] = None):
+        """One round trip: ``(status_rule, result_or_None)``."""
+        return self.transport.serve_request(
+            self.proc, self.tag, np.asarray(x, np.float32),
+            qos=self.qos if qos is None else qos,
+        )
+
+    def infer(self, x: np.ndarray, qos: Optional[int] = None,
+              max_sheds: int = 8) -> np.ndarray:
+        """Round trips until an ``ok`` reply, honoring shed retry-after
+        hints with +-50% jitter; raises :class:`ShedError` after
+        ``max_sheds`` consecutive sheds."""
+        retry_ms = 0
+        for attempt in range(max_sheds + 1):
+            status, result = self.infer_once(x, qos=qos)
+            if status == "ok":
+                return result
+            if status.startswith("shed:"):
+                retry_ms = int(status.split(":", 1)[1] or 0)
+                if attempt < max_sheds:
+                    self._sleep(
+                        (retry_ms / 1000.0)
+                        * (0.5 + self._rng.random())
+                    )
+                continue
+            raise RuntimeError(f"unexpected serve reply {status!r}")
+        raise ShedError(max_sheds, retry_ms)

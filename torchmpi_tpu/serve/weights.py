@@ -1,0 +1,86 @@
+"""Atomic serving-weight snapshots keyed by a PS shard version vector.
+
+The downpour group bumps a per-shard version on every applied update
+(``_Instance.versions``); a server's refresh fetch reads the assembled
+tensor plus that vector and swaps both in as ONE reference — request
+handlers read the current ``(weights, versions)`` pair without a lock
+(a single attribute load), so weight refresh never pauses serving and
+no request ever observes weights from one version and metadata from
+another.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+import numpy as np
+
+from ..analysis import lockmon as _lockmon
+
+
+def version_vector(ps, client: int = 0) -> Tuple[int, ...]:
+    """The per-shard version vector a serving fetch pairs with its
+    assembled tensor: local shards read the instance's applied-update
+    counters directly; remote shards read the delta-fetch client cache
+    (the version the last ``receive`` reconstructed against). Remote
+    shards never fetched through the delta path report -1 — the swap
+    treats ANY vector change as fresh, so the degenerate vector still
+    swaps once and then holds."""
+    inst = ps._inst
+    transport = ps._transport
+    vec = []
+    for r in range(inst.size):
+        if inst.has_storage(r):
+            vec.append(int(inst.versions[r]))
+        elif transport is not None:
+            cached = transport._delta_cache.get(
+                (inst.owners[r], inst.id, r, client)
+            )
+            vec.append(int(cached[0]) if cached is not None else -1)
+        else:
+            vec.append(-1)
+    return tuple(vec)
+
+
+class WeightCache:
+    """One snapshot slot: ``(weights, versions)`` swapped atomically.
+
+    Readers call :meth:`get` (no lock: one tuple-reference load);
+    the refresher calls :meth:`swap`, which installs the new pair only
+    when the version vector actually changed — a fetch that raced no
+    training updates is a no-op, keeping the swap counter an honest
+    freshness signal."""
+
+    def __init__(self, weights: np.ndarray, versions=(),
+                 clock=time.monotonic):
+        self._clock = clock
+        self._lock = _lockmon.make_lock("serve/weights.py:WeightCache")
+        self._snap = (np.ascontiguousarray(weights), tuple(versions))
+        self._swapped_at = clock()
+        self.swaps = 0
+
+    def get(self) -> Tuple[np.ndarray, Tuple[int, ...]]:
+        return self._snap
+
+    @property
+    def versions(self) -> Tuple[int, ...]:
+        return self._snap[1]
+
+    def age_s(self) -> float:
+        """Seconds since the last applied swap (the staleness the
+        brownout ladder is allowed to widen)."""
+        with self._lock:
+            return max(0.0, self._clock() - self._swapped_at)
+
+    def swap(self, weights: np.ndarray, versions) -> bool:
+        """Install ``(weights, versions)`` iff the vector changed;
+        returns whether a swap happened."""
+        versions = tuple(versions)
+        with self._lock:
+            if versions == self._snap[1]:
+                return False
+            self._snap = (np.ascontiguousarray(weights), versions)
+            self._swapped_at = self._clock()
+            self.swaps += 1
+            return True

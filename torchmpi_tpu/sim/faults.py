@@ -12,9 +12,13 @@ pair at 1k ranks on every fast-tier run (``scripts/ci.sh`` sim-smoke).
 Event kinds: ``die`` (rank-death wave), ``straggle`` (persistent
 per-step skew), ``partition`` (coordinator + cross-group unreachability,
 optional ``heal_t``), and fleet-level keys ``arrival_spread_s`` (widens
-the barrier-arrival window so a second death can tear a resize) and
+the barrier-arrival window so a second death can tear a resize),
 ``ps`` (attach a modeled PS shard group — servers, replication, client
-load — for BUSY storms and failover dead-mark scenarios).
+load — for BUSY storms and failover dead-mark scenarios) and ``serve``
+(attach a modeled inference-serving tier — an open-loop diurnal
+arrival ``trace``, per-rank ``capacity_qps`` — for traffic-surge
+autoscaling and brownout scenarios; see
+:class:`~.fleet.SimServe`).
 
 Verdicts (:func:`verdict_of`, derived ONLY from the analyzer report):
 
@@ -36,7 +40,7 @@ from typing import Any, Dict, List, Optional, Union
 
 from .. import constants
 from ..telemetry.analyze import analyze, load_run
-from .fleet import SimFleet, SimPS
+from .fleet import SimFleet, SimPS, SimServe
 
 #: packaged scenario library (death_wave.json, straggler.json, ...)
 SCENARIO_DIR = Path(__file__).resolve().parent / "scenarios"
@@ -258,6 +262,50 @@ def check_recovery(expected: dict, supervisor, stats: dict) -> List[str]:
             f"{stats.get('steps_completed', 0)} < "
             f"{expected['resumed_steps_min']}",
         )
+    resizes = stats.get("resizes", [])
+    if "max_resizes" in expected:
+        # the flap bound: formation + every committed scale action is
+        # one resize, so an oscillating trace that saws the world size
+        # blows through this ceiling
+        need(
+            len(resizes) <= expected["max_resizes"],
+            f"recovery: {len(resizes)} resizes > flap bound "
+            f"{expected['max_resizes']}: "
+            f"{[(r['world_old'], r['world_new']) for r in resizes]}",
+        )
+    if "world_peak_min" in expected:
+        peak = max((r["world_new"] for r in resizes), default=0)
+        need(
+            peak >= expected["world_peak_min"],
+            f"recovery: world never grew to "
+            f"{expected['world_peak_min']} (peak {peak}) — scale-up "
+            "did not commit",
+        )
+    if expected.get("world_grew"):
+        # world-size-relative form of world_peak_min (the packaged
+        # scenario runs at whatever --ranks the caller picked):
+        # excluding the cold formation resize, some resize must have
+        # COMMITTED a larger world
+        grew = any(
+            r["world_new"] > r["world_old"]
+            for r in resizes if r["world_old"]
+        )
+        need(grew, "recovery: no committed world growth in "
+             f"{[(r['world_old'], r['world_new']) for r in resizes]}")
+    serve = stats.get("serve") or {}
+    if "serve_shed_min" in expected:
+        need(
+            serve.get("shed", 0) >= expected["serve_shed_min"],
+            f"recovery: brownout shed {serve.get('shed', 0)} requests "
+            f"< {expected['serve_shed_min']} — the ladder never "
+            "engaged",
+        )
+    if "serve_dropped_max" in expected:
+        need(
+            serve.get("dropped", 0) <= expected["serve_dropped_max"],
+            f"recovery: {serve.get('dropped', 0)} requests silently "
+            f"dropped > {expected['serve_dropped_max']}",
+        )
     return failures
 
 
@@ -355,7 +403,23 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
                     ps.get("updates_per_client", 40)
                 ),
             )
+        if "serve" in scn:
+            sv = dict(scn["serve"])
+            SimServe(
+                fleet,
+                trace=sv.get("trace") or [[0.0, 0.0]],
+                capacity_qps=float(sv.get("capacity_qps", 120.0)),
+                tick_s=float(sv.get("tick_s", 0.25)),
+                publish_interval_s=float(
+                    sv.get("publish_interval_s", 0.0)
+                ),
+                start_t=float(sv.get("start_t", 0.0)),
+            )
         stats = fleet.run(horizon_s=float(scn.get("horizon_s", 60.0)))
+        if fleet.serve is not None:
+            # fluid counters carry float dust: the report's rollup is
+            # rounded so the per-seed byte-identity contract holds
+            stats["serve"] = fleet.serve.rollup()
         out = Path(out_dir)
         fleet.dump_telemetry(out)
         run = load_run(out)

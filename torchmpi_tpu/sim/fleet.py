@@ -32,6 +32,8 @@ from ..parameterserver.transport import admission_decision, busy_backoff_s
 from ..reshard.core import Layout, plan_transfers
 from ..reshard.elastic import ElasticCoordinator
 from ..schedule import candidate_plans
+from ..serve.server import brownout_level, shed_qos_floor
+from ..supervise.core import Actuator
 from ..schedule.topology import LINK_HOST, Topology
 from ..schedule.cost import link_alpha_us, link_beta_us_per_mib
 from ..telemetry import flightrecorder as _flight
@@ -118,6 +120,7 @@ class SimFleet:
             sr.rank: sr for sr in self.ranks.values()
         }
         self.ps: Optional[SimPS] = None
+        self.serve: Optional["SimServe"] = None
         self.hangs: List[dict] = []
         self.stats: Dict[str, Any] = {
             "world": int(world), "seed": int(seed),
@@ -236,6 +239,26 @@ class SimFleet:
             if sr is not None:
                 sr.skew_s = float(skew_s)
         self.loop.at(t, _skew)
+
+    def spawn(self) -> SimRank:
+        """Admit one NEW simulated host mid-run — the scale-up
+        actuator's lever. A real coordinator ``join`` (its epoch bump
+        drives the live resize through the same sweep/barrier path a
+        death does), a fresh :class:`SimRank` at the next unused rank
+        number, heartbeating from the next beat tick on."""
+        rep = self.coord._handle(
+            {"op": "join", "host": "sim", "data_port": 0}
+        )
+        mid = int(rep["mid"])
+        rank = 1 + max(
+            (sr.rank for sr in self.ranks.values()), default=-1
+        )
+        sr = SimRank(mid, rank)
+        sr.last_beat = self.loop.now
+        self.ranks[mid] = sr
+        self._rank_index[rank] = sr
+        self.stats["spawns"] = self.stats.get("spawns", 0) + 1
+        return sr
 
     def _by_rank(self, rank: int) -> Optional[SimRank]:
         return self._rank_index.get(rank)
@@ -649,7 +672,7 @@ class SimFleet:
         return out
 
 
-class SimActuator:
+class SimActuator(Actuator):
     """The supervisor's levers over a simulated fleet — the exact
     semantics of the launcher's actuator, on the virtual clock:
 
@@ -658,9 +681,14 @@ class SimActuator:
       coordinator ``evict`` op (the epoch bump drives the live shrink),
       and drop its fleet view (``mark_evicted``) so verdicts stop
       charging the job with a buried corpse;
-    - ``grow``: unsupported — the simulator cannot spawn hosts; the
-      failure is a counted attempt, exactly what a launcher whose spawn
-      hook fails would journal;
+    - ``grow``: admit one fresh simulated host through the REAL
+      coordinator ``join`` (:meth:`SimFleet.spawn`) — the epoch bump
+      drives the live grow-resize, and the new rank starts serving /
+      heartbeating on the next tick;
+    - ``scale_up`` / ``scale_down``: inherited from the real
+      :class:`~..supervise.core.Actuator` delegation (grow/evict) —
+      the load rungs drive the SAME membership levers the failure
+      rungs do, in sim as in the launcher;
     - ``rollback``: record the decision in ``fleet.stats['rollback']``
       and kill the world (in production the launcher's
       ``--max-restarts`` loop then relaunches from the registered
@@ -689,7 +717,7 @@ class SimActuator:
         return bool(rep.get("ok", True))
 
     def grow(self, reason: str) -> bool:
-        return False
+        return self.fleet.spawn() is not None
 
     def rollback(self, reason: str) -> bool:
         self.fleet.stats["rollback"] = {
@@ -970,3 +998,190 @@ class SimPS:
         self.fleet.loop.after(
             self.interval_s, self._send, c, seq + 1, 0
         )
+
+
+# ---------------------------------------------------------------------------
+# modeled inference-serving tier (real brownout ladder + admission policy)
+# ---------------------------------------------------------------------------
+
+
+class SimServe:
+    """A modeled inference-serving tier riding the fleet: an OPEN-LOOP
+    diurnal arrival trace — piecewise-linear ``[t, qps]`` knots, where
+    ``qps`` is load **per formation rank** so the same scenario file
+    stresses a 64-rank test and a 10k-rank smoke identically — spreads
+    requests across every live rank. Each rank runs one fluid queue
+    degraded through the REAL brownout ladder
+    (:func:`~..serve.server.brownout_level` /
+    :func:`~..serve.server.shed_qos_floor` against the
+    ``serve_queue_budget`` knob: shed the lowest QoS classes with
+    retry-after, widen the weight-refresh staleness bound, only then
+    BUSY at the transport's ``ps_pending_frame_budget`` — BUSY'd
+    arrivals retry next tick, so an open-loop surge is never silently
+    dropped). Metrics land in the per-rank registries under the exact
+    live names (``tm_serve_requests_total``, ``tm_serve_queue_depth``,
+    ``tm_ps_busy_rejected_total``, ...), so the live aggregator derives
+    its load verdicts (overload / underload) from the same series a
+    real serving fleet ships — which is how the ``traffic_surge``
+    scenario proves the scale-up/scale-down rungs and the
+    brownout-before-drop contract, byte-identically per seed.
+
+    A background trainer is modeled by ``publish_interval_s``: the
+    published weight version advances on that cadence and every serving
+    rank picks it up on its (brownout-widened) refresh cycle — the
+    ``tm_serve_weight_*`` families the live run ships."""
+
+    def __init__(self, fleet: SimFleet, trace, capacity_qps: float = 120.0,
+                 tick_s: float = 0.25, publish_interval_s: float = 0.0,
+                 start_t: float = 0.0):
+        self.fleet = fleet
+        knots = [(float(t), float(q)) for t, q in (trace or [[0.0, 0.0]])]
+        self.trace = sorted(knots)
+        self.capacity = float(capacity_qps)
+        self.tick_s = max(1e-3, float(tick_s))
+        self.publish_interval_s = float(publish_interval_s)
+        self.start_t = float(start_t)
+        #: per-formation-rank trace -> total arrivals scale with the
+        #: FORMATION world, so scaling up genuinely dilutes the load
+        self.world0 = max(1, len(fleet.ranks))
+        # rank -> [queue_depth, busy_carry, fetched_version, next_fetch_t]
+        self._st: Dict[int, list] = {}
+        self._mh: Dict[int, tuple] = {}  # rank -> cached metric handles
+        self.stats = {
+            "requests": 0.0, "ok": 0.0, "shed": 0.0, "busy": 0.0,
+            "dropped": 0.0, "slo_breaches": 0.0, "swaps": 0,
+            "peak_level": 0, "peak_queue": 0.0,
+        }
+        fleet.serve = self
+        fleet.stats["serve"] = self.stats
+        fleet.loop.at(self.start_t, self._tick)
+
+    def _qps_per_rank(self, t: float) -> float:
+        """Piecewise-linear interpolation over the trace knots (flat
+        beyond both ends)."""
+        ks = self.trace
+        if t <= ks[0][0]:
+            return ks[0][1]
+        for (t0, q0), (t1, q1) in zip(ks, ks[1:]):
+            if t <= t1:
+                if t1 <= t0:
+                    return q1
+                return q0 + (q1 - q0) * (t - t0) / (t1 - t0)
+        return ks[-1][1]
+
+    def _handles(self, sr: SimRank) -> tuple:
+        h = self._mh.get(sr.rank)
+        if h is None:
+            reg = sr.metrics()
+            h = (
+                reg.counter("tm_serve_requests_total",
+                            "inference requests by result"),
+                reg.histogram("tm_serve_latency_seconds",
+                              "request sojourn time"),
+                reg.counter("tm_serve_slo_breaches_total",
+                            "requests served over serve_slo_ms"),
+                reg.gauge("tm_serve_queue_depth",
+                          "pending inference requests"),
+                reg.gauge("tm_serve_brownout_level",
+                          "current brownout ladder rung"),
+                reg.counter("tm_ps_busy_rejected_total",
+                            "frames rejected by the admission budget"),
+                reg.counter("tm_serve_weight_swaps_total",
+                            "weight snapshot swaps applied"),
+                reg.gauge("tm_serve_weight_version",
+                          "summed shard versions of the live snapshot"),
+                reg.counter("tm_serve_weight_fetches_total",
+                            "weight refresh attempts by outcome"),
+            )
+            self._mh[sr.rank] = h
+        return h
+
+    def _serving(self) -> List[SimRank]:
+        out = [
+            sr for sr in self.fleet.ranks.values()
+            if sr.alive and not sr.partitioned and not sr.evicted
+        ]
+        out.sort(key=lambda sr: sr.rank)
+        return out
+
+    def _tick(self) -> None:
+        if self.fleet._finished:
+            return
+        t = self.fleet.loop.now
+        dt = self.tick_s
+        serving = self._serving()
+        if serving:
+            per = self._qps_per_rank(t) * self.world0 / len(serving) * dt
+            budget = int(constants.get("serve_queue_budget"))
+            admit_budget = int(constants.get("ps_pending_frame_budget"))
+            qos_levels = int(constants.get("serve_qos_levels"))
+            slo_s = float(constants.get("serve_slo_ms")) / 1000.0
+            refresh = float(constants.get("serve_refresh_interval_s"))
+            widen = float(
+                constants.get("serve_brownout_staleness_factor")
+            )
+            published = 1 if self.publish_interval_s <= 0 else 1 + int(
+                max(0.0, t - self.start_t) / self.publish_interval_s
+            )
+            s = self.stats
+            s["requests"] += per * len(serving)
+            for sr in serving:
+                st = self._st.setdefault(sr.rank, [0.0, 0.0, 0, 0.0])
+                q, carry = st[0], st[1]
+                c_req, h_lat, c_breach, g_q, g_lvl, c_busy, c_swap, \
+                    g_ver, c_fetch = self._handles(sr)
+                # the ladder, in the real rung order: brownout level
+                # from the queue the handler sees, shed below the QoS
+                # floor, and only past the transport admission budget
+                # BUSY (retried next tick: open-loop, never dropped)
+                level = brownout_level(q, budget)
+                arrivals = per + carry
+                room = max(0.0, admit_budget - q)
+                admitted = min(arrivals, room)
+                busy_n = arrivals - admitted
+                shed_n = admitted * (
+                    shed_qos_floor(level, qos_levels) / qos_levels
+                )
+                q += admitted - shed_n
+                sojourn = q / self.capacity if self.capacity > 0 else 0.0
+                done = min(q, self.capacity * dt)
+                q -= done
+                st[0], st[1] = q, busy_n
+                if done > 0:
+                    c_req.inc(done, result="ok")
+                    h_lat.observe(sojourn)
+                    if sojourn > slo_s:
+                        c_breach.inc(done)
+                        s["slo_breaches"] += done
+                if shed_n > 0:
+                    c_req.inc(shed_n, result="shed")
+                if busy_n > 0:
+                    c_busy.inc(busy_n, listener=str(sr.rank))
+                g_q.set(round(q, 6))
+                g_lvl.set(level)
+                s["ok"] += done
+                s["shed"] += shed_n
+                s["busy"] += busy_n
+                s["peak_level"] = max(s["peak_level"], level)
+                s["peak_queue"] = max(s["peak_queue"], q)
+                # weight refresh on the (brownout-widened) cadence
+                if t >= st[3]:
+                    if st[3] > 0.0:  # not the priming fetch
+                        if st[2] < published:
+                            st[2] = published
+                            c_swap.inc()
+                            g_ver.set(published)
+                            c_fetch.inc(outcome="swap")
+                            s["swaps"] += 1
+                        else:
+                            c_fetch.inc(outcome="same")
+                    st[3] = t + refresh * (widen if level >= 2 else 1.0)
+        self.fleet.loop.after(dt, self._tick)
+
+    def rollup(self) -> Dict[str, Any]:
+        """The deterministic JSON-stable summary the scenario report
+        carries (floats rounded: fluid counts)."""
+        out = {}
+        for k, v in self.stats.items():
+            out[k] = round(v, 3) if isinstance(v, float) else v
+        return out

@@ -10,6 +10,10 @@ maps each streaming verdict to a bounded remediation —
     desync / resize-torn  -> checkpoint rollback (kill the world,
                              relaunch from the last registered
                              checkpoint_every artifact)
+    overload              -> scale-up (live grow through the elastic
+                             coordinator; the serving brownout ladder
+                             holds the line at max world)
+    underload             -> scale-down (retire the highest live rank)
     clean (persisting)    -> grow back (opt-in)
 
 with hysteresis, jittered bounded retries, and an escalation ladder.
@@ -32,6 +36,8 @@ from .policy import (  # noqa: F401
     A_GROW,
     A_QUARANTINE,
     A_ROLLBACK,
+    A_SCALE_DOWN,
+    A_SCALE_UP,
     PolicyRule,
     default_policy,
 )
@@ -40,4 +46,5 @@ __all__ = [
     "Actuator", "RecoverySupervisor", "PolicyRule", "default_policy",
     "register_checkpoint", "last_checkpoint", "describe_last",
     "A_EVICT", "A_GROW", "A_QUARANTINE", "A_ROLLBACK",
+    "A_SCALE_UP", "A_SCALE_DOWN",
 ]

@@ -52,6 +52,8 @@ from .policy import (
     A_GROW,
     A_QUARANTINE,
     A_ROLLBACK,
+    A_SCALE_DOWN,
+    A_SCALE_UP,
     PolicyRule,
     default_policy,
 )
@@ -70,6 +72,15 @@ class Actuator:
 
     def rollback(self, reason: str) -> bool:
         raise NotImplementedError
+
+    # load-driven resizes default to the failure-driven primitives: an
+    # actuator that can grow/evict can already scale, and one that wants
+    # different mechanics (warm pools, draining) overrides these
+    def scale_up(self, reason: str) -> bool:
+        return self.grow(reason)
+
+    def scale_down(self, ranks: List[int], reason: str) -> bool:
+        return self.evict(ranks, reason)
 
 
 class RecoverySupervisor:
@@ -93,6 +104,18 @@ class RecoverySupervisor:
             constants.get("supervisor_quarantine_cooldown_s")
             if quarantine_cooldown_s is None else quarantine_cooldown_s
         )
+        # scale-rung flap damping (read at construction, same contract
+        # as default_policy: the launcher applies --set-constant first)
+        self._scale_cooldown = float(
+            constants.get("supervisor_scale_cooldown_s")
+        )
+        self._scale_max_world = int(
+            constants.get("supervisor_scale_max_world")
+        )
+        self._scale_min_world = max(
+            1, int(constants.get("supervisor_scale_min_world"))
+        )
+        self._last_scale_t = float("-inf")
         # one lock covers every mutable field: the observe loop (the
         # launcher's supervisor thread / the sim tick) mutates while the
         # aggregator's HTTP threads render /actions and /metrics — an
@@ -154,8 +177,13 @@ class RecoverySupervisor:
         rule = self.policy.get("clean")
         if rule is not None:
             return rule.hysteresis
+        # scale rungs excluded: scale-down's deliberately long
+        # hysteresis is capacity flap damping, not a bar recovery must
+        # clear before fault ladders reset
         return max(
-            (r.hysteresis for r in self.policy.values()), default=1
+            (r.hysteresis for r in self.policy.values()
+             if r.action not in (A_SCALE_UP, A_SCALE_DOWN)),
+            default=1,
         )
 
     # -- acting -------------------------------------------------------------
@@ -176,6 +204,21 @@ class RecoverySupervisor:
                 # hammer a rollback path that keeps failing
         if action == A_GROW and not self._want_grow(doc):
             return []
+        if action in (A_SCALE_UP, A_SCALE_DOWN):
+            if now - self._last_scale_t < self._scale_cooldown:
+                return []  # flap damping: one resize per cooldown, max
+            world = len(doc.get("ranks", []))
+            if action == A_SCALE_UP and self._scale_max_world and (
+                world >= self._scale_max_world
+            ):
+                # at the ceiling the supervisor HOLDS: the serving
+                # tier's brownout ladder degrades gracefully instead of
+                # the fleet collapsing under a grow it cannot satisfy
+                return []
+            if action == A_SCALE_DOWN and (
+                world - 1 < self._scale_min_world
+            ):
+                return []
         targets = self._targets(action, verdict, doc)
         entry = {
             "time": round(now, 6),
@@ -225,6 +268,26 @@ class RecoverySupervisor:
             if action == A_GROW:
                 return "applied" if self.actuator.grow(reason=verdict) \
                     else "failed"
+            if action == A_SCALE_UP:
+                ok = self.actuator.scale_up(reason=verdict)
+                if ok:
+                    self._last_scale_t = now
+                return "applied" if ok else "failed"
+            if action == A_SCALE_DOWN:
+                ok = True
+                if targets:
+                    ok = self.actuator.scale_down(targets, reason=verdict)
+                if ok:
+                    self.evicted.update(targets)
+                    self._last_scale_t = now
+                    # a deliberate shrink lowers the observed high-water
+                    # mark: grow-back must not fight scale-down by
+                    # restoring capacity the load no longer needs
+                    self._world_high = max(
+                        self._scale_min_world,
+                        self._world_high - len(targets),
+                    )
+                return "applied" if ok else "failed"
             if action == A_ROLLBACK:
                 ok = self.actuator.rollback(reason=verdict)
                 if ok:
@@ -236,11 +299,18 @@ class RecoverySupervisor:
 
     # -- target selection ---------------------------------------------------
     def _targets(self, action: str, verdict: str, doc: dict) -> List[int]:
-        if action in (A_ROLLBACK, A_GROW):
+        if action in (A_ROLLBACK, A_GROW, A_SCALE_UP):
             return []
         fresh = lambda rs: sorted(  # noqa: E731
             {int(r) for r in rs} - self.evicted
         )
+        if action == A_SCALE_DOWN:
+            # retire the HIGHEST live rank: the elastic world contracts
+            # from the top, so the shrink commits without renumbering
+            live = fresh(doc.get("ranks") or [])
+            if len(live) <= self._scale_min_world:
+                return []
+            return [live[-1]]
         if verdict == "rank-dead":
             return fresh(doc.get("dead_ranks") or [])
         if verdict == "hang":

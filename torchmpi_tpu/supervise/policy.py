@@ -27,11 +27,23 @@ hang                evict-shrink   rollback
 rank-dead           evict-shrink   rollback
 resize-incomplete   evict-shrink   rollback
 straggler           quarantine     (none: advisory eviction)
+overload            scale-up       (none: at max world the
+                                   serving brownout ladder
+                                   degrades instead)
 ps-overload         (observe)      (none: admission control
                                    already sheds the load)
+underload           scale-down     (none)
 clean               grow-back      (opt-in via
                                    supervisor_grow_back)
 ==================  =============  ==========================
+
+The scale rungs are the AMBITIOUS half of the ladder: every other rung
+reacts to failure, these react to load (the serving tier's streaming
+load verdicts). Flap damping is layered — asymmetric hysteresis
+(``supervisor_scale_up_hysteresis`` fast, ``supervisor_scale_down_``
+``hysteresis`` slow) plus a shared cooldown
+(``supervisor_scale_cooldown_s``) between ANY two applied scale
+actions, so an oscillating arrival trace cannot saw the world size.
 """
 
 from __future__ import annotations
@@ -46,6 +58,8 @@ A_EVICT = "evict-shrink"
 A_QUARANTINE = "quarantine"
 A_ROLLBACK = "rollback"
 A_GROW = "grow-back"
+A_SCALE_UP = "scale-up"
+A_SCALE_DOWN = "scale-down"
 
 
 @dataclass(frozen=True)
@@ -91,6 +105,23 @@ def default_policy() -> Dict[str, PolicyRule]:
         # ps-overload is absent on purpose: BUSY/backoff admission
         # control is the load-shedding mechanism; killing servers under
         # load would amplify the storm
+        #
+        # the load rungs (serving tier): scale-up reacts faster than
+        # scale-down by construction — asymmetric hysteresis is the
+        # first line of flap damping, the supervisor's shared scale
+        # cooldown the second
+        "overload": rule(
+            A_SCALE_UP,
+            hysteresis=int(
+                constants.get("supervisor_scale_up_hysteresis")
+            ),
+        ),
+        "underload": rule(
+            A_SCALE_DOWN,
+            hysteresis=int(
+                constants.get("supervisor_scale_down_hysteresis")
+            ),
+        ),
     }
     if bool(constants.get("supervisor_grow_back")):
         # grow back only after the fleet has been CLEAN for the same

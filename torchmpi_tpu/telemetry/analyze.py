@@ -377,11 +377,24 @@ def _kind_series(metrics: dict, name: str) -> dict:
     return out
 
 
-def ps_health(ranks: Dict[int, dict]) -> dict:
+def ps_health(
+    ranks: Dict[int, dict], prev: Optional[dict] = None,
+    interval_s: Optional[float] = None,
+) -> dict:
     """Per-server RPC latency quantiles, queue depth over time,
     connection lifecycle, admission control, and the server-side
     queue-vs-apply attribution (where an RPC's latency went: waiting for
-    a pool worker, or applying the rule)."""
+    a pool worker, or applying the rule).
+
+    BUSY rejects are reported both as the integral (``busy_rejected``,
+    summed over listeners — what the overload verdict historically keyed
+    on) and per listener (``busy_by_listener``). With ``prev`` (the
+    ``servers`` dict of the previous call) and the elapsed
+    ``interval_s``, each server also carries ``busy_rate_per_s`` — the
+    per-listener ROLLING rate over the window, which is what the load
+    verdict and ``top`` trend on: a high integral from a storm an hour
+    ago is history, a high rate is load NOW."""
+    prev = prev or {}
     servers = {}
     for rank, data in sorted(ranks.items()):
         metrics = data["snapshot"].get("metrics", {})
@@ -417,10 +430,16 @@ def ps_health(ranks: Dict[int, dict]) -> dict:
             series = metrics.get(name, {}).get("series", {})
             if series:
                 connections[key] = sum(series.values())
+        busy_by_listener: Dict[str, float] = {}
+        for label_str, v in metrics.get(
+            "tm_ps_busy_rejected_total", {}
+        ).get("series", {}).items():
+            lst = _series_labels(label_str).get("listener", label_str)
+            busy_by_listener[lst] = busy_by_listener.get(lst, 0) + v
         listener = metrics.get("ps_listener")
         timeline = metrics.get("ps_queue_timeline") or []
         if rpc or listener or timeline or attribution or connections:
-            servers[str(rank)] = {
+            entry = {
                 "rpc_latency": rpc,
                 "server_time": attribution,
                 "connections": connections or None,
@@ -430,6 +449,20 @@ def ps_health(ranks: Dict[int, dict]) -> dict:
                     (p.get("queue_depth") or 0 for p in timeline), default=None
                 ) if timeline else None,
             }
+            if busy_by_listener:
+                entry["busy_by_listener"] = busy_by_listener
+                if interval_s:
+                    prev_b = (
+                        prev.get(str(rank)) or {}
+                    ).get("busy_by_listener") or {}
+                    entry["busy_rate_per_s"] = {
+                        lst: round(
+                            max(0.0, v - prev_b.get(lst, 0)) / interval_s,
+                            3,
+                        )
+                        for lst, v in busy_by_listener.items()
+                    }
+            servers[str(rank)] = entry
     return {"servers": servers}
 
 

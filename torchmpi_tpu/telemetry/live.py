@@ -80,10 +80,15 @@ _LEN = struct.Struct("!I")
 #: diff a rolling window, not history
 MAX_ENTRIES_PER_COMM = 256
 
-#: live verdict names, in priority order (first present wins)
+#: live verdict names, in priority order (first present wins).
+#: ``overload`` sits ABOVE ``ps-overload``: when a serving tier is
+#: present its BUSY/shed traffic lands in the same admission counters,
+#: and the actionable rung (scale-up) must win over the observe-only
+#: ps-overload finding. ``underload`` is last — any problem beats the
+#: suggestion to shrink.
 VERDICT_PRIORITY = (
     "desync", "resize-torn", "hang", "rank-dead", "resize-incomplete",
-    "straggler", "ps-overload",
+    "straggler", "overload", "ps-overload", "underload",
 )
 
 
@@ -424,6 +429,18 @@ class FleetAggregator:
         # journal serves on /actions and its tm_supervisor_* lines ride
         # the /metrics passthrough
         self.supervisor = None
+        # load-verdict trend state (serving tier): the previous window's
+        # fleet counter totals + ps_health servers dict, advanced at
+        # most once per live interval — /verdicts scrapes between ticks
+        # reuse the stored sample instead of corrupting the window
+        self._load_prev: Optional[dict] = None
+        self._load_sample: Optional[dict] = None
+        # per-listener BUSY-rate baseline (every fleet, serving or not):
+        # the previous ps_health servers dict + its evaluation time,
+        # from which ps_health derives busy_rate_per_s, and the per-rank
+        # rate rollup the /health rows and `top` display
+        self._ps_rate_prev: Optional[dict] = None
+        self._busy_rates: Dict[str, float] = {}
 
     def attach_supervisor(self, supervisor) -> None:
         """Expose a :class:`~..supervise.RecoverySupervisor` on the
@@ -442,6 +459,11 @@ class FleetAggregator:
         membership already dropped)."""
         with self._lock:
             self.ranks.pop(rank, None)
+            # re-baseline the load window: the popped view's counters
+            # vanish from the fleet totals, and a clamped-to-zero delta
+            # would read as a traffic collapse (phantom underload)
+            self._load_prev = None
+            self._load_sample = None
         self._clear_dead_marker(rank)
 
     # -- ingestion ---------------------------------------------------------
@@ -559,7 +581,28 @@ class FleetAggregator:
             }
         desync = detect_desync(ranks)
         stragglers = rank_stragglers(ranks)
-        ps = ps_health(ranks)
+        interval = float(constants.get("telemetry_live_interval_s"))
+        with self._lock:
+            rate_prev = self._ps_rate_prev
+        ps = ps_health(
+            ranks,
+            prev=rate_prev["servers"] if rate_prev else None,
+            interval_s=(now - rate_prev["t"])
+            if rate_prev and now > rate_prev["t"] else None,
+        )
+        if rate_prev is None or (now - rate_prev["t"]) >= 0.5 * interval:
+            with self._lock:
+                self._ps_rate_prev = {
+                    "t": now, "servers": ps.get("servers", {}),
+                }
+                self._busy_rates = {
+                    r: round(
+                        sum((e.get("busy_rate_per_s") or {}).values()), 3
+                    )
+                    for r, e in ps.get("servers", {}).items()
+                    if e.get("busy_rate_per_s")
+                }
+        load = self._load_trends(ranks, now)
         resize = analyze_resizes(
             {"ranks": ranks, "heartbeats": {
                 str(r): {"time": t} for r, (t, _, _) in rank_meta.items()
@@ -610,7 +653,9 @@ class FleetAggregator:
             "rank-dead": bool(dead),
             "resize-incomplete": resize.get("status") == "incomplete",
             "straggler": bool(stragglers.get("significant")),
+            "overload": bool(load and load.get("overload")),
             "ps-overload": self._ps_overloaded(ps),
+            "underload": bool(load and load.get("underload")),
         }
         verdict = next(
             (v for v in VERDICT_PRIORITY if present[v]), "clean"
@@ -627,8 +672,9 @@ class FleetAggregator:
             "stragglers": stragglers,
             "resize": resize,
             "ps": ps,
+            "load": load,
             "summary": self._summary(
-                verdict, desync, stragglers, dead, stuck, resize,
+                verdict, desync, stragglers, dead, stuck, resize, load,
             ),
         }
         with self._lock:
@@ -654,9 +700,107 @@ class FleetAggregator:
                     return True
         return False
 
+    # -- load verdicts (serving tier) ----------------------------------
     @staticmethod
-    def _summary(verdict, desync, stragglers, dead, stuck, resize
-                 ) -> List[str]:
+    def _load_totals(ranks: Dict[int, dict]) -> Optional[dict]:
+        """Fleet-wide serving-tier counter totals, or None when no rank
+        reports a ``tm_serve_*`` family — fleets without a serving tier
+        never see load verdicts (training-only jobs keep the PR 12/14
+        behavior bit for bit)."""
+        tot = {"requests": 0.0, "shed": 0.0, "breaches": 0.0,
+               "busy": 0.0, "queue": 0.0, "serve_ranks": 0}
+        present = False
+        for data in ranks.values():
+            met = data["snapshot"].get("metrics", {})
+            fam = met.get("tm_serve_requests_total")
+            if isinstance(fam, dict):
+                present = True
+                tot["serve_ranks"] += 1
+                for label, v in (fam.get("series") or {}).items():
+                    if "shed" in label:
+                        tot["shed"] += v
+                    else:
+                        tot["requests"] += v
+            for name, key in (
+                ("tm_serve_slo_breaches_total", "breaches"),
+                ("tm_ps_busy_rejected_total", "busy"),
+                ("tm_serve_queue_depth", "queue"),
+            ):
+                series = (met.get(name) or {}).get("series")
+                if series:
+                    tot[key] += sum(series.values())
+        return tot if present else None
+
+    def _load_trends(self, ranks: Dict[int, dict],
+                     now: float) -> Optional[dict]:
+        """Incremental load sample over the live window: SLO-burn rate,
+        BUSY/shed-rate trend, queue-growth trend, per-rank QPS — the
+        three signals the scale-up/scale-down rungs act on, computed
+        from the frames the aggregator already receives (no new wire
+        traffic). The window advances at most once per live interval;
+        calls between ticks (HTTP scrapes hit :meth:`evaluate` too)
+        return the stored sample unchanged."""
+        tot = self._load_totals(ranks)
+        interval = float(constants.get("telemetry_live_interval_s"))
+        with self._lock:
+            prev = self._load_prev
+            sample = self._load_sample
+            if tot is None:
+                self._load_prev = None
+                self._load_sample = None
+                return None
+            if prev is not None and (now - prev["t"]) < 0.5 * interval:
+                return sample
+            if prev is None:
+                self._load_prev = {"t": now, **tot}
+                return sample
+            dt = now - prev["t"]
+            n = max(1, tot["serve_ranks"])
+            # counter deltas clamp at zero: a restarted rank's counters
+            # reset, and a negative delta is noise, not negative load
+            served = max(0.0, tot["requests"] - prev["requests"])
+            shed = max(0.0, tot["shed"] - prev["shed"])
+            breaches = max(0.0, tot["breaches"] - prev["breaches"])
+            busy = max(0.0, tot["busy"] - prev["busy"])
+            qgrow = (tot["queue"] - prev["queue"]) / dt / n
+            qps = (served + shed) / dt / n
+            burn = breaches / served if served else 0.0
+            # shed replies count into the reject-rate trend: brownout
+            # shedding IS the serving tier reporting overload
+            busy_rate = (busy + shed) / dt / n
+            overload = (
+                burn > float(constants.get("serve_slo_burn_threshold"))
+                or busy_rate > float(
+                    constants.get("serve_overload_busy_rate")
+                )
+                or qgrow > float(
+                    constants.get("serve_queue_growth_per_s")
+                )
+            )
+            underload = (
+                not overload
+                and breaches == 0 and busy == 0 and shed == 0
+                and qgrow <= 0
+                and qps < float(constants.get("serve_underload_qps"))
+            )
+            sample = {
+                "window_s": round(dt, 6),
+                "serve_ranks": tot["serve_ranks"],
+                "qps_per_rank": round(qps, 3),
+                "slo_burn": round(burn, 4),
+                "busy_rate_per_s": round(busy_rate, 3),
+                "queue_growth_per_s": round(qgrow, 3),
+                "shed_per_s": round(shed / dt / n, 3),
+                "overload": overload,
+                "underload": underload,
+            }
+            self._load_sample = sample
+            self._load_prev = {"t": now, **tot}
+            return sample
+
+    @staticmethod
+    def _summary(verdict, desync, stragglers, dead, stuck, resize,
+                 load=None) -> List[str]:
         lines = [f"verdict: {verdict}"]
         div = desync.get("first_divergence")
         if div is None:
@@ -697,6 +841,14 @@ class FleetAggregator:
             if info.get("failed"):
                 detail.append(f"failed on {info['failed']}")
             lines.append(f"resize: epoch {ep} " + "; ".join(detail))
+        if load is not None:
+            lines.append(
+                f"load: {load['qps_per_rank']}/s/rank "
+                f"burn={load['slo_burn']} "
+                f"busy/s={load['busy_rate_per_s']} "
+                f"queue{'+' if load['queue_growth_per_s'] >= 0 else ''}"
+                f"{load['queue_growth_per_s']}/s"
+            )
         return lines
 
     # -- health / prometheus ------------------------------------------------
@@ -776,6 +928,10 @@ class FleetAggregator:
                 "seq_lag": lag,
                 "step_p50_ms": step_p50_ms,
                 "busy_rejected": busy,
+                # rolling per-window rate (summed over this rank's
+                # listeners), captured by the last evaluate(): the trend
+                # `top` and the load verdict key on, vs the integral
+                "busy_rate_per_s": self._busy_rates.get(str(rank)),
                 "resize_epoch": rv["resize_epoch"],
                 "ps_dominant": dominant,
                 "spans_dropped": rv["spans"].get("dropped", 0),

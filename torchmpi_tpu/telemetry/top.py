@@ -8,8 +8,8 @@ endpoints (``launch --telemetry-live`` prints the address):
 
 Each refresh fetches ``/health`` + ``/verdicts`` and renders one row
 per rank — last-report age, flight seq high-water and lag behind the
-fleet, step p50, BUSY reject count, resize epoch, dominant PS latency
-term — under the streaming verdict summary. ``--once`` prints a single
+fleet, step p50, BUSY reject count and rolling per-second rate, resize
+epoch, dominant PS latency term — under the streaming verdict summary. ``--once`` prints a single
 frame (scripts/tests); the default loops every ``--interval`` seconds,
 clearing the screen between frames. Stdlib-only (urllib).
 """
@@ -52,8 +52,8 @@ def render(health: dict, verdicts: dict) -> str:
     lines.append("")
     header = (
         f"{'rank':>5} {'age_s':>7} {'seq_hw':>8} {'lag':>5} "
-        f"{'step_p50':>9} {'busy':>6} {'epoch':>6} {'ps_term':>8} "
-        f"{'state':>6}"
+        f"{'step_p50':>9} {'busy':>6} {'busy/s':>7} {'epoch':>6} "
+        f"{'ps_term':>8} {'state':>6}"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -67,6 +67,7 @@ def render(health: dict, verdicts: dict) -> str:
             f"{_fmt(row.get('seq_lag'), 5)} "
             f"{_fmt(row.get('step_p50_ms'), 9, 'ms')} "
             f"{_fmt(row.get('busy_rejected'), 6)} "
+            f"{_fmt(row.get('busy_rate_per_s'), 7)} "
             f"{_fmt(row.get('resize_epoch'), 6)} "
             f"{_fmt(row.get('ps_dominant'), 8)} {state:>6}"
         )
