@@ -1800,6 +1800,368 @@ def _ps_fleet(check: bool = False, clients: str = "", window_s: float = 1.2):
     return 0 if ok else 1
 
 
+class _ReadFleetMembers:
+    """A 3-process replica-chain member set for ``--ps-fleet
+    --read-mix``: three real ``_Instance``s (owners=[0, 1, 2], so rank
+    0's chain is [0, 1, 2] at replication 3) each behind its own
+    listener + serve thread, with in-order chain pumps forwarding
+    applied updates head -> middle -> tail BEFORE acking (the
+    ack-after-chain-apply contract the RYW audit leans on).
+
+    ``serve_pace_s`` > 0 rate-paces each member's message intake (one
+    sleep per posted mailbox message, on that member's listener loop
+    thread) — the same fixed-capacity service model as the
+    ``--ps-microbench`` rate-paced loopback link. On a single-core CI
+    box wall-clock parallelism can't show the fleet effect, but paced
+    sleeps release the GIL, so three members genuinely serve ~3x the
+    aggregate: the curve then measures the READ PATH's routing (how
+    much of that aggregate capacity replica-spread fetches can reach)
+    instead of the box's core count."""
+
+    def __init__(
+        self, inst_id: int, rep: int, elems: int,
+        serve_pace_s: float = 0.0,
+    ):
+        import threading
+
+        import numpy as np
+
+        from torchmpi_tpu import constants
+        from torchmpi_tpu.parameterserver import transport as T
+        from torchmpi_tpu.parameterserver.server import _Instance
+
+        constants.set("ps_replication", rep)
+        self.inst_id = inst_id
+        self.elems = elems
+        full = np.zeros(3 * elems, np.float32)
+        self.insts = [
+            _Instance(inst_id, full, 3, owners=[0, 1, 2], my_proc=p)
+            for p in range(3)
+        ]
+        if serve_pace_s > 0:
+            for inst in self.insts:
+
+                def post(server_rank, msg, _orig=inst.post):
+                    time.sleep(serve_pace_s)
+                    _orig(server_rank, msg)
+
+                inst.post = post
+        self.lsts = [
+            T._Listener(lambda i, _inst=inst: _inst) for inst in self.insts
+        ]
+        self.addresses = {
+            p: ("127.0.0.1", self.lsts[p].port) for p in range(3)
+        }
+        self.chain = list(self.insts[0].chains[0])
+        self._pools = []
+        if rep > 1:
+            # chain pumps on every non-tail member of rank 0's chain
+            for p in self.chain[:-1]:
+                pool = T._PeerPool(dict(self.addresses))
+                self._pools.append(pool)
+
+                def forward(succ, r, msg, _pool=pool):
+                    # fwd: tag = chain-forward admission bypass (the
+                    # head already admitted this update)
+                    _pool.request(
+                        succ, T._KIND_UPDATE, inst_id, r, msg.client,
+                        rule=f"fwd:{msg.rule}",
+                        payload_arr=np.asarray(msg.payload),
+                        oseq=msg.oseq,
+                    )
+
+                self.insts[p].attach_replication(forward)
+        self._stop = threading.Event()
+        self._threads = []
+        for inst in self.insts:
+            t = threading.Thread(
+                target=self._serve, args=(inst,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, inst) -> None:
+        while not self._stop.is_set():
+            if not inst.serve_once():
+                time.sleep(0.0005)
+
+    def busy_rejects(self) -> int:
+        return sum(lst._busy_rejects for lst in self.lsts)
+
+    def kill(self, p: int) -> None:
+        """Fault injection: kill member ``p``'s listener mid-window."""
+        self.lsts[p].close()
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(10)
+        for pool in self._pools:
+            pool.close()
+        for lst in self.lsts:
+            lst.close()
+
+
+def _read_fleet_point(
+    members, n_clients: int, window_s: float, read_mix: float,
+    payload, *, label: str, lane: str = "socket", kill_member=None,
+):
+    """One read-mix curve point: ``n_clients`` threads drive rank 0
+    (the hot shard) through ONE shared Transport (routing, RYW floors,
+    shm lane and failover all live there). ``read_mix`` is the READER
+    fraction of the fleet: readers fetch continuously (the serving
+    tier), the rest are writers running update -> immediate read-back
+    cycles (the trainer tier — and the read-your-writes probe: every
+    write is re-read on the same session right after its ack). Every
+    update adds 1.0 to every shard element, so the audit is
+    self-describing: any non-uniform fetch is a TORN read, and a
+    uniform fetch below the client's own acked-update count at issue
+    time is a read-your-writes VIOLATION."""
+    import threading
+
+    from torchmpi_tpu.parameterserver import transport as T
+
+    tr = T.Transport.__new__(T.Transport)
+    tr.process_index = 77
+    tr.pool = T._PeerPool(dict(members.addresses))
+    from torchmpi_tpu.analysis import lockmon
+
+    tr._dead_procs = {}
+    tr._dead_expired = set()
+    tr._dead_lock = lockmon.make_lock("bench.dead")
+    tr._oseq = {}
+    tr._oseq_lock = lockmon.make_lock("bench.oseq")
+    tr._delta_cache = {}
+    tr._delta_locks = {}
+    tr._delta_guard = lockmon.make_lock("bench.delta")
+    tr._acked = {}
+    tr._read_rr = {}
+    tr._read_lock = lockmon.make_lock("bench.read")
+    tr._shm_readers = {}
+    tr._shm_failed = set()
+    tr._read_versions = {}
+
+    inst_id = members.inst_id
+    chain = members.chain
+    stop = threading.Event()
+    recording = threading.Event()
+    stats = [
+        {"fetches": 0, "updates": 0, "torn": 0, "ryw": 0,
+         "lat": [], "errors": []}
+        for _ in range(n_clients)
+    ]
+
+    n_readers = int(round(n_clients * read_mix))
+
+    def client(cid: int, st: dict) -> None:
+        reader = cid <= n_readers
+        while not stop.is_set():
+            rec = recording.is_set()
+            if not reader:
+                try:
+                    tr.update(
+                        0, inst_id, 0, cid, "add", payload, chain=chain
+                    )
+                except ConnectionError as e:
+                    st["errors"].append(f"update: {e}")
+                    continue
+                if rec:
+                    st["updates"] += 1
+            # readers fetch back-to-back; writers read back every write
+            # they just acked (the read-your-writes probe)
+            acked = tr._acked.get((inst_id, 0, cid), 0)
+            t0 = time.perf_counter()
+            try:
+                out = tr.trigger(0, inst_id, 0, cid, chain=chain)
+            except ConnectionError as e:
+                st["errors"].append(f"fetch: {e}")
+                continue
+            dt = time.perf_counter() - t0
+            lo, hi = float(out.min()), float(out.max())
+            if rec:
+                st["fetches"] += 1
+                st["lat"].append(dt)
+                if lo != hi:
+                    st["torn"] += 1
+                elif lo < float(acked):
+                    st["ryw"] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(cid + 1, stats[cid]),
+                         daemon=True)
+        for cid in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # warmup: connects + first round trips
+    busy0 = members.busy_rejects()
+    recording.set()
+    t0 = time.monotonic()
+    if kill_member is not None:
+        killer = threading.Timer(
+            window_s * 0.75, members.kill, args=(kill_member,)
+        )
+        killer.start()
+    time.sleep(window_s)
+    recording.clear()
+    window = time.monotonic() - t0
+    stop.set()
+    for t in threads:
+        t.join(30)
+    tr.pool.close()
+    for reader in tr._shm_readers.values():
+        reader.close()
+    lat = sorted(x for st in stats for x in st["lat"])
+
+    def pct(p):
+        return round(lat[int(p * (len(lat) - 1))] * 1e3, 3) if lat else None
+
+    fetches = sum(st["fetches"] for st in stats)
+    errors = [e for st in stats for e in st["errors"]]
+    return {
+        "label": label,
+        "clients": n_clients,
+        "replication": len(chain),
+        "lane": lane,
+        "read_mix": read_mix,
+        "fetch_per_s": round(fetches / window, 1),
+        "update_per_s": round(
+            sum(st["updates"] for st in stats) / window, 1
+        ),
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "fetches_measured": fetches,
+        "torn_reads": sum(st["torn"] for st in stats),
+        "ryw_violations": sum(st["ryw"] for st in stats),
+        "busy_rejected": members.busy_rejects() - busy0,
+        "replica_killed": kill_member is not None,
+        "client_errors": errors[:5],
+    }
+
+
+def _ps_read_fleet(
+    check: bool = False, read_mix: float = 0.9, window_s: float = 1.2
+):
+    """``--ps-fleet --read-mix``: the PS READ-path scalability curve
+    (clients x replication x lane) over one hot shard. Four points:
+
+    - 256 clients, replication 1, socket — owner-only baseline with
+      rate-paced per-member apply capacity (fetch traffic and write
+      traffic funnel through ONE member's capacity);
+    - 256 clients, replication 3, socket, ``ps_read_policy=replica`` —
+      the same mix and same per-member capacity, reads spread over the
+      chain (3x the aggregate), with a replica KILLED mid-window
+      (fault injection: the walk must fall back to the owner without a
+      torn or stale-served read);
+    - 32 clients, replication 1, socket vs **shm** — the same-host
+      zero-copy lane against the loopback socket lane, same mix.
+
+    Every point audits zero torn reads (every update is uniform +1.0,
+    so any non-uniform fetch tore) and zero read-your-writes violations
+    (a fetch below the client's own acked count). ``--check`` gates:
+    replication-3 fetch throughput >= 2x owner-only at 256 clients, shm
+    p50 <= socket p50 / 1.5 at 32 clients, zero torn / RYW / client
+    errors everywhere. Pure host path — no jax backend."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import shmlane
+
+    elems = 256
+    payload = np.ones(elems, np.float32)
+    prev = {
+        k: constants.get(k)
+        for k in (
+            "ps_replication", "ps_read_policy", "ps_read_staleness",
+            "ps_shm_lane", "ps_pending_frame_budget", "ps_listen_backlog",
+        )
+    }
+    constants.set("ps_listen_backlog", max(prev["ps_listen_backlog"], 1024))
+    constants.set("ps_read_staleness", 0)
+    points = []
+
+    def run_point(inst_id, rep, n, *, label, policy, budget, lane="socket",
+                  kill_member=None, window=window_s, pace=0.0):
+        constants.set("ps_pending_frame_budget", budget)
+        constants.set("ps_read_policy", policy)
+        constants.set("ps_shm_lane", lane == "shm")
+        members = _ReadFleetMembers(inst_id, rep, elems, serve_pace_s=pace)
+        pub = None
+        try:
+            if lane == "shm":
+                pub = shmlane.ShmPublisher(members.lsts[0].port, inst_id)
+                members.insts[0].attach_shm(pub)
+            points.append(_read_fleet_point(
+                members, n, window, read_mix, payload,
+                label=label, lane=lane, kill_member=kill_member,
+            ))
+        finally:
+            if pub is not None:
+                members.insts[0].detach_shm()
+            members.close()
+
+    try:
+        # throughput pair: same mix, same per-member apply capacity
+        # (rate-paced intake, 500 msg/s/member — the fixed-capacity
+        # service model of the --ps-microbench rate-paced link), same
+        # generous admission budget; replication is the only variable.
+        # Owner-only funnels every fetch AND every update through one
+        # member's capacity; replica-spread reads reach the chain's 3x
+        # aggregate while each update consumes a slot at every member
+        # (head apply + chain forwards). Paced sleeps release the GIL,
+        # so the 3x aggregate is real even on a 1-core CI box — the
+        # pair measures routing reach, not host core count.
+        run_point(41, 1, 256, label="owner_only_256", policy="owner",
+                  budget=512, window=2.5, pace=0.002)
+        run_point(42, 3, 256, label="replica_spread_256", policy="replica",
+                  budget=512, kill_member=2, window=2.5, pace=0.002)
+        # lane pair: same mix + default-sized budget; lane is the only
+        # variable
+        run_point(43, 1, 32, label="socket_lane_32", policy="owner",
+                  budget=4096)
+        run_point(44, 1, 32, label="shm_lane_32", policy="owner",
+                  budget=4096, lane="shm")
+    finally:
+        for k, v in prev.items():
+            constants.set(k, v)
+    by_label = {p["label"]: p for p in points}
+    line = {
+        "metric": "PS read-path scalability (replica-aware fetch "
+        "routing + RYW sessions + shm lane, hot-shard read mix)",
+        "unit": "fetch/s",
+        "platform": "cpu",
+        "payload_elems": elems,
+        "read_mix": read_mix,
+        "window_s": window_s,
+        "points": points,
+        "value": max((p["fetch_per_s"] for p in points), default=0),
+    }
+    print(json.dumps(line), flush=True)
+    if not check:
+        return 0
+    ok = all(
+        p["torn_reads"] == 0 and p["ryw_violations"] == 0
+        and not p["client_errors"] and p["fetches_measured"] > 0
+        for p in points
+    )
+    owner = by_label.get("owner_only_256")
+    spread = by_label.get("replica_spread_256")
+    if owner and spread:
+        ok &= spread["fetch_per_s"] >= 2.0 * owner["fetch_per_s"]
+    sock = by_label.get("socket_lane_32")
+    shm = by_label.get("shm_lane_32")
+    if sock and shm and sock["p50_ms"] and shm["p50_ms"]:
+        ok &= shm["p50_ms"] <= sock["p50_ms"] / 1.5
+    if not ok:
+        print(
+            f"# ps read-fleet smoke FAILED: points={json.dumps(points)}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return 0 if ok else 1
+
+
 def _sim_bench(check: bool = False, worlds: str = ""):
     """``--sim``: the coordinator-scalability curve over a SIMULATED
     fleet (torchmpi_tpu.sim — real control plane, modeled network).
@@ -2103,6 +2465,17 @@ def main(argv=None):
         "curve (overrides TORCHMPI_TPU_PS_FLEET_CLIENTS)",
     )
     ap.add_argument(
+        "--read-mix",
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="with --ps-fleet: run the READ-path curve instead — FRAC "
+        "of each client's ops are hot-shard fetches (rest are updates), "
+        "swept over clients x replication x lane with torn-read and "
+        "read-your-writes audits plus a mid-window replica kill; "
+        "prints one JSON line",
+    )
+    ap.add_argument(
         "--sim",
         action="store_true",
         help="simulated-fleet coordinator scalability curve: formation "
@@ -2153,6 +2526,8 @@ def main(argv=None):
         return _sim_bench(check=args.check, worlds=args.sim_worlds)
 
     if args.ps_fleet:
+        if args.read_mix is not None:
+            return _ps_read_fleet(check=args.check, read_mix=args.read_mix)
         return _ps_fleet(check=args.check, clients=args.fleet_clients)
 
     if args.ps_microbench:

@@ -48,7 +48,7 @@ run_sim_smoke() {
     # strand ~2k telemetry dumps per retry in /tmp on the CI box
     trap 'rm -rf "$simdir"' EXIT
     JAX_PLATFORMS=cpu python -m torchmpi_tpu.sim death_wave partition \
-        --ranks 1024 --out "$simdir"
+        read_storm --ranks 1024 --out "$simdir"
     rm -rf "$simdir"
     # partition SUPERVISED at 1024 ranks: the recovery ladder (verdict
     # -> evict the wave -> committed shrink -> training resumed) per
@@ -110,6 +110,14 @@ run_perf_smoke() {
     # updates — the scalability-curve JSON is the CI-captured evidence.
     echo "=== perf-smoke (parameter-server fleet scalability, CPU) ==="
     python bench.py --ps-fleet --check
+    # PS read-path smoke: replica-spread fetch routing must reach >= 2x
+    # the owner-only fetch throughput at 256 clients under the same
+    # reader/writer mix and per-member capacity (with a replica killed
+    # mid-window), the shm lane p50 must beat the loopback socket p50,
+    # and the self-describing audits must hold everywhere: zero torn
+    # reads, zero read-your-writes violations.
+    echo "=== perf-smoke (parameter-server read path: routing/RYW/shm, CPU) ==="
+    python bench.py --ps-fleet --read-mix 0.9 --check
     # flight-recorder/analyzer smoke: a short 2-proc job with telemetry on
     # must yield a merged per-rank Perfetto trace and a clean
     # `desync: none` analyzer report.

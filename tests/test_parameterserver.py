@@ -1968,6 +1968,13 @@ def test_transport_chain_routing_marks_dead_and_fails_over():
 
         tr._dead_lock = lockmon.make_lock("test.dead")
         tr._oseq_lock = lockmon.make_lock("test.oseq")
+        # read-path routing state (see Transport.__init__)
+        tr._acked = {}
+        tr._read_rr = {}
+        tr._read_lock = lockmon.make_lock("test.read")
+        tr._shm_readers = {}
+        tr._shm_failed = set()
+        tr._read_versions = {}
         tr.update(
             0, 5, 0, 0, "add", np.full(2, 3.0, np.float32), chain=[0, 1]
         )
@@ -2022,6 +2029,471 @@ def test_malformed_delta_trigger_releases_admission_slot():
             else out,
             np.full(2, 7.0, np.float32),
         )
+    finally:
+        ch.close()
+        lst.close()
+
+
+# ---------------------------------------------------------------------------
+# PS read path: replica-aware routing, read-your-writes sessions, shm lane
+# ---------------------------------------------------------------------------
+
+
+def _bare_read_transport(addresses):
+    """A Transport wired straight at in-test listeners (the client half
+    only — no listener of its own), with the read-path routing state
+    Transport.__init__ would have built."""
+    from torchmpi_tpu.analysis import lockmon
+    from torchmpi_tpu.parameterserver import transport as T
+
+    tr = T.Transport.__new__(T.Transport)
+    tr.process_index = 99
+    tr.pool = T._PeerPool(dict(addresses))
+    tr._dead_procs = {}
+    tr._dead_expired = set()
+    tr._dead_lock = lockmon.make_lock("test.dead")
+    tr._oseq = {}
+    tr._oseq_lock = lockmon.make_lock("test.oseq")
+    tr._delta_cache = {}
+    tr._delta_locks = {}
+    tr._delta_guard = lockmon.make_lock("test.delta")
+    tr._acked = {}
+    tr._read_rr = {}
+    tr._read_lock = lockmon.make_lock("test.read")
+    tr._shm_readers = {}
+    tr._shm_failed = set()
+    tr._read_versions = {}
+    return tr
+
+
+class _ChainPair:
+    """A live 2-process replica chain for read-path tests: two real
+    _Instances (owners=[0, 1], chains [[0, 1], [1, 0]]), each behind its
+    own listener, a pause-able serve thread driving both mailboxes, and
+    per-member TRIGGER counters (a stale refusal is answered BEFORE the
+    mailbox post, so the counters measure fetches actually SERVED)."""
+
+    def __init__(self, inst_id=21, with_pump=True, n=4):
+        import threading
+
+        from torchmpi_tpu.parameterserver import transport as T
+        from torchmpi_tpu.parameterserver.server import _Instance
+
+        full = np.zeros(n, np.float32)
+        self.inst_a = _Instance(inst_id, full, 2, owners=[0, 1], my_proc=0)
+        self.inst_b = _Instance(inst_id, full, 2, owners=[0, 1], my_proc=1)
+        self.lst_a = T._Listener(lambda i: self.inst_a)
+        self.lst_b = T._Listener(lambda i: self.inst_b)
+        self.served = {0: 0, 1: 0}
+        for pidx, inst in ((0, self.inst_a), (1, self.inst_b)):
+            self._count_triggers(pidx, inst)
+        self._fwd_pool = None
+        if with_pump:
+            # chain-forward rank-0 applies head -> replica, preserving
+            # the original (client, oseq) dedup identity — the replica's
+            # per-client applied high-water is what the RYW floor checks
+            self._fwd_pool = T._PeerPool({1: ("127.0.0.1", self.lst_b.port)})
+
+            def forward(succ, r, msg):
+                self._fwd_pool.request(
+                    succ, T._KIND_UPDATE, inst_id, r, msg.client,
+                    rule=msg.rule, payload_arr=np.asarray(msg.payload),
+                    oseq=msg.oseq,
+                )
+
+            self.inst_a.attach_replication(forward)
+        self.paused = threading.Event()
+        self._stop = threading.Event()
+
+        def serve():
+            import time as _t
+
+            while not self._stop.is_set():
+                if self.paused.is_set():
+                    _t.sleep(0.0005)
+                    continue
+                if not (self.inst_a.serve_once() | self.inst_b.serve_once()):
+                    _t.sleep(0.0005)
+
+        self._thread = threading.Thread(target=serve, daemon=True)
+        self._thread.start()
+
+    def _count_triggers(self, pidx, inst):
+        orig = inst.post
+
+        def post(rank, msg):
+            if msg.kind == "trigger":
+                self.served[pidx] += 1
+            return orig(rank, msg)
+
+        inst.post = post
+
+    def transport(self):
+        return _bare_read_transport({
+            0: ("127.0.0.1", self.lst_a.port),
+            1: ("127.0.0.1", self.lst_b.port),
+        })
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(10)
+        if self._fwd_pool is not None:
+            self._fwd_pool.close()
+        self.lst_a.close()
+        self.lst_b.close()
+
+
+def test_read_policy_replica_spreads_and_survives_replica_death():
+    """ps_read_policy=replica rotates fetches of ONE shard across both
+    chain members; killing the replica mid-stream falls back to the
+    owner with zero torn reads — every fetch returns the exact
+    all-updates-applied value (the chain forward acks only after the
+    replica applied, so a replica-served read is never mid-update)."""
+    from torchmpi_tpu import constants
+
+    constants.set("ps_replication", 2)
+    constants.set("ps_read_policy", "replica")
+    pair = _ChainPair(inst_id=21, with_pump=True)
+    tr = pair.transport()
+    try:
+        for _ in range(5):
+            tr.update(0, 21, 0, 0, "add", np.full(2, 1.0, np.float32),
+                      chain=[0, 1])
+        for _ in range(8):
+            out = tr.trigger(0, 21, 0, 0, chain=[0, 1])
+            np.testing.assert_allclose(out, np.full(2, 5.0, np.float32))
+        # round-robin rotation: both members actually served fetches
+        assert pair.served[0] > 0 and pair.served[1] > 0
+        # replica death mid-stream: the walk marks it dead and the
+        # owner serves every remaining fetch, still torn-free
+        pair.lst_b.close()
+        for _ in range(6):
+            out = tr.trigger(0, 21, 0, 0, chain=[0, 1])
+            assert out.min() == out.max() == 5.0  # zero torn reads
+        assert 1 in tr._dead_procs
+    finally:
+        tr.pool.close()
+        pair.close()
+
+
+def test_read_your_writes_redirects_lagged_replica():
+    """RYW with a deliberately LAGGED replica (no chain pump, so its
+    applied high-water never advances): under ps_read_staleness=0 every
+    replica-routed fetch is refused with stale:<hw> BEFORE reaching the
+    replica's mailbox and redirected to the owner — the client always
+    observes its own acked writes. Widening ps_read_staleness past the
+    write count lets the lagged replica serve its old view again (the
+    staleness bound is the knob, not a hardcoded freshness rule)."""
+    from torchmpi_tpu import constants
+
+    constants.set("ps_replication", 2)
+    constants.set("ps_read_policy", "replica")
+    constants.set("ps_read_staleness", 0)
+    pair = _ChainPair(inst_id=22, with_pump=False)
+    tr = pair.transport()
+    try:
+        for _ in range(3):
+            # no chain: the write lands on the owner only (the replica
+            # stays at 0.0 with applied high-water 0 — maximal lag)
+            tr.update(0, 22, 0, 0, "add", np.full(2, 1.0, np.float32))
+            tr._record_acked(22, 0, 0, tr.next_oseq(22, 0, 0))
+        assert tr._session_floor(22, 0, 0) == 3
+        for _ in range(6):
+            out = tr.trigger(0, 22, 0, 0, chain=[0, 1])
+            np.testing.assert_allclose(out, np.full(2, 3.0, np.float32))
+        # the stale refusals never reached the replica's server loop
+        assert pair.served[1] == 0
+        assert pair.served[0] == 6
+        # staleness allowance >= lag: the replica may serve its old view
+        constants.set("ps_read_staleness", 10)
+        assert tr._session_floor(22, 0, 0) == 0
+        seen = set()
+        for _ in range(4):
+            seen.add(float(tr.trigger(0, 22, 0, 0, chain=[0, 1])[0]))
+        assert pair.served[1] > 0  # lagged replica allowed to serve...
+        assert 0.0 in seen  # ...and its stale view was observed
+    finally:
+        tr.pool.close()
+        pair.close()
+
+
+def test_read_your_writes_holds_across_busy_retry_window():
+    """RYW survives BUSY/retry: with the serve thread paused and a tiny
+    admission budget, concurrent fetches pile up, some are BUSYed and
+    retried — and after serving resumes, EVERY fetch still returns the
+    client's own acked writes (the session floor rides the retried
+    frame unchanged)."""
+    import threading
+
+    from torchmpi_tpu import constants
+
+    constants.set("ps_replication", 2)
+    constants.set("ps_read_policy", "replica")
+    pair = _ChainPair(inst_id=23, with_pump=True)
+    tr = pair.transport()
+    try:
+        for _ in range(4):
+            tr.update(0, 23, 0, 0, "add", np.full(2, 1.0, np.float32),
+                      chain=[0, 1])
+        constants.set("ps_pending_frame_budget", 2)
+        pair.paused.set()  # frames pile up: nothing drains admission
+        results, errs = [], []
+
+        def fetch():
+            try:
+                results.append(tr.trigger(0, 23, 0, 0, chain=[0, 1]))
+            except Exception as e:  # noqa: BLE001 - fail the test below
+                errs.append(e)
+
+        threads = [threading.Thread(target=fetch) for _ in range(6)]
+        for t in threads:
+            t.start()
+        import time as _t
+
+        _t.sleep(0.3)  # let the pile-up trip the admission budget
+        pair.paused.clear()
+        for t in threads:
+            t.join(30)
+        assert not errs, errs
+        assert len(results) == 6
+        for out in results:
+            np.testing.assert_allclose(out, np.full(2, 4.0, np.float32))
+        assert (pair.lst_a._busy_rejects + pair.lst_b._busy_rejects) > 0
+    finally:
+        tr.pool.close()
+        pair.close()
+
+
+def test_shm_seqlock_torn_read_retries_then_recovers():
+    """The seqlock contract, forced deterministically: an odd version
+    counter (a write frozen mid-flight) makes the reader spin its
+    budget and return None with .retries advanced — never a torn
+    payload; restoring a complete publish makes the same reader
+    succeed at the new value."""
+    import os
+
+    from torchmpi_tpu.parameterserver import shmlane
+
+    port = 40000 + os.getpid() % 20000
+    pub = shmlane.ShmPublisher(port, 5)
+    reader = None
+    try:
+        pub.publish(0, np.full(4, 2.0, np.float32), version=1)
+        reader = shmlane.ShmReader(shmlane.segment_name(port, 5, 0))
+        arr, version = reader.read()
+        np.testing.assert_allclose(arr, np.full(4, 2.0, np.float32))
+        assert version == 1
+        # freeze the segment mid-write: pack an ODD counter in place
+        seg = pub._segs[0]
+        shmlane._HDR.pack_into(
+            seg.buf, 0, shmlane._MAGIC, 3, 1, 16, b"<f4\x00\x00\x00\x00\x00"
+        )
+        before = reader.retries
+        assert reader.read() is None  # spun out, no torn payload
+        assert reader.retries > before
+        pub.publish(0, np.full(4, 9.0, np.float32), version=2)
+        arr, version = reader.read()
+        np.testing.assert_allclose(arr, np.full(4, 9.0, np.float32))
+        assert version == 2
+    finally:
+        if reader is not None:
+            reader.close()
+        pub.close()
+
+
+def test_shm_seqlock_uniform_under_concurrent_writer():
+    """Torn-read audit under a live concurrent writer: every publish is
+    a uniform array, so ANY non-uniform read is a torn read. The reader
+    hammers the segment while the writer republishes; every successful
+    read must be uniform and version-consistent."""
+    import os
+    import threading
+
+    from torchmpi_tpu.parameterserver import shmlane
+
+    port = 40000 + (os.getpid() + 7) % 20000
+    pub = shmlane.ShmPublisher(port, 6)
+    pub.publish(0, np.full(1024, 0.0, np.float32), version=1)
+    reader = shmlane.ShmReader(shmlane.segment_name(port, 6, 0))
+    stop = threading.Event()
+
+    def writer():
+        v = 1
+        while not stop.is_set():
+            v += 1
+            pub.publish(0, np.full(1024, float(v), np.float32), version=v)
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    torn = 0
+    reads = 0
+    try:
+        for _ in range(3000):
+            res = reader.read()
+            if res is None:
+                continue  # spin budget exhausted: honest miss, not torn
+            arr, version = res
+            reads += 1
+            if arr.min() != arr.max():
+                torn += 1
+        assert torn == 0
+        assert reads > 0
+    finally:
+        stop.set()
+        wt.join(10)
+        reader.close()
+        pub.close()
+
+
+def test_shm_lane_serves_local_fetches_without_sockets():
+    """ps_shm_lane end-to-end: the owner publishes on attach and after
+    every applied update (BEFORE acking); a same-host client's trigger
+    is served from the segment — zero TRIGGER frames reach the server
+    loop — and observes its own acked write immediately (RYW by
+    publish-before-ack)."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import shmlane
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _Instance
+
+    constants.set("ps_shm_lane", True)
+    full = np.arange(4, dtype=np.float32)
+    inst = _Instance(31, full, 2, owners=[0, 0], my_proc=0)
+    lst = T._Listener(lambda i: inst)
+    inst.attach_shm(shmlane.ShmPublisher(lst.port, 31))
+    served = {"triggers": 0}
+    orig_post = inst.post
+
+    def post(rank, msg):
+        if msg.kind == "trigger":
+            served["triggers"] += 1
+        return orig_post(rank, msg)
+
+    inst.post = post
+    import threading
+    import time as _t
+
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            if not inst.serve_once():
+                _t.sleep(0.0005)
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    tr = _bare_read_transport({0: ("127.0.0.1", lst.port)})
+    try:
+        out = tr.trigger(0, 31, 0, 0)
+        np.testing.assert_allclose(out, full[:2])
+        out = tr.trigger(0, 31, 1, 0)
+        np.testing.assert_allclose(out, full[2:])
+        assert served["triggers"] == 0  # zero socket fetches
+        # write -> republish-before-ack -> the NEXT shm read sees it
+        tr.update(0, 31, 0, 0, "add", np.full(2, 10.0, np.float32))
+        out = tr.trigger(0, 31, 0, 0)
+        np.testing.assert_allclose(out, full[:2] + 10.0)
+        assert served["triggers"] == 0
+        # the lane recorded the shard version it observed (feeds the
+        # serving tier's version vector)
+        assert tr._read_versions[(31, 0, 0)] >= 1
+    finally:
+        stop.set()
+        thread.join(10)
+        tr.pool.close()
+        inst.detach_shm()
+        lst.close()
+
+
+def test_route_read_rotation_prefer_and_adaptive_pressure():
+    """route_read under each policy: owner pins the head; replica
+    round-robins the live chain (so a fan-out's consecutive routes land
+    on distinct endpoints); prefer pins the walk's first candidate to
+    the member the caller already grouped by; adaptive spreads ONLY
+    while the owner shows backpressure."""
+    import time as _t
+
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    tr = _bare_read_transport({})
+    try:
+        chain = [0, 1, 2]
+        assert tr.route_read(0, 1, 0, chain, policy="owner") == 0
+        assert tr.route_read(0, 1, 0, None, policy="replica") == 0
+        got = [tr.route_read(0, 1, 0, chain, policy="replica")
+               for _ in range(6)]
+        assert got == [0, 1, 2, 0, 1, 2]
+        # prefer pins the first candidate without advancing the cursor
+        cands = tr._read_candidates(0, 1, 0, chain, "replica", prefer=2)
+        assert cands == [2, 0, 1]
+        # adaptive: calm owner -> owner-first (no spread) ...
+        assert [tr.route_read(0, 1, 1, chain, policy="adaptive")
+                for _ in range(3)] == [0, 0, 0]
+        # ... BUSY backpressure within the last second -> spread
+        ch = T._PeerChannel({0: ("127.0.0.1", 1)}, 0)
+        tr.pool._channels[0] = ch
+        ch.last_busy = _t.monotonic()
+        assert tr._owner_pressured(0)
+        got = [tr.route_read(0, 1, 1, chain, policy="adaptive")
+               for _ in range(3)]
+        assert sorted(set(got)) != [0]  # rotation engaged
+        # dead-marked owner pressures too
+        ch.last_busy = 0.0
+        tr._mark_dead(0)
+        assert tr._owner_pressured(0)
+        # global knob drives the default
+        constants.set("ps_read_policy", "replica")
+        first = tr.route_read(0, 2, 0, chain)
+        second = tr.route_read(0, 2, 0, chain)
+        assert first != second
+    finally:
+        tr.pool.close()
+
+
+def test_chain_forward_frames_bypass_admission():
+    """A ``fwd:``-tagged UPDATE (a replica pump relaying an update the
+    chain head already admitted) is NEVER BUSYed — re-admitting at each
+    hop would invert priority, stalling the single in-order pump behind
+    the client traffic it carries — while an untagged client update
+    against the same zero budget is rejected."""
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applied = []
+
+    class FakeInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            applied.append((msg.rule, msg.oseq))
+            msg.done.set()
+
+    lst = T._Listener(lambda i: FakeInst())
+    ch = T._PeerChannel({0: ("127.0.0.1", lst.port)}, 0)
+    try:
+        # saturate admission: budget 1 with the one slot pre-occupied
+        constants.set("ps_pending_frame_budget", 1)
+        with lst._pending_lock:
+            lst._pending_frames += 1
+        payload = np.full(2, 1.0, np.float32)
+        ch.request(
+            T._KIND_UPDATE, 1, 0, 0, rule="fwd:add",
+            payload_arr=payload, oseq=7,
+        )
+        # forwarded frame sailed through the full budget, and the fwd:
+        # tag was stripped before the apply saw the rule
+        assert applied == [("add", 7)]
+        assert lst._busy_rejects == 0
+        # the SAME state rejects an untagged client update (probed via
+        # the pure decision — the live channel would BUSY-retry forever
+        # against a permanently saturated budget)
+        admit, _ = T.admission_decision(
+            lst._pending_frames, 1, None, 2, True
+        )
+        assert not admit
+        with lst._pending_lock:
+            lst._pending_frames -= 1
     finally:
         ch.close()
         lst.close()

@@ -96,6 +96,41 @@ def test_version_vector_tracks_applied_updates():
         free_all()
 
 
+def test_refresh_rides_the_configured_read_policy_and_stays_fresh():
+    """The background refresher fetches under serve_refresh_read_policy
+    (default 'replica': spread over the chains, off the owner's back)
+    — and freshness is PRESERVED: the swap still lands with the
+    post-write version vector and the post-write weights, because the
+    vector key is chain-consistent and the RYW floor redirects a
+    too-stale member to the owner."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import constants
+    from torchmpi_tpu.parameterserver import ParameterServer, free_all
+
+    assert constants.get("serve_refresh_read_policy") == "replica"
+    mpi.start()
+    try:
+        ps = ParameterServer(np.zeros(8, np.float32))
+        seen = []
+        orig = ps.receive
+
+        def receive(client=0, read_policy=None):
+            seen.append(read_policy)
+            return orig(client, read_policy=read_policy)
+
+        ps.receive = receive
+        srv = InferenceServer(lambda w, x: x, ps)
+        ps.send(np.ones(8, np.float32), rule="add").wait()
+        assert srv.refresh_once()
+        # the refresh fetch carried the configured policy...
+        assert seen[-1] == "replica"
+        # ...and the swap installed the post-write view (fresh)
+        np.testing.assert_allclose(srv.cache.get()[0], 1.0)
+        assert srv.cache.versions == version_vector(ps)
+    finally:
+        free_all()
+
+
 # ---------------------------------------------------------------------------
 # InferenceServer.handle: the request path + brownout shedding
 # ---------------------------------------------------------------------------

@@ -92,6 +92,7 @@ def test_clean_fleet_reaches_clean_verdict(tmp_path):
         ("partition", None),
         ("torn_resize", None),
         ("busy_storm", None),
+        ("read_storm", 64),
     ],
 )
 def test_packaged_scenario_reaches_named_verdict(tmp_path, name, ranks):
@@ -312,6 +313,7 @@ def test_scenario_constants_are_restored(tmp_path):
         ("partition", None),
         ("torn_resize", None),
         ("busy_storm", None),
+        ("read_storm", 64),
     ],
 )
 def test_supervised_scenario_meets_recovery_contract(tmp_path, name,

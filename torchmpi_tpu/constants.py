@@ -187,6 +187,38 @@ class _Constants:
     # forever while everyone else still talks to the recovered head.
     # 0 makes dead-marks permanent (until restart).
     ps_dead_peer_retry_s: float = 5.0
+    # Read-path routing policy for SHARD/delta fetches against a
+    # replicated shard: 'owner' fetches from the chain head (legacy
+    # failover walk), 'replica' round-robins fetches across the live
+    # chain members (the read-scaling mode: a read-heavy fleet spreads
+    # off the owner hot spot), 'adaptive' prefers the owner until it
+    # shows backpressure (a recent BUSY or an active dead-mark), then
+    # spreads like 'replica' until the pressure clears. Replica-served
+    # fetches carry the client's read-session floor (last-ACKED origin
+    # seq minus ps_read_staleness); a member whose applied high-water
+    # has not covered it answers 'stale:<hw>' and the client falls back
+    # to the owner — read-your-writes holds under every policy.
+    ps_read_policy: str = "owner"
+    # Allowed replica lag for replica-served fetches, in ACKED origin
+    # seqs per (instance, rank, client) session. 0 = strict
+    # read-your-writes (a replica must have applied every update this
+    # client was acked for); N > 0 trades N acked updates of session
+    # staleness for replica availability. Pure readers (no acked writes)
+    # are served by any live member regardless.
+    ps_read_staleness: int = 0
+    # Zero-copy shared-memory fetch lane: shard owners publish each
+    # applied shard into a per-(instance, rank) shared-memory segment
+    # (seqlock-versioned; published BEFORE the update's ack, so owner
+    # shm reads are read-your-writes by construction), and co-located
+    # clients map the segment and fetch without touching the socket or
+    # the event loop. Torn concurrent writes are detected by the seqlock
+    # and retried (bounded spins), then the fetch falls back to the
+    # socket path. Off by default: costs one shard-sized segment per
+    # locally-owned shard.
+    ps_shm_lane: bool = False
+    # Seqlock read attempts before the shm lane gives up on a torn /
+    # unpublished segment and the fetch falls back to the socket path.
+    ps_shm_spin_limit: int = 64
 
     # --- distributed flight recorder / hang watchdog ---
     # Seconds a collective dispatch or PS RPC may stay in flight (or a
@@ -357,6 +389,13 @@ class _Constants:
     # delta-fetch path); each fetch that lands a newer version swaps
     # the serving weights atomically.
     serve_refresh_interval_s: float = 2.0
+    # Read-routing policy for the background weight refresher's fetches
+    # ('' inherits ps_read_policy). Default 'replica': a serving tier's
+    # weight refreshes spread across the replica chain instead of
+    # competing with training updates at the shard owner; freshness is
+    # preserved by the read-session staleness bound + the version-vector
+    # swap (a stale-identical fetch is a no-op swap, never a regression).
+    serve_refresh_read_policy: str = "replica"
     # Staleness bound: a server whose weights are older than this warns
     # (and the brownout ladder may widen it; see the factor below).
     serve_refresh_staleness_s: float = 30.0

@@ -376,6 +376,10 @@ class _Instance:
                     nxt = chain[i + 1]
             self._next_chain[r] = nxt
         self._pump: Optional[_ReplicaPump] = None
+        # zero-copy read lane (shmlane.ShmPublisher), armed by
+        # ParameterServer when ps_shm_lane is on; owner-only, touched
+        # only by the server thread (like the shards themselves)
+        self._shm_pub = None
         self.ranges: List[Tuple[int, int]] = []
         sizes = []
         for r in range(size):
@@ -454,6 +458,37 @@ class _Instance:
         has a successor."""
         if any(v is not None for v in self._next_chain.values()):
             self._pump = _ReplicaPump(forward)
+
+    def attach_shm(self, publisher) -> None:
+        """Arm the zero-copy read lane: every locally-OWNED shard is
+        published into ``publisher`` (a :class:`shmlane.ShmPublisher`)
+        now, and re-published by ``serve_once`` after every apply —
+        strictly before the update's done event, so a co-located client
+        that was acked for a write always observes it through the
+        segment (read-your-writes on the shm lane by construction)."""
+        self._shm_pub = publisher
+        for r in range(self.size):
+            if self.is_local(r):
+                self._shm_publish(r)
+
+    def _shm_publish(self, r: int) -> None:
+        # lane failure disarms the lane, never the server: co-located
+        # readers fall back to the socket path on their spin budget
+        pub = self._shm_pub
+        if pub is None:
+            return
+        try:
+            pub.publish(r, self.read_shard(r), self.versions[r])
+        except Exception:  # noqa: BLE001 - /dev/shm full, torn down, ...
+            self._shm_pub = None
+
+    def detach_shm(self) -> None:
+        pub, self._shm_pub = self._shm_pub, None
+        if pub is not None:
+            try:
+                pub.close()
+            except Exception:  # noqa: BLE001
+                pass
 
     def reform(self, live: Sequence[int],
                replication: Optional[int] = None) -> Dict[int, List[int]]:
@@ -627,6 +662,11 @@ class _Instance:
                         # version vector for delta-encoded fetches: every
                         # applied update advances the shard version
                         self.versions[r] += 1
+                        # zero-copy lane: republish BEFORE msg.done is
+                        # set — acked writes are always visible through
+                        # the owner's segment
+                        if self._shm_pub is not None and self.is_local(r):
+                            self._shm_publish(r)
                     except Exception as e:
                         # Never kill the (single, shared) server thread and
                         # never strand the sender's completion event; the
@@ -795,6 +835,7 @@ class _GlobalServer:
                         )
         if inst._pump is not None:
             inst._pump.stop()
+        inst.detach_shm()
         inst.release_storage()
 
     def unregister(self, inst: _Instance) -> None:
@@ -908,6 +949,20 @@ class ParameterServer:
             self._inst = _server.register(full, comm.size, owners, my_proc)
             if any(len(c) > 1 for c in self._inst.chains):
                 self._attach_chain_pump()
+            if constants.get("ps_shm_lane") and any(
+                self._inst.is_local(r) for r in range(self._inst.size)
+            ):
+                # zero-copy read lane: publish locally-owned shards into
+                # per-shard shm segments named from this listener's port
+                # (what co-located clients derive from the address book)
+                try:
+                    from . import shmlane as _shmlane
+
+                    self._inst.attach_shm(_shmlane.ShmPublisher(
+                        self._transport.listener.port, self._inst.id
+                    ))
+                except Exception:  # noqa: BLE001 - lane only, never fatal
+                    pass
             self._transport.barrier(
                 set(owners), f"ps-init-{self._inst.id}-{self._inst.fingerprint}"
             )
@@ -1191,7 +1246,8 @@ class ParameterServer:
 
         return SyncHandle(future=_submit_bounded(do_send))
 
-    def receive(self, client: int = 0) -> SyncHandle:
+    def receive(self, client: int = 0,
+                read_policy: Optional[str] = None) -> SyncHandle:
         """Fetch the full tensor: trigger every server, assemble shards
         (``clientReceive``, ``parameterserver.cpp:356-400``). ``wait()``
         returns the assembled ndarray.
@@ -1203,16 +1259,22 @@ class ParameterServer:
         (the server thread serializes rule applies and reads per
         instance), so a prefetched read never observes a torn apply —
         cross-shard staleness skew is the async-PS contract, intra-shard
-        tearing is not."""
+        tearing is not.
+
+        ``read_policy`` overrides the ``ps_read_policy`` knob for this
+        fetch (``owner``/``replica``/``adaptive`` — see
+        ``Transport.trigger``); the read-your-writes session floor and
+        staleness bound hold under every policy."""
         if self._inst.freed:
             raise RuntimeError("parameter server already freed")
         with self._prefetch_lock:
             q = self._prefetch_q.get(client)
             if q:
                 return q.popleft()
-        return self._issue_receive(client)
+        return self._issue_receive(client, read_policy=read_policy)
 
-    def prefetch(self, client: int = 0, depth: int = 2) -> SyncHandle:
+    def prefetch(self, client: int = 0, depth: int = 2,
+                 read_policy: Optional[str] = None) -> SyncHandle:
         """Start the next :meth:`receive` now and let it ride the wire
         during compute — double-buffered per (instance, client): at most
         ``depth`` fetches outstanding (extra calls return the oldest
@@ -1225,11 +1287,12 @@ class ParameterServer:
             q = self._prefetch_q.setdefault(client, deque())
             if len(q) >= max(1, depth):
                 return q[0]
-            h = self._issue_receive(client)
+            h = self._issue_receive(client, read_policy=read_policy)
             q.append(h)
             return h
 
-    def _issue_receive(self, client: int) -> SyncHandle:
+    def _issue_receive(self, client: int,
+                       read_policy: Optional[str] = None) -> SyncHandle:
         inst = self._inst
         shape, dtype = self.shape, self.dtype
         transport = self._transport
@@ -1240,29 +1303,42 @@ class ParameterServer:
             wcode = _w.resolve_ps_wire(dtype)
             replies = {}
             out = np.empty((int(np.prod(shape)),), dtype)
-            by_proc: Dict[int, List[int]] = {}
+            by_proc: Dict[int, List[Tuple[int, int]]] = {}
+            replicated = any(len(c) > 1 for c in inst.chains)
             for r in range(inst.size):
                 if inst.is_local(r):
                     f: Future = Future()
                     inst.post(r, _Message("trigger", client=client, reply=f))
                     replies[r] = f
                 else:
-                    by_proc.setdefault(inst.owners[r], []).append(r)
+                    # fan-out grouped by the ROUTED chain member, not the
+                    # owner: issuing all fetches then waiting only
+                    # overlaps if the issues land on distinct endpoints —
+                    # owner-ordered grouping under ps_read_policy=replica
+                    # would re-serialize the whole fetch at the head
+                    owner = inst.owners[r]
+                    routed = owner
+                    if transport is not None and replicated:
+                        routed = transport.route_read(
+                            owner, inst.id, r, inst.chains[r],
+                            policy=read_policy,
+                        )
+                    by_proc.setdefault(routed, []).append((r, routed))
 
-            replicated = any(len(c) > 1 for c in inst.chains)
-
-            def fetch_from(proc, ranks, errs):
+            def fetch_from(pairs, errs):
                 try:
-                    for r in ranks:
+                    for r, routed in pairs:
                         # clientReceive's trigger + Ssend-back
                         # (parameterserver.cpp:356-400); under
                         # replication a dead head fails over to the next
                         # live chain member's replicated shard
                         s, e = inst.ranges[r]
                         out[s:e] = transport.trigger(
-                            proc, inst.id, r, client, fp=inst.fingerprint,
+                            inst.owners[r], inst.id, r, client,
+                            fp=inst.fingerprint,
                             logical_dtype=dtype,
                             chain=inst.chains[r] if replicated else None,
+                            read_policy=read_policy, prefer=routed,
                         )
                 except Exception as e:
                     errs.append(e)
@@ -1270,9 +1346,9 @@ class ParameterServer:
             errs: List[Exception] = []
             threads = [
                 threading.Thread(
-                    target=fetch_from, args=(proc, ranks, errs), daemon=True
+                    target=fetch_from, args=(pairs, errs), daemon=True
                 )
-                for proc, ranks in by_proc.items()
+                for pairs in by_proc.values()
             ]
             for t in threads:
                 t.start()

@@ -1,0 +1,225 @@
+"""Zero-copy shared-memory fetch lane for co-located PS clients.
+
+The socket fetch path costs two syscalls, an event-loop dispatch, an
+apply-pool hop, and a wire encode/decode per shard — pure overhead when
+client and shard owner share a host. This module gives same-host fetches
+a lane that bypasses all of it: the owner publishes every applied shard
+into a per-(instance, rank) ``multiprocessing.shared_memory`` segment,
+and clients read it through a **seqlock**:
+
+- the publisher bumps a version counter to ODD, memcpys the shard bytes
+  plus the shard's delta version, then bumps the counter to EVEN;
+- a reader snapshots the counter (odd = write in progress, retry), reads
+  the payload, and re-reads the counter — any mismatch means the read
+  raced a publish (torn) and is retried; after ``ps_shm_spin_limit``
+  attempts the caller falls back to the socket path.
+
+Freshness contract: :meth:`ShmPublisher.publish` is called by the server
+thread right after each apply, BEFORE the update's ack is released — so
+a client that has been acked for a write always observes it through the
+owner's segment (read-your-writes by construction, no session floor
+needed on this lane).
+
+Segment names are derived from the owner's listener port (unique per
+host), so clients compute them from the address book with no extra
+exchange. Python 3.10's ``SharedMemory`` registers every attach with the
+resource tracker (which would spuriously unlink publisher-owned segments
+at reader-process exit); readers unregister themselves, and the
+publisher owns unlink.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import constants
+
+# header: magic u32 | seqlock counter u64 | shard version u64 |
+# payload nbytes u64 | dtype str (8 bytes, NUL-padded). Little-endian,
+# fixed offsets; payload starts at _HDR_SIZE.
+_MAGIC = 0x544D5053  # "TMPS"
+_HDR = struct.Struct("<IQQQ8s")
+_HDR_SIZE = 64  # padded: payload lands cache-line aligned
+
+
+def segment_name(port: int, inst: int, rank: int) -> str:
+    """The shm segment name for shard ``rank`` of instance ``inst``
+    owned by the listener on ``port`` — derivable by any co-located
+    client from the bootstrap address book."""
+    return f"tmps{int(port)}i{int(inst)}r{int(rank)}"
+
+
+def is_local_host(host: str) -> bool:
+    """Whether ``host`` (an address-book entry) names THIS machine —
+    the gate for attempting the shm lane at all."""
+    return host in ("127.0.0.1", "localhost", "0.0.0.0",
+                    socket.gethostname())
+
+
+def _unregister_tracker(shm) -> None:
+    # attach-side resource_tracker registration (fixed only in 3.12's
+    # track=False): without this, a reader process exiting would unlink
+    # segments the PUBLISHER still serves from
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # noqa: BLE001 - best-effort, platform-dependent
+        pass
+
+
+class ShmPublisher:
+    """Owner-side segment set for one instance's locally-owned shards.
+
+    Created by whoever runs the instance (``ParameterServer`` when
+    ``ps_shm_lane`` is on; benches/tests arm it directly) and handed to
+    :meth:`_Instance.attach_shm`; the server thread calls
+    :meth:`publish` after every apply. ``close`` unlinks everything."""
+
+    def __init__(self, port: int, inst: int):
+        self.port = int(port)
+        self.inst = int(inst)
+        self._segs: Dict[int, "object"] = {}  # rank -> SharedMemory
+        self._counters: Dict[int, int] = {}
+
+    def publish(self, rank: int, shard: np.ndarray, version: int) -> None:
+        """Seqlock-write ``shard`` (+ its delta ``version``) into the
+        rank's segment, creating it on first publish."""
+        from multiprocessing import shared_memory
+
+        arr = np.ascontiguousarray(shard)
+        seg = self._segs.get(rank)
+        if seg is None:
+            name = segment_name(self.port, self.inst, rank)
+            size = _HDR_SIZE + max(1, arr.nbytes)
+            try:
+                seg = shared_memory.SharedMemory(
+                    name=name, create=True, size=size
+                )
+            except FileExistsError:
+                # stale segment from a dead predecessor on this port:
+                # take it over (same name => same (port, inst, rank))
+                seg = shared_memory.SharedMemory(name=name)
+                if seg.size < size:
+                    seg.close()
+                    shared_memory.SharedMemory(name=name).unlink()
+                    seg = shared_memory.SharedMemory(
+                        name=name, create=True, size=size
+                    )
+            self._segs[rank] = seg
+            self._counters[rank] = 0
+        c = self._counters[rank] + 1  # odd: write in progress
+        buf = seg.buf
+        _HDR.pack_into(
+            buf, 0, _MAGIC, c, int(version), arr.nbytes,
+            arr.dtype.str.encode()[:8],
+        )
+        buf[_HDR_SIZE:_HDR_SIZE + arr.nbytes] = arr.tobytes()
+        c += 1  # even: payload + version consistent
+        _HDR.pack_into(
+            buf, 0, _MAGIC, c, int(version), arr.nbytes,
+            arr.dtype.str.encode()[:8],
+        )
+        self._counters[rank] = c
+
+    def close(self) -> None:
+        """Unlink every segment (readers mid-read keep their mapping
+        alive until they drop it; new attaches fail over to sockets)."""
+        for seg in self._segs.values():
+            try:
+                # a same-process reader's tracker unregistration (see
+                # _unregister_tracker) may have dropped OUR registration
+                # too (one tracker per process); re-register so unlink's
+                # own unregister finds it instead of spamming stderr
+                from multiprocessing import resource_tracker
+
+                resource_tracker.register(seg._name, "shared_memory")
+            except Exception:  # noqa: BLE001 - best-effort
+                pass
+            try:
+                seg.close()
+                seg.unlink()
+            except Exception:  # noqa: BLE001 - already unlinked / torn down
+                pass
+        self._segs.clear()
+        self._counters.clear()
+
+    def __del__(self):  # best-effort: never leak /dev/shm entries
+        self.close()
+
+
+class ShmReader:
+    """Client-side seqlock reader for one (owner port, inst, rank)
+    segment. ``read()`` returns ``(array copy, shard version)`` or
+    ``None`` (unpublished / persistently torn — caller uses the socket
+    path). Attach failures are retried at most once per
+    ``_ATTACH_RETRY_S`` so an unarmed publisher costs one failed open
+    per window, not per fetch."""
+
+    _ATTACH_RETRY_S = 1.0
+
+    def __init__(self, name: str):
+        self.name = name
+        self._shm = None
+        self._next_attach = 0.0
+        self.retries = 0  # torn-read retries observed (telemetry drain)
+
+    def _attached(self):
+        if self._shm is not None:
+            return self._shm
+        now = time.monotonic()
+        if now < self._next_attach:
+            return None
+        from multiprocessing import shared_memory
+
+        try:
+            shm = shared_memory.SharedMemory(name=self.name)
+        except (FileNotFoundError, OSError):
+            self._next_attach = now + self._ATTACH_RETRY_S
+            return None
+        _unregister_tracker(shm)
+        self._shm = shm
+        return shm
+
+    def read(self) -> Optional[Tuple[np.ndarray, int]]:
+        shm = self._attached()
+        if shm is None:
+            return None
+        buf = shm.buf
+        spins = max(1, int(constants.get("ps_shm_spin_limit")))
+        for _ in range(spins):
+            try:
+                magic, c1, version, nbytes, dt = _HDR.unpack_from(buf, 0)
+            except struct.error:
+                return None
+            if magic != _MAGIC or c1 == 0:
+                return None  # never published
+            if c1 & 1:
+                self.retries += 1
+                continue  # publish in progress
+            if _HDR_SIZE + nbytes > shm.size:
+                return None  # header torn beyond plausibility
+            payload = bytes(buf[_HDR_SIZE:_HDR_SIZE + nbytes])
+            c2 = _HDR.unpack_from(buf, 0)[1]
+            if c1 != c2:
+                self.retries += 1
+                continue  # raced a publish: torn payload, retry
+            try:
+                dtype = np.dtype(dt.rstrip(b"\0").decode())
+            except (TypeError, ValueError):
+                return None
+            return np.frombuffer(payload, dtype).copy(), int(version)
+        return None  # spin budget exhausted: socket fallback
+
+    def close(self) -> None:
+        if self._shm is not None:
+            try:
+                self._shm.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._shm = None

@@ -201,20 +201,37 @@ class PSGroup:
         self._prefetched = None
         return ranks, stacks
 
-    def receive_full(self, client: int = 0):
+    def receive_full(self, client: int = 0, read_policy=None):
         """Synchronously fetch the full center value of every leaf —
         all fetches issued first, then waited, so the per-leaf round
         trips overlap on the pipelined transport instead of serializing
-        (one leaf's wire time hides the next leaf's)."""
-        handles = [srv.receive(client=client) for srv in self.servers]
+        (one leaf's wire time hides the next leaf's).
+
+        The overlap only pays when the issues land on distinct
+        endpoints: under ``ps_read_policy=replica`` (or an explicit
+        ``read_policy``) each server's fan-out groups its fetch threads
+        by the ROUTED chain member — per-leaf round-robin cursors
+        stagger across leaves, so concurrent leaf fetches interleave
+        over the whole chain instead of queueing owner-ordered at the
+        heads."""
+        handles = [
+            srv.receive(client=client, read_policy=read_policy)
+            for srv in self.servers
+        ]
         leaves = [h.wait() for h in handles]
         return tree_util.tree_unflatten(self.treedef, leaves)
 
-    def prefetch_full(self, client: int = 0) -> List[SyncHandle]:
+    def prefetch_full(self, client: int = 0,
+                      read_policy=None) -> List[SyncHandle]:
         """Instance-level prefetch of every leaf (double-buffered per
         server, see :meth:`ParameterServer.prefetch`): the next
-        :meth:`receive_full` consumes these in-flight fetches."""
-        return [srv.prefetch(client=client) for srv in self.servers]
+        :meth:`receive_full` consumes these in-flight fetches. Routing
+        spreads across replica chains exactly as in
+        :meth:`receive_full`."""
+        return [
+            srv.prefetch(client=client, read_policy=read_policy)
+            for srv in self.servers
+        ]
 
     def free(self) -> None:
         for srv in self.servers:

@@ -170,6 +170,25 @@ def _metric_handles():
                 "re-probed; each expiry closes one bounded split-brain "
                 "window)",
             ),
+            m.counter(
+                "tm_ps_read_routes_total",
+                "fetches routed per serving lane (owner/replica/shm), "
+                "by lane and read policy",
+            ),
+            m.counter(
+                "tm_ps_read_fallbacks_total",
+                "fetch routing fallbacks to the owner, by reason "
+                "(stale/dead/shm)",
+            ),
+            m.counter(
+                "tm_ps_read_shm_retries_total",
+                "seqlock torn-read retries on the shared-memory fetch "
+                "lane (writer raced the read)",
+            ),
+            m.histogram(
+                "tm_ps_read_latency_seconds",
+                "fetch latency per serving lane, by lane",
+            ),
         )
     return _MET
 
@@ -217,8 +236,27 @@ def _srv_metric_handles():
                 "replica-chain forwards that failed; the chain degrades "
                 "to head-only for that successor",
             ),
+            m.counter(
+                "tm_ps_read_stale_redirects_total",
+                "fetches a chain member refused because its applied "
+                "high-water had not covered the client's session floor "
+                "(client re-fetches at the owner), by listener",
+            ),
         )
     return _SRV_MET
+
+
+class _StaleRead(Exception):
+    """A chain member refused a fetch because its applied high-water had
+    not covered the client's read-your-writes session floor (reply rule
+    ``stale:<hw>``). Internal routing signal: ``Transport.trigger``
+    catches it and redirects toward the owner — it never escapes to
+    callers."""
+
+    def __init__(self, proc: int, rule: str):
+        super().__init__(f"peer {proc} stale for session floor ({rule})")
+        self.proc = proc
+        self.rule = rule
 
 
 def busy_backoff_s(attempts: int, hint_ms: int = 0, rng=None) -> float:
@@ -974,7 +1012,21 @@ class _Listener:
         if kind not in (_KIND_UPDATE, _KIND_UPDATE_MULTI, _KIND_TRIGGER):
             reply(_KIND_ERROR, seq, rule=f"bad kind {kind}")
             return
-        if not self._admit(conn, kind, seq):
+        # chain-forward frames (a replica pump relaying an update the
+        # chain head ALREADY admitted) bypass admission: re-admitting at
+        # every hop multiplies the rejection probability and inverts
+        # priority — a BUSYed forward blocks the single in-order pump
+        # while the originating update holds its slot upstream, so
+        # replication traffic would starve behind the very client
+        # traffic it carries. Forwarded frames still occupy pending
+        # slots, so CLIENT traffic sheds first at a loaded replica —
+        # backpressure points at the right party. Depth stays bounded:
+        # each forward maps 1:1 to an update admitted under the head's
+        # own budget.
+        forwarded = kind == _KIND_UPDATE and rule.startswith("fwd:")
+        if forwarded:
+            rule = rule[4:]
+        if not forwarded and not self._admit(conn, kind, seq):
             reply(
                 _KIND_BUSY, seq,
                 rule=str(constants.get("ps_busy_retry_ms")),
@@ -1132,6 +1184,25 @@ class _Listener:
                 inst_id, rank, posted, timeout, t_admit,
             )
         else:  # _KIND_TRIGGER
+            if oseq:
+                # read-your-writes session floor: a replica-routed fetch
+                # carries the client's last-acked origin seq (minus the
+                # ps_read_staleness allowance). A member whose applied
+                # high-water has not covered it must not serve — the
+                # stale reply redirects the client to the owner, which
+                # is fresh by construction (it is the write point).
+                with self._applied_lock:
+                    hw = self._applied.get((inst_id, rank, client), 0)
+                if hw < oseq:
+                    if _telemetry.enabled():
+                        _srv_metric_handles()[7].inc(
+                            listener=str(self.port)
+                        )
+                    finish(
+                        _KIND_SHARD, seq, inst=inst_id, rank=rank,
+                        rule=f"stale:{hw}", dtype="<f4",
+                    )
+                    return
             f: Future = Future()
             delta_base = None
             delta_origin = 0
@@ -1464,6 +1535,10 @@ class _PeerChannel:
         self._busy_seqs: set = set()
         self._busy_due = 0.0
         self._busy_thread: Optional[threading.Thread] = None
+        # monotonic time of the last BUSY reject from this peer — the
+        # adaptive read policy's backpressure signal (stale value just
+        # means the owner recovered; reads drift back to it)
+        self.last_busy = 0.0
         self.closed = False
 
     def _connect(self) -> socket.socket:
@@ -1558,6 +1633,7 @@ class _PeerChannel:
             hint_ms = int(hint)
         except (TypeError, ValueError):
             hint_ms = 0
+        self.last_busy = time.monotonic()
         with self.lock:
             self._unacked_replays = 0
             self._last_reply = time.monotonic()
@@ -1977,13 +2053,20 @@ class Transport:
         host = os.environ.get("TORCHMPI_TPU_PS_HOST") or socket.gethostname()
         addresses = self._exchange_addresses(host, self.listener.port)
         self.pool = _PeerPool(addresses)
-        # delta-fetch client cache: (proc, inst, rank, client) ->
-        # (version, reconstruction). One in-flight delta round trip per
-        # key (the per-key lock): overlapping deltas against one snapshot
-        # would fork the client/server reconstruction agreement.
-        self._delta_cache: Dict[Tuple[int, int, int, int],
-                                Tuple[int, np.ndarray]] = {}
-        self._delta_locks: Dict[Tuple[int, int, int, int],
+        # delta-fetch client cache: (inst, rank, client) ->
+        # (serving proc, version, reconstruction). One in-flight delta
+        # round trip per key (the per-key lock): overlapping deltas
+        # against one snapshot would fork the client/server
+        # reconstruction agreement. The key is CHAIN-CONSISTENT (no
+        # proc): replica-aware routing may serve consecutive fetches of
+        # one shard from different chain members, and a per-proc key
+        # would let a replica-served delta poison the owner's recorded
+        # reconstruction. The serving proc lives in the VALUE instead —
+        # a fetch routed to a different member sends base=-1 (full,
+        # self-healing), because snapshot agreement is per member.
+        self._delta_cache: Dict[Tuple[int, int, int],
+                                Tuple[int, int, np.ndarray]] = {}
+        self._delta_locks: Dict[Tuple[int, int, int],
                                 threading.Lock] = {}
         self._delta_guard = _lockmon.make_lock(
             "transport.py:Transport._delta_guard"
@@ -2007,6 +2090,26 @@ class Transport:
         self._oseq_lock = _lockmon.make_lock(
             "transport.py:Transport._oseq_lock"
         )
+        # read-path state (PS read routing; see trigger()):
+        # - _acked: (inst, rank, client) -> highest origin seq this
+        #   process has been ACKED for — the read-your-writes session
+        #   floor a replica-routed fetch must have applied (guarded by
+        #   _oseq_lock, same lifecycle as _oseq);
+        # - _read_rr: (inst, rank) -> round-robin cursor spreading
+        #   fetches over the replica chain under ps_read_policy=replica;
+        # - _shm_readers / _shm_failed / _read_versions: the zero-copy
+        #   shared-memory lane's attach cache, the peers known to be on
+        #   another host (never retried), and the shard version each
+        #   shm-served fetch observed (consulted by serve's
+        #   version_vector, which otherwise only sees the delta cache).
+        self._read_rr: Dict[Tuple[int, int], int] = {}
+        self._read_lock = _lockmon.make_lock(
+            "transport.py:Transport._read_lock"
+        )
+        self._acked: Dict[Tuple[int, int, int], int] = {}
+        self._shm_readers: Dict[Tuple[int, int, int], object] = {}
+        self._shm_failed: set = set()
+        self._read_versions: Dict[Tuple[int, int, int], int] = {}
 
     @staticmethod
     def _exchange_addresses(host: str, port: int) -> Dict[int, Tuple[str, int]]:
@@ -2037,6 +2140,28 @@ class Transport:
             v = self._oseq.get((inst, rank, client), 0) + 1
             self._oseq[(inst, rank, client)] = v
             return v
+
+    def _record_acked(self, inst: int, rank: int, client: int,
+                      oseq: int) -> None:
+        """Advance the read-your-writes session floor: ``oseq`` was
+        ACKED (applied at its serving chain member), so any later fetch
+        by this client must observe at least it."""
+        if not oseq:
+            return
+        k = (inst, rank, client)
+        with self._oseq_lock:
+            if oseq > self._acked.get(k, 0):
+                self._acked[k] = oseq
+
+    def _session_floor(self, inst: int, rank: int, client: int) -> int:
+        """The origin seq a NON-owner chain member must have applied to
+        serve this client's fetch: last-acked minus the
+        ``ps_read_staleness`` allowance (0 = nothing written yet, or
+        everything written is inside the allowed lag — any member may
+        serve). The owner never needs a floor: it is the write point."""
+        with self._oseq_lock:
+            acked = self._acked.get((inst, rank, client), 0)
+        return max(0, acked - int(constants.get("ps_read_staleness")))
 
     def _dead_marks_gauge(self, ttl: float, now: float) -> None:
         if not _telemetry.enabled():
@@ -2109,6 +2234,7 @@ class Transport:
                 proc, _KIND_UPDATE, inst, rank, client,
                 fp=fp, rule=rule, payload_arr=payload, oseq=oseq,
             )
+            self._record_acked(inst, rank, client, oseq)
             return
         if not oseq:
             oseq = self.next_oseq(inst, rank, client)
@@ -2119,6 +2245,7 @@ class Transport:
                     p, _KIND_UPDATE, inst, rank, client,
                     fp=fp, rule=rule, payload_arr=payload, oseq=oseq,
                 )
+                self._record_acked(inst, rank, client, oseq)
                 return
             except ConnectionError as e:
                 self._mark_dead(p)
@@ -2134,10 +2261,14 @@ class Transport:
     ) -> None:
         """Chain-forward an APPLIED update to the next replica, keeping
         the original (client, oseq) dedup identity. Called by the
-        server-side replica pump in apply order."""
+        server-side replica pump in apply order. The ``fwd:`` rule tag
+        exempts the frame from the successor's admission budget (it was
+        admitted once, at the chain head — see the listener's bypass
+        note), so a loaded replica sheds client traffic, never the
+        replication stream that keeps it consistent."""
         self.pool.request(
             proc, _KIND_UPDATE, inst, rank, client,
-            fp=fp, rule=rule, payload_arr=payload, oseq=oseq,
+            fp=fp, rule=f"fwd:{rule}", payload_arr=payload, oseq=oseq,
         )
 
     def update_multi(
@@ -2213,70 +2344,246 @@ class Transport:
                 self._delta_cache.pop(next(iter(self._delta_cache)))
             self._delta_cache[key] = entry
 
+    def _read_candidates(
+        self, owner: int, inst: int, rank: int, chain, policy: str,
+        prefer: Optional[int] = None,
+    ) -> List[int]:
+        """The ordered chain members a fetch of ``rank`` tries, per the
+        read policy. ``owner``: the legacy availability walk — head
+        first, live replicas only as failover. ``replica``: rotate the
+        live chain round-robin so concurrent fetches of one shard land
+        on distinct endpoints. ``adaptive``: owner-preferred, spreading
+        only while the owner shows backpressure (a BUSY inside the last
+        second, or a dead-mark). ``prefer`` pins the first candidate (a
+        member already chosen by :meth:`route_read` so a caller's
+        fan-out grouping and the actual routing agree)."""
+        if chain is None or len(chain) <= 1:
+            return [owner]
+        alive = self._alive_chain(chain)
+        if policy == "replica":
+            spread = True
+        elif policy == "adaptive":
+            spread = self._owner_pressured(owner)
+        else:
+            spread = False
+        if not spread or len(alive) <= 1:
+            return alive
+        if prefer is not None and prefer in alive:
+            rot = alive.index(prefer)
+        else:
+            with self._read_lock:
+                i = self._read_rr.get((inst, rank), 0)
+                self._read_rr[(inst, rank)] = i + 1
+            rot = i % len(alive)
+        return alive[rot:] + alive[:rot]
+
+    def route_read(self, owner: int, inst: int, rank: int, chain,
+                   policy=None) -> int:
+        """The chain member the NEXT fetch of ``rank`` would be served
+        by under ``policy`` (advances the round-robin cursor). Callers
+        fanning out many fetches group their issue threads by this, so
+        the issue-all-then-wait overlap lands on distinct endpoints;
+        they pass the result back to :meth:`trigger` as ``prefer``."""
+        policy = str(policy or constants.get("ps_read_policy"))
+        return self._read_candidates(owner, inst, rank, chain, policy)[0]
+
+    def _owner_pressured(self, owner: int) -> bool:
+        ch = self.pool._channels.get(owner)
+        if ch is not None and time.monotonic() - ch.last_busy < 1.0:
+            return True
+        ttl = constants.get("ps_dead_peer_retry_s")
+        t = self._dead_procs.get(owner)
+        return t is not None and (
+            not ttl or time.monotonic() - t < ttl
+        )
+
+    def _shm_fetch(
+        self, owner: int, inst: int, rank: int, client: int,
+    ) -> Optional[np.ndarray]:
+        """The zero-copy lane: seqlock-read shard ``rank`` from the
+        owner's shared-memory segment, if the owner is on THIS host and
+        has published. None = lane unavailable or spin budget exhausted
+        (caller falls back to the socket path). Owner publishes before
+        acking, so this lane is read-your-writes with no session floor."""
+        if owner in self._shm_failed:
+            return None
+        key = (owner, inst, rank)
+        reader = self._shm_readers.get(key)
+        if reader is None:
+            from . import shmlane as _shm
+
+            addr = self.pool.addresses.get(owner)
+            if addr is None or not _shm.is_local_host(addr[0]):
+                self._shm_failed.add(owner)  # permanent: host won't move
+                return None
+            with self._read_lock:
+                reader = self._shm_readers.get(key)
+                if reader is None:
+                    reader = _shm.ShmReader(
+                        _shm.segment_name(addr[1], inst, rank)
+                    )
+                    self._shm_readers[key] = reader
+        before = reader.retries
+        res = reader.read()
+        if _telemetry.enabled() and reader.retries > before:
+            _metric_handles()[13].inc(reader.retries - before)
+        if res is None:
+            return None
+        arr, version = res
+        with self._read_lock:
+            k = (inst, rank, client)
+            if version > self._read_versions.get(k, 0):
+                self._read_versions[k] = version
+        return arr
+
     def trigger(
         self, proc: int, inst: int, rank: int, client: int, fp: int = 0,
-        logical_dtype=np.float32, chain=None,
+        logical_dtype=np.float32, chain=None, read_policy=None,
+        prefer=None,
     ) -> np.ndarray:
-        """Fetch shard ``rank``. Served by the chain head; on a dead
-        head the fetch fails over to the next live replica (which holds
-        the chain-forwarded state)."""
-        if chain is not None and len(chain) > 1:
-            last: Optional[Exception] = None
-            for p in self._alive_chain(chain):
-                try:
-                    return self._trigger_one(
-                        p, inst, rank, client, fp, logical_dtype
+        """Fetch shard ``rank``. Lanes, in preference order:
+
+        1. **shm** (``ps_shm_lane``): same-host owner segment, seqlock
+           read, no sockets — read-your-writes by publish-before-ack;
+        2. **socket**, routed by ``read_policy`` (default the
+           ``ps_read_policy`` knob) over the replica ``chain`` via
+           :meth:`_read_candidates`. Non-owner members receive this
+           client's session floor (:meth:`_session_floor`) and answer
+           ``stale:<hw>`` instead of serving a view older than the
+           client's own acked writes — the client then redirects to the
+           owner (the "forward to the owner" of the session contract,
+           executed client-side so the redirect rides the existing
+           failover machinery). Dead members are marked (PR 8 walk) and
+           skipped for ``ps_dead_peer_retry_s``.
+
+        Last resort is always one direct owner attempt (even through a
+        dead-mark — it may have recovered): a stale or dying replica set
+        must never fail a fetch the owner can still serve."""
+        policy = str(read_policy or constants.get("ps_read_policy"))
+        want_t = _telemetry.enabled()
+        t0 = time.monotonic() if want_t else 0.0
+        if constants.get("ps_shm_lane"):
+            arr = self._shm_fetch(proc, inst, rank, client)
+            if arr is not None:
+                if want_t:
+                    _metric_handles()[11].inc(lane="shm", policy=policy)
+                    _metric_handles()[14].observe(
+                        time.monotonic() - t0, lane="shm"
                     )
-                except ConnectionError as e:
-                    self._mark_dead(p)
-                    last = e
-            raise ConnectionError(
-                f"all replicas of shard {rank} (chain {list(chain)}) "
-                f"unreachable: {last}"
-            )
-        return self._trigger_one(proc, inst, rank, client, fp, logical_dtype)
+                return arr
+            if want_t:
+                _metric_handles()[12].inc(reason="shm")
+        floor = self._session_floor(inst, rank, client)
+        last: Optional[Exception] = None
+        owner_tried = False
+        for p in self._read_candidates(
+            proc, inst, rank, chain, policy, prefer=prefer,
+        ):
+            need = 0 if p == proc or policy == "owner" else floor
+            try:
+                arr = self._trigger_one(
+                    p, inst, rank, client, fp, logical_dtype,
+                    need_oseq=need,
+                )
+            except _StaleRead:
+                # the member's applied high-water hasn't covered this
+                # client's session floor: redirect toward the owner
+                if want_t:
+                    _metric_handles()[12].inc(reason="stale")
+                continue
+            except ConnectionError as e:
+                self._mark_dead(p)
+                last = e
+                if want_t and p != proc:
+                    _metric_handles()[12].inc(reason="dead")
+                owner_tried = owner_tried or p == proc
+                continue
+            if want_t:
+                lane = "owner" if p == proc else "replica"
+                _metric_handles()[11].inc(lane=lane, policy=policy)
+                _metric_handles()[14].observe(
+                    time.monotonic() - t0, lane=lane
+                )
+            return arr
+        if not owner_tried:
+            # every candidate was stale/dead and none was the owner (or
+            # the owner sat dead-marked outside the candidate walk):
+            # one direct re-probe — the owner needs no session floor
+            try:
+                arr = self._trigger_one(
+                    proc, inst, rank, client, fp, logical_dtype
+                )
+                if want_t:
+                    _metric_handles()[11].inc(lane="owner", policy=policy)
+                    _metric_handles()[14].observe(
+                        time.monotonic() - t0, lane="owner"
+                    )
+                return arr
+            except ConnectionError as e:
+                self._mark_dead(proc)
+                last = e
+        raise ConnectionError(
+            f"all replicas of shard {rank} "
+            f"(chain {list(chain) if chain else [proc]}) "
+            f"unreachable: {last}"
+        )
 
     def _trigger_one(
         self, proc: int, inst: int, rank: int, client: int, fp: int = 0,
-        logical_dtype=np.float32,
+        logical_dtype=np.float32, need_oseq: int = 0,
     ) -> np.ndarray:
         wire_req = _wire.resolve_ps_wire(logical_dtype)
         if not constants.get("parameterserver_delta_encoding"):
-            return self.pool.request(
+            w = self.pool.submit(
                 proc, _KIND_TRIGGER, inst, rank, client, fp=fp,
-                wire=wire_req,
+                wire=wire_req, oseq=need_oseq,
             )
+            arr = self.pool.complete(proc, w)
+            if need_oseq and w.reply[6].startswith("stale:"):
+                raise _StaleRead(proc, w.reply[6])
+            return arr
         # delta-encoded fetch: ship only the since-last-fetch difference
         # against the per-client version vector. Unchanged shard -> empty
         # 'same' reply (the big win for prefetch loops between sparse
         # updates); changed -> a delta, which quantizes on small scales
         # (tighter int8 error than a full-shard fetch); version mismatch
         # or server-side eviction -> a fresh full shard, self-healing.
-        key = (proc, inst, rank, client)
+        # The cache key is chain-consistent (no proc); the base version
+        # is offered only to the member that RECORDED the matching
+        # reconstruction — snapshot agreement is per member, so a fetch
+        # routed elsewhere goes base=-1 (full reply, re-anchoring the
+        # cache at the new member).
+        key = (inst, rank, client)
         with self._delta_lock_for(key):
             cached = self._delta_cache.get(key)
-            base = cached[0] if cached is not None else -1
+            if cached is not None and cached[0] == proc:
+                base, recon = cached[1], cached[2]
+            else:
+                base, recon = -1, None
             w = self.pool.submit(
                 proc, _KIND_TRIGGER, inst, rank, client, fp=fp,
                 rule=f"delta:{base}:{self.process_index}", wire=wire_req,
+                oseq=need_oseq,
             )
             arr = self.pool.complete(proc, w)
             rrule = w.reply[6]
+            if need_oseq and rrule.startswith("stale:"):
+                raise _StaleRead(proc, rrule)
             if _telemetry.enabled():
                 outcome = rrule.split(":", 1)[0] or "legacy"
                 _metric_handles()[7].inc(reply=outcome)
             if rrule.startswith("same:"):
                 version = int(rrule.split(":")[1])
-                self._delta_cache_store(key, (version, cached[1]))
-                return cached[1].copy()
+                self._delta_cache_store(key, (proc, version, recon))
+                return recon.copy()
             if rrule.startswith("delta:"):
                 _, _, version = rrule.split(":")
-                new = cached[1] + arr
-                self._delta_cache_store(key, (int(version), new))
+                new = recon + arr
+                self._delta_cache_store(key, (proc, int(version), new))
                 return new.copy()
             if rrule.startswith("full:"):
                 version = int(rrule.split(":")[1])
-                self._delta_cache_store(key, (version, arr.copy()))
+                self._delta_cache_store(key, (proc, version, arr.copy()))
                 return arr
             return arr  # peer predates delta mode: plain shard reply
 

@@ -195,11 +195,24 @@ class InferenceServer:
         return bound
 
     def refresh_once(self) -> bool:
-        """One fetch-and-maybe-swap; returns whether a swap landed."""
+        """One fetch-and-maybe-swap; returns whether a swap landed.
+
+        The fetch rides ``serve_refresh_read_policy`` (default
+        ``replica``): background weight refreshes spread over the shard
+        replica chains instead of competing with training updates at
+        the owner. Freshness is preserved — the version vector the swap
+        keys on is chain-consistent, and the read-your-writes floor
+        redirects a too-stale replica to the owner."""
         met = _metric_handles() if _telemetry.enabled() else None
         try:
             arr = np.asarray(
-                self.ps.receive(self.client).wait(), np.float32
+                self.ps.receive(
+                    self.client,
+                    read_policy=(
+                        constants.get("serve_refresh_read_policy") or None
+                    ),
+                ).wait(),
+                np.float32,
             )
         except Exception:  # noqa: BLE001 - refresh is best-effort
             if met is not None:

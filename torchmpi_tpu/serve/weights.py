@@ -23,10 +23,12 @@ def version_vector(ps, client: int = 0) -> Tuple[int, ...]:
     """The per-shard version vector a serving fetch pairs with its
     assembled tensor: local shards read the instance's applied-update
     counters directly; remote shards read the delta-fetch client cache
-    (the version the last ``receive`` reconstructed against). Remote
-    shards never fetched through the delta path report -1 — the swap
-    treats ANY vector change as fresh, so the degenerate vector still
-    swaps once and then holds."""
+    (the version the last ``receive`` reconstructed against — the key
+    is chain-consistent, so a replica-served fetch reports its version
+    just like an owner-served one) or, when newer, the version the
+    zero-copy shm lane observed. Remote shards never fetched through
+    either path report -1 — the swap treats ANY vector change as fresh,
+    so the degenerate vector still swaps once and then holds."""
     inst = ps._inst
     transport = ps._transport
     vec = []
@@ -34,10 +36,13 @@ def version_vector(ps, client: int = 0) -> Tuple[int, ...]:
         if inst.has_storage(r):
             vec.append(int(inst.versions[r]))
         elif transport is not None:
-            cached = transport._delta_cache.get(
-                (inst.owners[r], inst.id, r, client)
-            )
-            vec.append(int(cached[0]) if cached is not None else -1)
+            key = (inst.id, r, client)
+            cached = transport._delta_cache.get(key)
+            v = int(cached[1]) if cached is not None else -1
+            shm_v = transport._read_versions.get(key)
+            if shm_v is not None and int(shm_v) > v:
+                v = int(shm_v)
+            vec.append(v)
         else:
             vec.append(-1)
     return tuple(vec)

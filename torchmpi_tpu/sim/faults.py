@@ -164,6 +164,23 @@ def check_expectations(expected: dict, report: dict,
             f"busy rejections: expected >= "
             f"{expected['busy_rejected_min']}, got {got}",
         )
+    if "busy_rejected_max" in expected:
+        got = sum(
+            (s.get("connections") or {}).get("busy_rejected", 0)
+            for s in report.get("ps", {}).get("servers", {}).values()
+        )
+        need(
+            got <= expected["busy_rejected_max"],
+            f"busy rejections: expected <= "
+            f"{expected['busy_rejected_max']}, got {got}",
+        )
+    if "ps_reads_min" in expected:
+        got = (stats.get("ps") or {}).get("reads", 0)
+        need(
+            got >= expected["ps_reads_min"],
+            f"ps reads served: expected >= "
+            f"{expected['ps_reads_min']}, got {got}",
+        )
     if "dead_mark_expiries_min" in expected:
         got = sum(
             (s.get("connections") or {}).get("dead_mark_expiries", 0)
@@ -402,6 +419,7 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
                 updates_per_client=int(
                     ps.get("updates_per_client", 40)
                 ),
+                read_frac=float(ps.get("read_frac", 0.0)),
             )
         if "serve" in scn:
             sv = dict(scn["serve"])
@@ -420,6 +438,8 @@ def run_scenario(src, out_dir, seed: Optional[int] = None,
             # fluid counters carry float dust: the report's rollup is
             # rounded so the per-seed byte-identity contract holds
             stats["serve"] = fleet.serve.rollup()
+        if fleet.ps is not None:
+            stats["ps"] = dict(fleet.ps.stats)
         out = Path(out_dir)
         fleet.dump_telemetry(out)
         run = load_run(out)

@@ -781,7 +781,8 @@ class SimPS:
     def __init__(self, fleet: SimFleet, servers: int, replication: int = 1,
                  clients: int = 8, payload_bytes: int = 1 << 16,
                  interval_s: float = 0.02, apply_us: float = 0.0,
-                 updates_per_client: int = 40, start_t: float = 0.1):
+                 updates_per_client: int = 40, start_t: float = 0.1,
+                 read_frac: float = 0.0):
         self.fleet = fleet
         self.rng = rng_for(fleet.seed, "ps")
         self.owners = list(range(int(servers)))
@@ -806,7 +807,13 @@ class SimPS:
             if first + i < nranks
         ]
         self.stats = {"acked": 0, "busy": 0, "failovers": 0,
-                      "unroutable": 0}
+                      "unroutable": 0, "reads": 0}
+        # read traffic: each client op is a hot-shard (shard 0) FETCH
+        # with probability read_frac, routed per ps_read_policy —
+        # "owner" pins every fetch to the chain head, anything else
+        # rotates across live chain members (the replica-spread path)
+        self.read_frac = max(0.0, min(1.0, float(read_frac)))
+        self._read_rr: Dict[int, int] = {}
         self._marks: Dict[int, Dict[int, float]] = {
             c: {} for c in self.clients
         }
@@ -886,6 +893,38 @@ class SimPS:
             self._client_metrics(c)
         return None
 
+    def _route_read(self, c: int):
+        """Fetch routing for the hot shard honoring ``ps_read_policy``
+        on the virtual clock: owner policy funnels every read to the
+        chain head; replica/adaptive rotate the client's reads across
+        the live chain members (the transport's replica-spread walk,
+        same dead-mark bookkeeping as writes)."""
+        now = self.fleet.loop.now
+        self._sweep_marks(c)
+        marks = self._marks[c]
+        chain = self.chains[0]
+        candidates = [p for p in chain if p not in marks] or list(chain)
+        if str(constants.get("ps_read_policy")) != "owner" \
+                and len(candidates) > 1:
+            # rotation starts at the client's own offset: a fleet that
+            # all starts at index 0 would stampede the head on its
+            # first synchronized fetch round
+            i = self._read_rr.get(c, c) % len(candidates)
+            self._read_rr[c] = i + 1
+            candidates = candidates[i:] + candidates[:i]
+        for p in candidates:
+            srv = self.fleet._by_rank(p)
+            cli = self.fleet._by_rank(c)
+            if (
+                srv is not None and srv.alive and cli is not None
+                and srv.reachable(cli)
+            ):
+                return p
+            marks[p] = now
+            self.stats["failovers"] += 1
+            self._client_metrics(c)
+        return None
+
     def _count_expiry(self, c: int) -> None:
         self._expiries[c] += 1
         sr = self.fleet._by_rank(c)
@@ -911,26 +950,35 @@ class SimPS:
             "peers skipped by failover routing",
         ).set(active)
 
-    def _send(self, c: int, seq: int, attempts: int) -> None:
+    def _send(self, c: int, seq: int, attempts: int,
+              kind: str = None) -> None:
         if seq > self.updates_per_client or self.fleet._finished:
             return
         cli = self.fleet._by_rank(c)
         if cli is None or not cli.alive:
             return
-        p = self._route(c, seq)
+        if kind is None:  # BUSY retries keep their original kind
+            kind = (
+                "fetch"
+                if self.read_frac and self.rng.random() < self.read_frac
+                else "update"
+            )
+        p = self._route_read(c) if kind == "fetch" else self._route(c, seq)
         if p is None:
             self.stats["unroutable"] += 1
             self.fleet.loop.after(
-                self.interval_s, self._send, c, seq, 0
+                self.interval_s, self._send, c, seq, 0, kind
             )
             return
-        lat = self.fleet.net.latency_s(c, p, self.payload_bytes)
+        nbytes = 64 if kind == "fetch" else self.payload_bytes
+        lat = self.fleet.net.latency_s(c, p, nbytes)
         self.fleet.loop.after(
-            lat, self._arrive, p, c, seq, attempts, self.fleet.loop.now
+            lat, self._arrive, p, c, seq, attempts, self.fleet.loop.now,
+            kind,
         )
 
     def _arrive(self, p: int, c: int, seq: int, attempts: int,
-                sent_t: float) -> None:
+                sent_t: float, kind: str = "update") -> None:
         srv_rank = self.fleet._by_rank(p)
         cli = self.fleet._by_rank(c)
         if (
@@ -941,14 +989,17 @@ class SimPS:
             self._marks[c][p] = self.fleet.loop.now
             self.stats["failovers"] += 1
             self._client_metrics(c)
-            self.fleet.loop.after(0.001, self._send, c, seq, attempts)
+            self.fleet.loop.after(
+                0.001, self._send, c, seq, attempts, kind
+            )
             return
         srv = self.servers.setdefault(
             p, {"pending": 0, "next_free": 0.0, "floors": {}, "busy": 0}
         )
         budget = int(constants.get("ps_pending_frame_budget"))
         admit, srv["floors"][c] = admission_decision(
-            srv["pending"], budget, srv["floors"].get(c), seq, True
+            srv["pending"], budget, srv["floors"].get(c), seq,
+            kind == "update",
         )
         reg = srv_rank.metrics()
         now = self.fleet.loop.now
@@ -965,7 +1016,7 @@ class SimPS:
             )
             reply_lat = self.fleet.net.latency_s(p, c, 64)
             self.fleet.loop.after(
-                reply_lat + back, self._send, c, seq, attempts + 1
+                reply_lat + back, self._send, c, seq, attempts + 1, kind
             )
             return
         srv["pending"] += 1
@@ -975,17 +1026,21 @@ class SimPS:
         reg.histogram(
             "tm_ps_server_queue_seconds",
             "admission-to-apply-start wait per admitted PS frame",
-        ).observe(start - now, kind="update")
+        ).observe(start - now, kind=kind)
         reg.histogram(
             "tm_ps_server_apply_seconds",
             "apply time per admitted PS frame",
-        ).observe(self.apply_s, kind="update")
-        self.fleet.loop.at(done, self._done, p, c, seq, sent_t)
+        ).observe(self.apply_s, kind=kind)
+        self.fleet.loop.at(done, self._done, p, c, seq, sent_t, kind)
 
-    def _done(self, p: int, c: int, seq: int, sent_t: float) -> None:
+    def _done(self, p: int, c: int, seq: int, sent_t: float,
+              kind: str = "update") -> None:
         srv = self.servers[p]
         srv["pending"] -= 1
-        self.stats["acked"] += 1
+        if kind == "fetch":
+            self.stats["reads"] += 1
+        else:
+            self.stats["acked"] += 1
         srv_rank = self.fleet._by_rank(p)
         if srv_rank is not None:
             reply_lat = self.fleet.net.latency_s(p, c, 64)
@@ -993,10 +1048,12 @@ class SimPS:
                 "tm_ps_rpc_latency_seconds",
                 "submit-to-reply latency per PS frame",
             ).observe(
-                self.fleet.loop.now + reply_lat - sent_t, kind="update"
+                self.fleet.loop.now + reply_lat - sent_t, kind=kind
             )
+        # a fetch does not advance the client's update sequence
         self.fleet.loop.after(
-            self.interval_s, self._send, c, seq + 1, 0
+            self.interval_s, self._send, c,
+            seq if kind == "fetch" else seq + 1, 0
         )
 
 

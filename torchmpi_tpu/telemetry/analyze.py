@@ -362,11 +362,12 @@ def _series_labels(label_str: str) -> dict:
     return out
 
 
-def _kind_series(metrics: dict, name: str) -> dict:
-    """Histogram series of ``name`` keyed by its ``kind`` label."""
+def _kind_series(metrics: dict, name: str, label: str = "kind") -> dict:
+    """Histogram series of ``name`` keyed by one of its labels
+    (``kind`` by default; the read-lane series key on ``lane``)."""
     out = {}
     for label_str, h in metrics.get(name, {}).get("series", {}).items():
-        kind = _series_labels(label_str).get("kind", label_str)
+        kind = _series_labels(label_str).get(label, label_str)
         out[kind] = {
             "count": h.get("count"),
             "mean_s": (
@@ -436,9 +437,46 @@ def ps_health(
         ).get("series", {}).items():
             lst = _series_labels(label_str).get("listener", label_str)
             busy_by_listener[lst] = busy_by_listener.get(lst, 0) + v
+        # read-path attribution, split by serving lane (owner socket /
+        # replica socket / same-host shm): where fetches were routed,
+        # why any fell back to the owner (stale floor, dead member, shm
+        # miss), seqlock contention, and per-lane latency — the
+        # read-side twin of the queue-vs-apply write attribution
+        reads: Dict[str, dict] = {}
+        routes: Dict[str, float] = {}
+        for label_str, v in metrics.get(
+            "tm_ps_read_routes_total", {}
+        ).get("series", {}).items():
+            lane = _series_labels(label_str).get("lane", label_str)
+            routes[lane] = routes.get(lane, 0) + v
+        if routes:
+            reads["routes_by_lane"] = routes
+        fallbacks: Dict[str, float] = {}
+        for label_str, v in metrics.get(
+            "tm_ps_read_fallbacks_total", {}
+        ).get("series", {}).items():
+            reason = _series_labels(label_str).get("reason", label_str)
+            fallbacks[reason] = fallbacks.get(reason, 0) + v
+        if fallbacks:
+            reads["fallbacks_by_reason"] = fallbacks
+        shm_retries = metrics.get(
+            "tm_ps_read_shm_retries_total", {}
+        ).get("series", {})
+        if shm_retries:
+            reads["shm_seqlock_retries"] = sum(shm_retries.values())
+        stale_srv = metrics.get(
+            "tm_ps_read_stale_redirects_total", {}
+        ).get("series", {})
+        if stale_srv:
+            reads["stale_redirects_served"] = sum(stale_srv.values())
+        read_lat = _kind_series(
+            metrics, "tm_ps_read_latency_seconds", label="lane"
+        )
+        if read_lat:
+            reads["latency_by_lane"] = read_lat
         listener = metrics.get("ps_listener")
         timeline = metrics.get("ps_queue_timeline") or []
-        if rpc or listener or timeline or attribution or connections:
+        if rpc or listener or timeline or attribution or connections or reads:
             entry = {
                 "rpc_latency": rpc,
                 "server_time": attribution,
@@ -449,6 +487,8 @@ def ps_health(
                     (p.get("queue_depth") or 0 for p in timeline), default=None
                 ) if timeline else None,
             }
+            if reads:
+                entry["reads"] = reads
             if busy_by_listener:
                 entry["busy_by_listener"] = busy_by_listener
                 if interval_s:
