@@ -123,6 +123,12 @@ run_perf_smoke() {
     # `desync: none` analyzer report.
     echo "=== telemetry smoke (2-proc flight recorder + analyzer) ==="
     python scripts/telemetry_smoke.py
+    # causal-tracing smoke: the same 2-proc shape with a trace-stamped
+    # step loop must yield >=1 CROSS-RANK flow arrow in the merged
+    # Perfetto trace and a critical-path attribution whose bucket sums
+    # cover >=95% of each rank's step wall time.
+    echo "=== trace smoke (2-proc causal flows + critical path) ==="
+    python scripts/trace_smoke.py
     # live-plane smoke: a 2-proc job with --telemetry-live must serve
     # fleet Prometheus + JSON (per-rank seq high-waters) and a streaming
     # `desync: none` verdict WHILE still running, the top CLI must

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""CI smoke for causal fleet tracing: flow events + critical path.
+
+Runs a short 2-process job through ``python -m torchmpi_tpu.launch
+--telemetry-dir`` where each rank issues an identical trace-stamped
+collective sequence, then runs the cross-rank analyzer and asserts the
+causal-tracing contract end to end:
+
+- ONE merged Perfetto trace exists and contains at least one CROSS-RANK
+  flow (a ``ph: s`` arrow whose flow id also appears on a different
+  rank's track — the analyzer joined the same logical collective across
+  pid tracks);
+- the critical-path attribution in ``analysis.json`` covers >= 95% of
+  each rank's step wall time (the sweep's bucket sums account for the
+  window — nothing silently unattributed);
+- the per-rank dumps carry trace-stamped flight entries (the ambient
+  trace context reached the recorder).
+
+Same hermetic shape as ``telemetry_smoke.py``: the ranks do NOT form a
+jax.distributed world — the path under test is host-side journal
+assembly. Exits non-zero on any failed assertion — wired into
+``scripts/ci.sh fast``.
+"""
+
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.pop("TORCHMPI_TPU_COORDINATOR", None)
+import numpy as np
+import jax
+import torchmpi_tpu as mpi
+from torchmpi_tpu.telemetry import tracecontext
+
+mpi.start()
+p = mpi.current_communicator().size
+# identical trace-stamped step loop on every rank: new_trace derives the
+# SAME deterministic trace id from the same parts, so the analyzer's
+# cross-rank joins see one logical step per ordinal
+for i in range(4):
+    with tracecontext.use(tracecontext.new_trace("smoke.step", i)):
+        mpi.allreduce_tensor(np.ones((p, 64), np.float32))
+mpi.broadcast_tensor(np.ones((p, 16), np.float32), root=0)
+mpi.stop()
+print("trace smoke rank ok", flush=True)
+"""
+
+
+def main() -> int:
+    tmp = Path(tempfile.mkdtemp(prefix="tm_trace_smoke_"))
+    worker = tmp / "worker.py"
+    worker.write_text(WORKER.format(repo=str(REPO)))
+    tel = tmp / "tel"
+
+    launch = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.launch",
+         "--nproc", "2", "--cpu-devices", "2",
+         "--telemetry-dir", str(tel), str(worker)],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=300,
+    )
+    if launch.returncode != 0:
+        print(launch.stdout[-3000:])
+        print("trace smoke FAILED: launch rc != 0", file=sys.stderr)
+        return 1
+
+    analyze = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.telemetry.analyze", str(tel),
+         "--strict", "--critical-path"],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    print(analyze.stdout, end="")
+
+    trace_path = tel / "merged.trace.json"
+    report_path = tel / "analysis.json"
+    if not (trace_path.exists() and report_path.exists()):
+        print("trace smoke FAILED: analyzer outputs missing",
+              file=sys.stderr)
+        return 1
+    trace = json.loads(trace_path.read_text())
+    report = json.loads(report_path.read_text())
+
+    # cross-rank flow arrows: group s/t/f events by flow id; a flow that
+    # touches >= 2 pid tracks is a causal edge ACROSS ranks
+    flow_pids = {}
+    starts = finishes = 0
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") in ("s", "t", "f") and str(
+            ev.get("cat", "")
+        ).startswith("flow."):
+            flow_pids.setdefault(ev["id"], set()).add(ev.get("pid"))
+            if ev["ph"] == "s":
+                starts += 1
+            elif ev["ph"] == "f":
+                finishes += 1
+    cross_rank_flows = sum(
+        1 for pids in flow_pids.values() if len(pids) >= 2
+    )
+
+    # critical-path attribution: bucket sums must cover >= 95% of each
+    # rank's step wall time (the sweep leaves nothing unattributed)
+    cp = report.get("critical_path", {})
+    cp_ranks = cp.get("ranks", {})
+    coverage_ok = bool(cp_ranks)
+    for rank, row in cp_ranks.items():
+        window = float(row.get("window_us") or 0.0)
+        bucket_sum = sum(float(v) for v in row.get(
+            "buckets_us", {}
+        ).values())
+        if window > 0 and bucket_sum < 0.95 * window:
+            coverage_ok = False
+            print(f"  rank {rank}: buckets {bucket_sum:.1f}us vs window "
+                  f"{window:.1f}us", file=sys.stderr)
+
+    # trace stamping reached the per-rank journals
+    stamped = 0
+    for dump in sorted(tel.glob("telemetry_rank_*.json")):
+        if dump.name.endswith(".trace.json"):
+            continue
+        data = json.loads(dump.read_text())
+        for e in data.get("flight_recorder", {}).get("entries", []):
+            if int(e.get("trace") or 0):
+                stamped += 1
+
+    checks = {
+        "analyzer clean (rc 0 under --strict)": analyze.returncode == 0,
+        "merged trace has flow starts and finishes":
+            starts >= 1 and finishes >= 1,
+        ">=1 cross-rank flow (one id on >=2 rank tracks)":
+            cross_rank_flows >= 1,
+        "critical-path buckets cover >=95% of each rank window":
+            coverage_ok,
+        "report carries overlap ledger + serve hops keys":
+            "overlap" in report and "serve_hops" in report,
+        "trace-stamped flight entries in the dumps": stamped >= 2,
+    }
+    failed = [name for name, passed in checks.items() if not passed]
+    for name, passed in checks.items():
+        print(f"  [{'ok' if passed else 'FAIL'}] {name}")
+    if failed:
+        print(f"trace smoke FAILED: {failed}", file=sys.stderr)
+        return 1
+    print("trace smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

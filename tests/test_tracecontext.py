@@ -1,0 +1,763 @@
+"""Causal trace-context tests: deterministic ids, the wire propagation
+matrix (update, delta fetch, BUSY replay, chain forward, shm-lane
+fallback, serve request, resize barrier), critical-path DAG attribution
+on hand-built journals, the overlap ledger vs the PR 15 stage model,
+serve-hop decomposition, clock-drift hardening, and the TPL205
+frame-documentation lint.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import constants, telemetry
+from torchmpi_tpu.telemetry import criticalpath as cp
+from torchmpi_tpu.telemetry import flightrecorder as flight
+from torchmpi_tpu.telemetry import tracecontext as tc
+
+
+@pytest.fixture(autouse=True)
+def _start():
+    mpi.start()
+    yield
+    from torchmpi_tpu.parameterserver import free_all
+
+    free_all()
+
+
+@pytest.fixture
+def recorder():
+    """Armed, pristine flight recorder for propagation assertions."""
+    flight.recorder.reset()
+    flight.enable()
+    yield flight.recorder
+    flight.disable()
+    flight.recorder.reset()
+
+
+def _register_instance(n, dtype=np.float32):
+    from torchmpi_tpu.parameterserver.server import _server
+
+    return _server.register(np.zeros(n, dtype), 1), _server
+
+
+def _client_entries(op=None):
+    return [
+        e for e in flight.recorder.entries()
+        if e["comm"].startswith("ps:")
+        and not e["comm"].startswith("ps:server:")
+        and (op is None or e["op"] == op)
+    ]
+
+
+def _server_entries(op=None):
+    return [
+        e for e in flight.recorder.entries()
+        if e["comm"].startswith("ps:server:")
+        and (op is None or e["op"] == op)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# id derivation
+# ---------------------------------------------------------------------------
+
+
+def test_fnv1a64_deterministic_separated_nonzero():
+    assert tc.fnv1a64("a", "b") == tc.fnv1a64("a", "b")
+    # the 0x1F part separator: regrouping the same bytes changes the id
+    assert tc.fnv1a64("ab", "c") != tc.fnv1a64("a", "bc")
+    assert tc.fnv1a64() != 0
+    assert 0 < tc.fnv1a64("x") < 1 << 64
+
+
+def test_new_trace_agrees_across_ranks():
+    """Two ranks deriving the root of the same logical step land on the
+    same trace id WITHOUT talking to each other (SPMD determinism)."""
+    a = tc.new_trace("engine.step", 7)
+    b = tc.new_trace("engine.step", 7)
+    assert (a.trace_id, a.span_id) == (b.trace_id, b.span_id)
+    assert tc.new_trace("engine.step", 8).trace_id != a.trace_id
+
+
+def test_child_and_stamp_derivation():
+    root = tc.new_trace("serve", 0, "infer", 1)
+    child = root.child("hop", 1)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id not in (0, root.span_id)
+    # no ambient context: stamp is the all-zero no-op
+    assert tc.stamp("x") == (0, 0, 0)
+    with tc.use(root):
+        trace, span, parent = tc.stamp("comm", "op", 3)
+        assert trace == root.trace_id and parent == root.span_id
+        assert span == tc.fnv1a64(root.trace_id, root.span_id,
+                                  "comm", "op", 3)
+
+
+def test_from_wire_zero_is_none_and_roundtrip():
+    assert tc.TraceContext.from_wire(0, 123) is None
+    ctx = tc.TraceContext.from_wire(11, 22)
+    assert (ctx.trace_id, ctx.span_id) == (11, 22)
+    assert tc.new_trace("a").to_wire()[0] == tc.new_trace("a").trace_id
+
+
+# ---------------------------------------------------------------------------
+# wire header
+# ---------------------------------------------------------------------------
+
+
+def test_frame_header_carries_trace_and_span():
+    from torchmpi_tpu.parameterserver import transport as T
+
+    header, rule_b, dtype_b = T._frame_header(
+        T._KIND_UPDATE, 5, 1, 2, 9, 0, 0, 0, "add", "<f4", 16, 0,
+        0xDEAD_BEEF_0BAD_F00D, 0x1234_5678_9ABC_DEF0,
+    )
+    fields = T._HEADER.unpack(header)
+    assert fields[-2] == 0xDEAD_BEEF_0BAD_F00D  # trace
+    assert fields[-1] == 0x1234_5678_9ABC_DEF0  # span
+    # unstamped frames stay unstamped (0 = no-context wire sentinel)
+    header0, _, _ = T._frame_header(
+        T._KIND_UPDATE, 5, 1, 2, 9, 0, 0, 0, "add", "<f4", 16, 0, 0, 0,
+    )
+    assert T._HEADER.unpack(header0)[-2:] == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# propagation matrix
+# ---------------------------------------------------------------------------
+
+
+def test_update_and_fetch_propagation_client_to_server(recorder):
+    """The core contract: the client stamps (trace, span) from the
+    ambient context; the server records its work with parent = the
+    client's span and a deterministic server-side span."""
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _server
+
+    inst, _ = _register_instance(64)
+    t = T.Transport(_server.get_instance)
+    try:
+        ctx = tc.new_trace("test.step", 1)
+        with tc.use(ctx):
+            t.update(0, inst.id, 0, 0, "add",
+                     np.ones(64, np.float32), fp=inst.fingerprint)
+            t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)
+        ups = _client_entries("update")
+        assert ups and all(e["trace"] == ctx.trace_id for e in ups)
+        client = ups[0]
+        assert client["span"] not in (0, ctx.span_id)
+        assert client["parent"] == ctx.span_id
+        srv = [e for e in _server_entries("update")
+               if e["parent"] == client["span"]]
+        assert len(srv) == 1
+        assert srv[0]["trace"] == ctx.trace_id
+        port = int(srv[0]["comm"].rsplit(":", 1)[1])
+        assert srv[0]["span"] == tc.fnv1a64(
+            ctx.trace_id, "ps:server", port, client["seq"]
+        )
+        # the fetch leg of the matrix: trigger frames carry the same
+        # ambient trace and the server joins by span -> parent
+        trig = _client_entries("trigger")
+        assert trig and all(e["trace"] == ctx.trace_id for e in trig)
+        spans = {e["span"] for e in trig}
+        joined = [e for e in _server_entries("trigger")
+                  if e["parent"] in spans]
+        assert joined and all(e["trace"] == ctx.trace_id for e in joined)
+    finally:
+        t.close()
+
+
+def test_delta_fetch_propagation(recorder):
+    """Delta-encoded fetches (full -> same/delta chain) keep stamping
+    every round trip: each TRIGGER is its own hop span under the same
+    trace, and every server-side record joins to one of them."""
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _server
+
+    constants.set("parameterserver_delta_encoding", True)
+    inst, _ = _register_instance(100)
+    t = T.Transport(_server.get_instance)
+    try:
+        ctx = tc.new_trace("test.delta", 1)
+        with tc.use(ctx):
+            t.update(0, inst.id, 0, 0, "copy",
+                     np.ones(100, np.float32), fp=inst.fingerprint)
+            a = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)  # full
+            t.update(0, inst.id, 0, 0, "add",
+                     np.ones(100, np.float32), fp=inst.fingerprint)
+            b = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)  # delta
+        np.testing.assert_allclose(a, 1.0)
+        np.testing.assert_allclose(b, 2.0, rtol=1e-6)
+        trig = _client_entries("trigger")
+        assert len(trig) >= 2
+        assert all(e["trace"] == ctx.trace_id for e in trig)
+        assert len({e["span"] for e in trig}) == len(trig)  # one span/hop
+        spans = {e["span"] for e in trig}
+        assert all(
+            e["parent"] in spans
+            for e in _server_entries("trigger")
+        )
+    finally:
+        t.close()
+
+
+def test_busy_replay_keeps_origin_context(recorder):
+    """Admission-control BUSY: the channel replays the RETAINED frame
+    bytes after backoff, so the replay carries the original (trace,
+    span) — the server applies each update exactly once under its
+    origin context."""
+    from torchmpi_tpu.parameterserver import transport as T
+
+    applied = []
+
+    class SlowInst:
+        fingerprint = 0
+
+        def post(self, rank, msg):
+            def run():
+                time.sleep(0.03)
+                applied.append(rank)
+                msg.done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+
+    constants.set("ps_pending_frame_budget", 1)
+    constants.set("ps_busy_retry_ms", 10)
+    lst = T._Listener(lambda i: SlowInst())
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        ctxs = [tc.new_trace("busy.step", i) for i in range(5)]
+
+        def send(i):
+            with tc.use(ctxs[i]):
+                ch.request(
+                    T._KIND_UPDATE, 1, i, 0, rule="add",
+                    payload_arr=np.ones(2, np.float32),
+                )
+
+        threads = [
+            threading.Thread(target=send, args=(i,)) for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+            assert not t.is_alive(), "request hung in BUSY replay"
+        assert sorted(applied) == list(range(5))
+        assert lst._busy_rejects >= 1, "admission never BUSYed"
+        clients = _client_entries("update")
+        servers = _server_entries("update")
+        assert {e["trace"] for e in clients} == {
+            c.trace_id for c in ctxs
+        }
+        # exactly one admitted server-side apply per client hop span,
+        # each under the ORIGIN trace (replays reused the frame bytes)
+        for e in clients:
+            joined = [s for s in servers if s["parent"] == e["span"]]
+            assert len(joined) == 1, (e["seq"], len(joined))
+            assert joined[0]["trace"] == e["trace"]
+    finally:
+        ch.close()
+        lst.close()
+
+
+def test_chain_forward_keeps_trace_and_respans_hop(recorder):
+    """fwd: replica forwarding: the forwarded frame keeps the ORIGIN
+    trace, gets a fresh span for the forwarding hop, and the replica
+    classifies as chain_forward (routing fwd=1)."""
+    from torchmpi_tpu.parameterserver import transport as T
+
+    inst, _ = _register_instance(8)
+    lst = T._Listener(lambda i: inst if i == inst.id else None)
+    ch = T._PeerChannel({0: ("localhost", lst.port)}, 0)
+    try:
+        origin_trace = tc.fnv1a64("origin", 1)
+        head_apply_span = tc.fnv1a64(origin_trace, "ps:server", 999, 1)
+        ch.request(
+            T._KIND_UPDATE, inst.id, 0, 0, rule="fwd:add",
+            payload_arr=np.ones(8, np.float32),
+            oseq=1, trace=origin_trace, parent=head_apply_span,
+        )
+        hop = _client_entries("update")[0]
+        assert hop["trace"] == origin_trace
+        assert hop["parent"] == head_apply_span
+        assert hop["span"] not in (0, head_apply_span)
+        srv = _server_entries("update")[0]
+        assert srv["trace"] == origin_trace
+        assert srv["parent"] == hop["span"]
+        assert "fwd=1" in srv["routing"]
+        assert cp.classify(srv) == "chain_forward"
+        np.testing.assert_array_equal(inst.read_shard(0), 1.0)
+    finally:
+        ch.close()
+        lst.close()
+
+
+def test_shm_lane_fallback_keeps_trace(recorder):
+    """ps_shm_lane with no published segment: the fetch falls back to
+    the socket path and the socket hop still carries the ambient
+    trace — the causal chain survives the lane switch."""
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _server
+
+    constants.set("ps_shm_lane", True)
+    inst, _ = _register_instance(16)
+    t = T.Transport(_server.get_instance)
+    try:
+        t.update(0, inst.id, 0, 0, "copy",
+                 np.full(16, 5.0, np.float32), fp=inst.fingerprint)
+        flight.recorder.reset()
+        ctx = tc.new_trace("test.shmfall", 1)
+        with tc.use(ctx):
+            out = t.trigger(0, inst.id, 0, 0, fp=inst.fingerprint)
+        np.testing.assert_array_equal(out, 5.0)
+        trig = _client_entries("trigger")
+        assert trig, "shm fallback never reached the socket lane"
+        assert all(e["trace"] == ctx.trace_id for e in trig)
+    finally:
+        t.close()
+
+
+def test_serve_request_propagation_and_client_e2e_histogram(recorder):
+    """Serving REQUEST: the client root trace rides the frame, the
+    server-side request entry joins by span -> parent and classifies as
+    serve_queue; tm_serve_client_e2e_seconds observes the full retry
+    loop by qos and outcome."""
+    from torchmpi_tpu.parameterserver import transport as T
+    from torchmpi_tpu.parameterserver.server import _server
+    from torchmpi_tpu.serve.client import ServeClient, ShedError
+
+    telemetry.enable()
+    t = T.Transport(_server.get_instance)
+    t.listener.request_handler = (
+        lambda rule, qos, payload, pending:
+        ("ok", np.frombuffer(payload, np.float32) * 2.0)
+    )
+    try:
+        client = ServeClient(t, 0, qos=1, sleep=lambda s: None)
+        out = client.infer(np.arange(4, dtype=np.float32))
+        np.testing.assert_array_equal(
+            out, np.arange(4, dtype=np.float32) * 2
+        )
+        creq = _client_entries("request")
+        assert creq and creq[0]["trace"] != 0
+        sreq = _server_entries("request")
+        assert len(sreq) == 1
+        assert sreq[0]["parent"] == creq[0]["span"]
+        assert sreq[0]["trace"] == creq[0]["trace"]
+        assert cp.classify(sreq[0]) == "serve_queue"
+        # the shed path lands in the same histogram under outcome=shed
+        t.listener.request_handler = (
+            lambda rule, qos, payload, pending: ("shed:1", None)
+        )
+        with pytest.raises(ShedError):
+            client.infer(np.ones(2, np.float32), max_sheds=1)
+        series = telemetry.snapshot()["metrics"][
+            "tm_serve_client_e2e_seconds"
+        ]["series"]
+        assert "outcome=ok,qos=1" in series
+        assert "outcome=shed,qos=1" in series
+        assert series["outcome=ok,qos=1"]["count"] == 1
+    finally:
+        telemetry.disable()
+        t.close()
+
+
+def test_resize_barrier_entries_stamped_and_classified(recorder):
+    """The resize-epoch barrier entry (comm 'resize') picks up the
+    ambient context like every other record and attributes as wait —
+    time inside the epoch barrier is rendezvous time, not compute."""
+    ctx = tc.new_trace("resize", 3)
+    with tc.use(ctx):
+        entry = flight.recorder.record("resize", "resize.enter", seq=3)
+    flight.FlightRecorder.complete(entry)
+    e = flight.recorder.entries()[-1]
+    assert e["trace"] == ctx.trace_id and e["parent"] == ctx.span_id
+    assert cp.classify(e) == "wait"
+
+
+# ---------------------------------------------------------------------------
+# critical-path DAG on hand-built journals
+# ---------------------------------------------------------------------------
+
+
+def _e(comm, op, t0, t1, seq=0, trace=0, span=0, parent=0,
+       routing="", plan="", status="completed"):
+    return {
+        "seq": seq, "comm": comm, "op": op, "payload": None, "wire": "",
+        "backend": "", "routing": routing, "plan": plan,
+        "t_issue": t0, "t_complete": t1, "status": status,
+        "trace": trace, "span": span, "parent": parent,
+    }
+
+
+def _journal(**per_rank):
+    """rank<N>=[entries] -> the analyzer's per-rank dict shape."""
+    return {
+        int(name[4:]): {
+            "snapshot": {"flight_recorder": {"entries": entries}},
+        }
+        for name, entries in per_rank.items()
+    }
+
+
+def test_critical_path_buckets_cover_window_exactly():
+    ranks = _journal(rank0=[
+        _e("global[2]", "allreduce", 0.0, 1.0, seq=0),
+        _e("ps:1", "update", 2.0, 3.0, seq=0),
+    ])
+    rep = cp.critical_path(ranks)
+    row = rep["ranks"]["0"]
+    assert row["window_us"] == pytest.approx(3e6)
+    b = row["buckets_us"]
+    assert b["collective"] == pytest.approx(1e6)
+    assert b["ps_wire"] == pytest.approx(1e6)
+    assert b["compute"] == pytest.approx(1e6)  # the 1s gap
+    assert sum(b.values()) == pytest.approx(row["window_us"])
+    assert row["coverage"] == pytest.approx(1.0)
+
+
+def test_critical_path_innermost_interval_wins():
+    """A server apply nested inside the client's RPC round trip: the
+    inner (later-starting) interval claims its segment; the RPC keeps
+    only the uncovered remainder."""
+    ranks = _journal(rank0=[
+        _e("ps:0", "update", 0.0, 10.0, seq=0),
+        _e("ps:server:9", "update", 2.0, 4.0, seq=0),
+    ])
+    b = cp.critical_path(ranks)["ranks"]["0"]["buckets_us"]
+    assert b["ps_apply"] == pytest.approx(2e6)
+    assert b["ps_wire"] == pytest.approx(8e6)
+
+
+def test_critical_path_straggler_wait_and_dominance():
+    """Early entrants of a shared collective wait for the last rank:
+    their lead time reclassifies as wait, and the dominance ledger
+    charges the straggler for the fleet seconds its lateness cost."""
+    ranks = _journal(
+        rank0=[_e("global[2]", "allreduce", 0.0, 6.0, seq=0)],
+        rank1=[_e("global[2]", "allreduce", 5.0, 6.0, seq=0)],
+    )
+    rep = cp.critical_path(ranks)
+    b0 = rep["ranks"]["0"]["buckets_us"]
+    assert b0["wait"] == pytest.approx(5e6)
+    assert b0["collective"] == pytest.approx(1e6)
+    assert rep["dominant_rank"] == 1
+    assert rep["ranks"]["1"]["dominance_us"] == pytest.approx(5e6)
+    assert rep["dominance_us"]["1"] == pytest.approx(5e6)
+
+
+def test_flow_events_collective_join_and_cap():
+    ranks = _journal(
+        rank0=[_e("global[2]", "allreduce", 0.0, 1.0, seq=0),
+               _e("global[2]", "allreduce", 2.0, 3.0, seq=1)],
+        rank1=[_e("global[2]", "allreduce", 0.5, 1.0, seq=0),
+               _e("global[2]", "allreduce", 2.5, 3.0, seq=1)],
+    )
+    evs = cp.flow_events(ranks)
+    by_id = {}
+    for ev in evs:
+        by_id.setdefault(ev["id"], []).append(ev)
+    assert len(by_id) == 2
+    for evs_of in by_id.values():
+        assert {e["ph"] for e in evs_of} == {"s", "f"}
+        assert {e["pid"] for e in evs_of} == {0, 1}
+        # arrow runs earliest entrant -> last entrant
+        start = next(e for e in evs_of if e["ph"] == "s")
+        assert start["pid"] == 0
+    assert len({ev["id"] for ev in cp.flow_events(ranks, max_flows=1)}) == 1
+
+
+def test_flow_events_ps_span_parent_join():
+    trace, span = tc.fnv1a64("t"), tc.fnv1a64("s")
+    ranks = _journal(
+        rank0=[_e("ps:1", "update", 0.0, 1.0, seq=0,
+                  trace=trace, span=span)],
+        rank1=[_e("ps:server:9", "update", 0.2, 0.8, seq=0,
+                  trace=trace, span=tc.fnv1a64("c"), parent=span)],
+    )
+    evs = [ev for ev in cp.flow_events(ranks)
+           if ev["cat"] == "flow.ps"]
+    assert {e["ph"] for e in evs} == {"s", "f"}
+    assert {e["pid"] for e in evs} == {0, 1}
+
+
+def test_serve_hops_decomposition():
+    trace, span = tc.fnv1a64("t"), tc.fnv1a64("s")
+    ranks = _journal(
+        rank0=[_e("ps:1", "request", 0.0, 0.010, seq=0,
+                  trace=trace, span=span)],
+        rank1=[_e("ps:server:9", "request", 0.002, 0.008, seq=0,
+                  trace=trace, span=tc.fnv1a64("c"), parent=span)],
+    )
+    hops = cp.serve_hops(ranks)["hops"]
+    assert len(hops) == 1
+    assert hops[0]["client_us"] == pytest.approx(10_000, rel=1e-6)
+    assert hops[0]["server_us"] == pytest.approx(6_000, rel=1e-6)
+    assert hops[0]["wire_us"] == pytest.approx(4_000, rel=1e-6)
+
+
+def test_overlap_ledger_and_fraction_math():
+    stages = {"encode": 10.0, "wire": 30.0, "decode": 10.0}
+    # depth 4: serial = 4*50, pipelined = 50 + 3*30 = 140 -> 0.3 hidden
+    assert cp.modeled_overlap_fraction(stages, 4) == pytest.approx(0.3)
+    assert cp.modeled_overlap_fraction(stages, 1) == 0.0
+    assert cp.modeled_overlap_fraction({}, 4) == 0.0
+    assert cp.measured_overlap_fraction(200.0, 140.0) == pytest.approx(0.3)
+    assert cp.measured_overlap_fraction(0.0, 1.0) == 0.0
+    assert cp.measured_overlap_fraction(100.0, 500.0) == 0.0  # clamped
+    ranks = _journal(rank0=[
+        _e("chunks", "allreduce", 0.0, 1.0, seq=0, plan="p0#0"),
+        _e("chunks", "allreduce", 0.5, 1.5, seq=1, plan="p0#1"),
+        _e("chunks", "allreduce", 0.0, 1.0, seq=2, plan="solo#0"),
+    ])
+    ledger = cp.overlap_ledger(ranks)["plans"]
+    assert "solo" not in ledger  # one chunk has nothing to overlap
+    row = ledger["p0"]
+    assert row["chunks"] == 2
+    # serial 2s, wall span 1.5s -> 25% of the serial cost was hidden
+    assert row["measured_fraction"] == pytest.approx(0.25)
+
+
+def test_merged_trace_flow_arrows_ordered_under_clock_drift():
+    """Drift injection on the offline merger: rank 1's perf_counter
+    origin drifted ~57s from rank 0's, so its span timestamps land far
+    off the wall axis pre-alignment. The per-rank clock-sync triple must
+    pull both ranks onto one wall-clock axis — flow arrows keep their
+    causal order (s strictly before f) and the same logical step's span
+    lands at the same aligned instant on both tracks."""
+    from torchmpi_tpu.telemetry import analyze
+
+    def dump(entries, perf_drift):
+        return {
+            "snapshot": {
+                "clock_sync": {"wall_time": 1000.0,
+                               "perf_counter": 100.0 + perf_drift},
+                "flight_recorder": {"entries": entries},
+            },
+            "trace_events": [
+                {"ph": "X", "name": "step", "cat": "span",
+                 "ts": (100.0 + perf_drift) * 1e6, "dur": 5.0,
+                 "pid": 0, "tid": 1},
+            ],
+        }
+
+    ranks = {
+        0: dump([_e("global[2]", "allreduce", 1000.0, 1001.0, seq=0)],
+                0.0),
+        1: dump([_e("global[2]", "allreduce", 1000.5, 1001.0, seq=0)],
+                -57.3),
+    }
+    trace = analyze.merged_trace(ranks)
+    assert trace["clockAligned"] == {0: True, 1: True}
+    flows = [ev for ev in trace["traceEvents"]
+             if ev.get("ph") in ("s", "f")
+             and str(ev.get("cat", "")).startswith("flow.")]
+    start = next(ev for ev in flows if ev["ph"] == "s")
+    finish = next(ev for ev in flows if ev["ph"] == "f")
+    assert start["pid"] == 0 and finish["pid"] == 1
+    assert start["ts"] < finish["ts"]
+    spans = {ev["pid"]: ev["ts"] for ev in trace["traceEvents"]
+             if ev.get("cat") == "span"}
+    assert spans[0] == pytest.approx(spans[1], abs=1.0)
+
+
+# ---------------------------------------------------------------------------
+# clock-drift hardening + live aggregator surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_clock_sync_preserves_identity_and_advances():
+    telemetry.record_clock_sync(rank=3, host="h")
+    first = dict(telemetry.clock_sync())
+    time.sleep(0.01)
+    second = telemetry.refresh_clock_sync()
+    assert second["rank"] == 3 and second["host"] == "h"
+    assert second["wall_time"] > first["wall_time"]
+    assert second["perf_counter"] > first["perf_counter"]
+
+
+def test_live_exporter_frame_recaptures_clock_sync():
+    from torchmpi_tpu.telemetry import live
+
+    telemetry.record_clock_sync(rank=0)
+    exp = live.LiveExporter(rank=0, carrier=True)
+    f1 = exp.frame()
+    time.sleep(0.01)
+    f2 = exp.frame()
+    assert f2["clock_sync"]["wall_time"] > f1["clock_sync"]["wall_time"]
+
+
+def test_aggregator_keeps_freshest_clock_sync_on_replay():
+    """Drift injection: frames arriving out of order must never regress
+    the merger's alignment — the freshest wall_time wins."""
+    from torchmpi_tpu.telemetry import live
+
+    agg = live.FleetAggregator()
+
+    def frame(wall, perf):
+        return {
+            "kind": "full", "rank": 0, "time": wall,
+            "metrics": {"families": {}, "generation": 0},
+            "metrics_generation": 0, "seq_high_water": {},
+            "flight_tail": [],
+            "clock_sync": {"wall_time": wall, "perf_counter": perf},
+        }
+
+    agg.ingest(frame(100.0, 1.0))
+    agg.ingest(frame(50.0, 0.5))   # stale replay: must NOT win
+    assert agg.ranks[0].clock_sync["wall_time"] == 100.0
+    agg.ingest(frame(200.0, 2.0))  # fresher triple: wins
+    assert agg.ranks[0].clock_sync["wall_time"] == 200.0
+    assert agg._pseudo_ranks()[0]["snapshot"]["clock_sync"][
+        "wall_time"
+    ] == 200.0
+
+
+def test_aggregator_criticalpath_and_prometheus_families():
+    from torchmpi_tpu.telemetry import live
+
+    agg = live.FleetAggregator()
+    trace, span = tc.fnv1a64("t"), tc.fnv1a64("s")
+    tail0 = [_e("global[2]", "allreduce", 0.0, 1.0, seq=0),
+             _e("ps:1", "update", 2.0, 3.0, seq=0,
+                trace=trace, span=span)]
+    tail1 = [_e("global[2]", "allreduce", 0.5, 1.0, seq=0),
+             _e("ps:server:9", "update", 2.2, 2.8, seq=0,
+                trace=trace, span=tc.fnv1a64("c"), parent=span)]
+    for rank, tail in ((0, tail0), (1, tail1)):
+        agg.ingest({
+            "kind": "full", "rank": rank, "time": 10.0 + rank,
+            "metrics": {"families": {}, "generation": 0},
+            "metrics_generation": 0, "seq_high_water": {},
+            "flight_tail": tail,
+        })
+    view = agg.criticalpath(now=12.0)
+    assert set(view["critical_path"]["ranks"]) == {"0", "1"}
+    assert view["critical_path"]["ranks"]["0"]["coverage"] == (
+        pytest.approx(1.0)
+    )
+    rows = agg.health(now=12.0)["ranks"]
+    assert all("cp_dominant" in r for r in rows.values())
+    text = agg.prometheus(now=12.0)
+    assert "tm_criticalpath_bucket_us{" in text
+    assert "tm_criticalpath_dominance_us{" in text
+    assert 'tm_trace_stamped_entries{rank="0"} 1' in text
+    assert "tm_trace_flow_events" in text
+
+
+# ---------------------------------------------------------------------------
+# simfleet determinism
+# ---------------------------------------------------------------------------
+
+
+def test_sim_trace_stamps_are_deterministic_and_shared():
+    """Sim step stamping derives from (comm, step ordinal) only: two
+    runs of the same scenario produce identical trace ids, and every
+    rank of a step shares one trace (the analyzer's cross-rank join)."""
+    from torchmpi_tpu.sim import fleet as simfleet
+
+    def run():
+        f = simfleet.SimFleet(world=4, seed=7, steps=3)
+        f.run(horizon_s=120.0)
+
+        def steps(rank):
+            return [
+                e for e in f._rank_index[rank].recorder.entries()
+                if e["comm"].startswith("global[")
+            ]
+
+        return [
+            (e["comm"], e["seq"], e["trace"], e["span"])
+            for e in steps(0)
+        ], [e["trace"] for e in steps(1)]
+
+    (a0, a1), (b0, b1) = run(), run()
+    assert a0 and a0 == b0 and a1 == b1  # byte-identical per seed
+    assert all(t for _, _, t, _ in a0)  # every sim step is stamped
+    # same step, different rank -> same trace (the cross-rank join key)
+    assert [t for _, _, t, _ in a0] == a1
+
+
+# ---------------------------------------------------------------------------
+# TPL205: frame-field documentation lint
+# ---------------------------------------------------------------------------
+
+
+_FAKE_TRANSPORT = '''\
+import struct
+
+# frame: magic u16, kind u8, seq u64, trace u64,
+#        span u64
+#
+# - seq: per-channel monotone sequence (this bare-# note line ends the
+#   field list; widths here like u32 must NOT parse as fields)
+_HEADER = struct.Struct(">HBQQQ")
+'''
+
+
+def _fake_sf(tmp_path, source, name="fake_transport.py"):
+    from torchmpi_tpu.analysis.core import load_source
+
+    p = tmp_path / name
+    p.write_text(source)
+    return load_source(p, root=tmp_path)
+
+
+def test_tpl205_frame_header_fields_parsing(tmp_path):
+    from torchmpi_tpu.analysis import knobs
+
+    sf = _fake_sf(tmp_path, _FAKE_TRANSPORT)
+    fields = knobs.frame_header_fields(sf)
+    assert set(fields) == {"magic", "kind", "seq", "trace", "span"}
+
+
+def test_tpl205_fires_on_undocumented_field(tmp_path):
+    from torchmpi_tpu.analysis import knobs
+
+    sf = _fake_sf(tmp_path, _FAKE_TRANSPORT)
+    docs = tmp_path / "PARITY.md"
+    docs.write_text("| `magic` | `kind` | `seq` | `trace` |")  # no span
+    findings = knobs.check_frame_docs([sf], [docs])
+    assert [f.rule for f in findings] == ["TPL205"]
+    assert "'span'" in findings[0].message
+    docs.write_text("| `magic` | `kind` | `seq` | `trace` | `span` |")
+    assert knobs.check_frame_docs([sf], [docs]) == []
+
+
+def test_tpl205_skips_files_without_header_struct(tmp_path):
+    from torchmpi_tpu.analysis import knobs
+
+    sf = _fake_sf(
+        tmp_path,
+        "# frame: magic u16, kind u8\nX = 1\n",
+        name="not_a_transport.py",
+    )
+    docs = tmp_path / "PARITY.md"
+    docs.write_text("nothing documented")
+    assert knobs.check_frame_docs([sf], [docs]) == []
+
+
+def test_shipped_tree_frame_fields_documented():
+    """The real transport's header fields are all in the shipped PARITY
+    frame-format table (the lint ships clean, baseline empty)."""
+    from pathlib import Path
+
+    from torchmpi_tpu.analysis import knobs
+    from torchmpi_tpu.analysis.core import load_source
+
+    root = Path(__file__).resolve().parent.parent
+    sf = load_source(
+        root / "torchmpi_tpu" / "parameterserver" / "transport.py",
+        root=root,
+    )
+    fields = knobs.frame_header_fields(sf)
+    assert {"trace", "span", "seq", "oseq"} <= set(fields)
+    assert knobs.check_frame_docs(
+        [sf], [root / "README.md", root / "docs" / "PARITY.md"]
+    ) == []

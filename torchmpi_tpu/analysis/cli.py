@@ -97,6 +97,10 @@ def run_analysis(
     # TPL203, and not gated on constants.py being in the scan set
     _attribute(knobs_mod.check_metrics_docs(sources, doc_paths))
 
+    # repo-level wire-contract rule (TPL205): every PS frame header
+    # field must be in the PARITY frame-format table
+    _attribute(knobs_mod.check_frame_docs(sources, doc_paths))
+
     for sf, flist in per_file.items():
         for f in flist:
             if f.rule not in wanted:

@@ -77,6 +77,11 @@ RULES: Dict[str, Tuple[str, str]] = {
         "registered tm_* metric family is not mentioned in README or "
         "docs/PARITY.md",
     ),
+    "TPL205": (
+        "frame-field-undocumented",
+        "PS wire-frame header field is not documented in the PARITY "
+        "frame-format table",
+    ),
 }
 
 _SLUG_TO_ID = {slug: rid for rid, (slug, _) in RULES.items()}

@@ -1,4 +1,4 @@
-"""Knob/metric-consistency lint (rules TPL201-TPL204).
+"""Knob/metric-consistency lint (rules TPL201-TPL205).
 
 ``constants.py`` is the single source of truth for every tunable knob.
 Three invariants keep it honest:
@@ -21,6 +21,14 @@ Three invariants keep it honest:
   documentation table (README.md or docs/PARITY.md), same shape as
   TPL203 for knobs: an undocumented family is an operator surface
   nobody can discover.
+- **TPL205 frame-field-undocumented** — every PS wire-frame header
+  field (the ``name uN`` tokens of the ``# frame:`` doc comment that
+  precedes ``_HEADER = struct.Struct(...)`` in the transport) must
+  appear as a backticked token in the documented frame-format table
+  (README.md / docs/PARITY.md). The wire layout is a cross-version
+  compatibility contract; a field that ships undocumented (the fate the
+  ``trace``/``span`` trace-context fields would otherwise share with
+  ``oseq`` before it) cannot be audited against peers.
 """
 
 from __future__ import annotations
@@ -206,4 +214,62 @@ def check_metrics_docs(
                 hint="add a row (name, type, labels, emitting module) "
                 "to the metrics table",
             ))
+    return findings
+
+
+_FRAME_FIELD_RE = re.compile(r"\b([a-z_][a-z0-9_]*) u(?:8|16|32|64)\b")
+
+
+def frame_header_fields(sf: SourceFile) -> Dict[str, int]:
+    """The wire-frame header fields a transport declares: the ``name uN``
+    tokens of the contiguous ``# frame:`` comment block (the field list
+    ends at the first bare ``#`` line, where the semantic notes start).
+    Returns name -> declaration line."""
+    out: Dict[str, int] = {}
+    in_block = False
+    for i, line in enumerate(sf.source.splitlines(), 1):
+        stripped = line.strip()
+        if stripped.startswith("# frame:"):
+            in_block = True
+        elif in_block and (not stripped.startswith("#") or stripped == "#"):
+            break
+        if in_block:
+            for m in _FRAME_FIELD_RE.finditer(stripped):
+                out.setdefault(m.group(1), i)
+    return out
+
+
+def check_frame_docs(
+    package_files: Sequence[SourceFile],
+    doc_paths: Sequence[Path],
+) -> List[Finding]:
+    """TPL205: every PS wire-frame header field must appear as a
+    backticked token in the documented frame-format table. Applies to
+    any scanned file that both declares a ``# frame:`` field list and
+    packs it (``_HEADER = struct.Struct``) — the wire contract and its
+    documentation must move together."""
+    docs = ""
+    for p in doc_paths:
+        try:
+            docs += Path(p).read_text()
+        except OSError:
+            pass
+    findings: List[Finding] = []
+    if not docs:
+        return findings  # no docs to check against (same rule as TPL203)
+    for sf in package_files:
+        if "_HEADER = struct.Struct(" not in sf.source:
+            continue
+        for name, line in sorted(
+            frame_header_fields(sf).items(), key=lambda kv: kv[1]
+        ):
+            if f"`{name}`" not in docs:
+                findings.append(Finding(
+                    "TPL205", sf.display, line,
+                    f"wire-frame header field '{name}' is not documented "
+                    "in the frame-format table (README.md or "
+                    "docs/PARITY.md)",
+                    hint="add the field (backticked, with width and "
+                    "meaning) to the PARITY frame-format table",
+                ))
     return findings

@@ -247,6 +247,12 @@ class _Constants:
     # median as a fit point (a single noisy dispatch must not bend the
     # calibrated cost model).
     plan_calibration_min_samples: int = 3
+    # Cap on Perfetto flow arrows (cross-rank causal edges: collective
+    # joins and PS span->parent hops) the offline analyzer's merged
+    # trace and the aggregator's /criticalpath view emit, earliest
+    # first. Bounds merged-trace size on long journals; 0 removes the
+    # cap.
+    trace_max_flow_events: int = 512
 
     # --- schedule-compiler cost model (alpha-beta per link class) ---
     # Per-hop launch latency (alpha, µs) and per-MiB transfer time

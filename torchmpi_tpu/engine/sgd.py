@@ -39,6 +39,7 @@ from .. import nn as mpinn, telemetry as _telemetry
 from ..nn import GradientBuckets
 from ..runtime.communicator import Communicator
 from ..telemetry import flightrecorder as _flight
+from ..telemetry import tracecontext as _tracecontext
 
 _AXIS = "mpi"
 
@@ -239,6 +240,9 @@ class AllReduceSGDEngine:
             from .. import runtime_state
 
             comm = runtime_state.current_communicator()
+        # step ordinal for per-step trace-context roots: every SPMD rank
+        # advances it identically, so step N is ONE trace fleet-wide
+        self._trace_steps = 0
         if mode not in ("sync", "async"):
             raise ValueError(f"mode must be 'sync' or 'async', got {mode!r}")
         if batch_format not in ("auto", "flat", "stacked"):
@@ -761,16 +765,24 @@ class AllReduceSGDEngine:
             )
             self._maybe_checkpoint()
             return loss
-        t0 = time.perf_counter()
-        self.params, self.opt_state, self.model_state, aux = self._call_step(
-            batch
-        )
-        loss, gnorm = self._split_aux(aux)
-        jax.block_until_ready(loss)
-        self._record_step(
-            jax.tree_util.tree_leaves(batch)[0].shape[0],
-            t0, time.perf_counter(), gnorm,
-        )
+        # each telemetry-enabled step is one causal trace root: the ids
+        # are derived from the step ordinal, so every SPMD rank running
+        # the same program lands on the SAME trace id for the same step
+        # and the analyzer can group cross-rank work per step
+        self._trace_steps = self._trace_steps + 1
+        with _tracecontext.use(
+            _tracecontext.new_trace("engine.step", self._trace_steps)
+        ):
+            t0 = time.perf_counter()
+            self.params, self.opt_state, self.model_state, aux = (
+                self._call_step(batch)
+            )
+            loss, gnorm = self._split_aux(aux)
+            jax.block_until_ready(loss)
+            self._record_step(
+                jax.tree_util.tree_leaves(batch)[0].shape[0],
+                t0, time.perf_counter(), gnorm,
+            )
         self._maybe_checkpoint()
         return loss
 

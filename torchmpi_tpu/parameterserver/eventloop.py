@@ -73,9 +73,10 @@ class Conn:
     thread; any thread may enqueue replies via :meth:`queue_write`.
 
     The parsed frame tuple is ``(kind, inst, rank, client, seq, oseq,
-    fp, rule, dtype, wire, nchunks, payload)`` — payload already decoded
-    to logical bytes for chunked/quantized frames, exactly what the
-    blocking ``_recv_frame`` produced.
+    fp, rule, dtype, wire, nchunks, payload, trace, span)`` — payload
+    already decoded to logical bytes for chunked/quantized frames,
+    exactly what the blocking ``_recv_frame`` produced, plus the frame's
+    causal trace context (zeros when unstamped).
     """
 
     __slots__ = (
@@ -83,7 +84,7 @@ class Conn:
         "busy_floor",
         "_phase", "_buf", "_view", "_got",
         "_kind", "_inst", "_rank", "_client", "_seq", "_oseq", "_fp",
-        "_wirec", "_nchunks", "_rl", "_dl", "_pl",
+        "_wirec", "_nchunks", "_rl", "_dl", "_pl", "_trace", "_span",
         "_rule", "_dtype", "_dt",
         "_payload_left", "_out_arr", "_out_mv", "_chunk_meta", "_scratch",
     )
@@ -140,7 +141,7 @@ class Conn:
         frame = (
             self._kind, self._inst, self._rank, self._client, self._seq,
             self._oseq, self._fp, self._rule, self._dtype, self._wirec,
-            self._nchunks, payload,
+            self._nchunks, payload, self._trace, self._span,
         )
         self._out_arr = None
         self._out_mv = None
@@ -153,7 +154,7 @@ class Conn:
         T = _transport()
         if self._phase == _PH_HEAD:
             (magic, kind, inst, rank, client, seq, oseq, fp, token, wirec,
-             nchunks, rl, dl, pl) = T._HEADER.unpack(self._buf)
+             nchunks, rl, dl, pl, trace, span) = T._HEADER.unpack(self._buf)
             if magic != T._MAGIC:
                 raise ConnectionClosed(
                     f"bad parameter-server frame magic 0x{magic:x}"
@@ -163,8 +164,10 @@ class Conn:
                     "parameter-server frame failed authentication"
                 )
             (self._kind, self._inst, self._rank, self._client, self._seq,
-             self._oseq, self._fp, self._wirec, self._nchunks) = (
-                kind, inst, rank, client, seq, oseq, fp, wirec, nchunks)
+             self._oseq, self._fp, self._wirec, self._nchunks,
+             self._trace, self._span) = (
+                kind, inst, rank, client, seq, oseq, fp, wirec, nchunks,
+                trace, span)
             self._rl, self._dl, self._pl = rl, dl, pl
             self._rule = self._dtype = ""
             if rl or dl:

@@ -178,6 +178,12 @@ class _Message:
     # identity this update keeps when chain-forwarded to a replica (0 =
     # not replicated / local update; see transport.py's oseq field)
     oseq: int = 0
+    # causal trace context (telemetry.tracecontext): the origin trace id
+    # and the receiving hop's span. A chain forward re-sends the ORIGIN
+    # trace with this hop's span as the parent, so replication stays one
+    # trace with one span per link. Zeros when unstamped.
+    trace: int = 0
+    span: int = 0
 
 
 class _ReplicaPump:
@@ -992,6 +998,7 @@ class ParameterServer:
                 proc, inst.id, r, msg.client, msg.rule,
                 np.asarray(msg.payload), fp=inst.fingerprint,
                 oseq=msg.oseq,
+                trace=msg.trace, parent=msg.span,
             )
 
         self._inst.attach_replication(_fwd)

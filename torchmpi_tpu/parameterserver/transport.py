@@ -49,6 +49,7 @@ from .. import constants, telemetry as _telemetry
 from ..analysis import lockmon as _lockmon
 from ..schedule import pipeline as _sched_pipeline
 from ..telemetry import flightrecorder as _flight
+from ..telemetry import tracecontext as _tracecontext
 from . import wire as _wire
 
 _MAGIC = 0x7E5B
@@ -295,7 +296,8 @@ def admission_decision(pending: int, budget: int, busy_floor, seq: int,
 
 # frame: magic u16, kind u8, inst u32, rank u32, client u32, seq u64,
 #        oseq u64, fp u32, token u32, wire u8, nchunks u32,
-#        rule_len u16, dtype_len u16, payload_len u64
+#        rule_len u16, dtype_len u16, payload_len u64, trace u64,
+#        span u64
 #
 # - seq: per-channel monotone sequence on EVERY frame; echoed on the
 #   reply (the client demux correlates by it — the server replies out
@@ -323,7 +325,15 @@ def admission_decision(pending: int, budget: int, busy_floor, seq: int,
 #   (``wire.py``): nchunks x [chunk header | encoded span], streamed so
 #   encode/decode of chunk k+1 overlaps the wire I/O of chunk k. 0 means
 #   the payload is one raw blob (control frames, multi-frame containers).
-_HEADER = struct.Struct(">HBIIIQQIIBIHHQ")
+# - trace: causal trace id (telemetry.tracecontext); 0 = unstamped (no
+#   ambient trace / tracing off). Replays and BUSY re-sends reuse the
+#   retained encoded frame, so origin context survives by construction.
+# - span: the sender's span id for THIS hop; the receiver records its
+#   local work with ``parent=span``, and replies echo (trace, span)
+#   unchanged. Chain-forwarded ``fwd:`` frames re-stamp span with the
+#   forwarding hop's span while keeping trace — one trace per update,
+#   one span per link of the chain.
+_HEADER = struct.Struct(">HBIIIQQIIBIHHQQQ")
 
 
 # Auto-derived per-job frame secret (see _init_job_token): 0 only until
@@ -448,11 +458,13 @@ def _frame_header(
     dtype: str = "",
     payload_len: int = 0,
     oseq: int = 0,
+    trace: int = 0,
+    span: int = 0,
 ):
     rule_b, dtype_b = rule.encode(), dtype.encode()
     header = _HEADER.pack(
         _MAGIC, kind, inst, rank, client, seq, oseq, fp, _auth_token(),
-        wire, nchunks, len(rule_b), len(dtype_b), payload_len,
+        wire, nchunks, len(rule_b), len(dtype_b), payload_len, trace, span,
     )
     return header, rule_b, dtype_b
 
@@ -470,10 +482,12 @@ def _frame_bytes(
     wire: int = 0,
     nchunks: int = 0,
     oseq: int = 0,
+    trace: int = 0,
+    span: int = 0,
 ) -> bytes:
     header, rule_b, dtype_b = _frame_header(
         kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
-        len(payload), oseq,
+        len(payload), oseq, trace, span,
     )
     return header + rule_b + dtype_b + payload
 
@@ -492,19 +506,21 @@ def _send_frame(
     wire: int = 0,
     nchunks: int = 0,
     oseq: int = 0,
+    trace: int = 0,
+    span: int = 0,
 ) -> None:
     if isinstance(payload, list):
         total = sum(len(memoryview(b).cast("B")) for b in payload)
         header, rule_b, dtype_b = _frame_header(
             kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
-            total, oseq,
+            total, oseq, trace, span,
         )
         _send_buffers(sock, [header, rule_b, dtype_b] + payload)
     else:
         sock.sendall(
             _frame_bytes(
                 kind, inst, rank, client, seq, fp, rule, dtype, payload,
-                wire, nchunks, oseq,
+                wire, nchunks, oseq, trace, span,
             )
         )
 
@@ -521,19 +537,23 @@ def _reply_bufs(
     payload: _Buffers = b"",
     wire: int = 0,
     nchunks: int = 0,
+    trace: int = 0,
+    span: int = 0,
 ):
     """Encode a reply frame as a buffer list for the event loop's write
-    queue (never sent inline: pool threads enqueue, the loop flushes)."""
+    queue (never sent inline: pool threads enqueue, the loop flushes).
+    ``(trace, span)`` echo the request's context — a reply closes the
+    request span, it does not open a new one."""
     if isinstance(payload, list):
         total = sum(len(memoryview(b).cast("B")) for b in payload)
         header, rule_b, dtype_b = _frame_header(
             kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
-            total,
+            total, trace=trace, span=span,
         )
         return [header, rule_b, dtype_b, *payload]
     header, rule_b, dtype_b = _frame_header(
         kind, inst, rank, client, seq, fp, wire, nchunks, rule, dtype,
-        len(payload),
+        len(payload), trace=trace, span=span,
     )
     return [header, rule_b, dtype_b, payload]
 
@@ -541,7 +561,7 @@ def _reply_bufs(
 def _recv_head(sock: socket.socket):
     header = _recv_exact(sock, _HEADER.size)
     (magic, kind, inst, rank, client, seq, oseq, fp, token, wire, nchunks,
-     rl, dl, pl) = _HEADER.unpack(header)
+     rl, dl, pl, trace, span) = _HEADER.unpack(header)
     if magic != _MAGIC:
         raise ConnectionError(
             f"bad parameter-server frame magic 0x{magic:x}"
@@ -958,11 +978,23 @@ class _Listener:
         QUEUED through the loop, never sent from pool threads, so a
         dead client cannot wedge a shared worker."""
         (kind, inst_id, rank, client, seq, oseq, fp, rule, dtype,
-         wire, nchunks, payload) = frame
+         wire, nchunks, payload, trace, tspan) = frame
         loop = self._loop
+        # the server-side span for this frame's local work: child of the
+        # sender's span (parent=tspan), deterministic so replays re-derive
+        # the same id. Zero stays zero — unstamped frames cost one branch.
+        srv_span = (
+            _tracecontext.fnv1a64(trace, "ps:server", self.port, seq)
+            if trace else 0
+        )
 
         def reply(rkind: int, rseq: int, **kw) -> None:
-            loop.send(conn, _reply_bufs(rkind, seq=rseq, **kw))
+            # replies echo the request's (trace, span): the closing edge
+            # of the request span, not a new node
+            loop.send(
+                conn,
+                _reply_bufs(rkind, seq=rseq, trace=trace, span=tspan, **kw),
+            )
 
         if kind == _KIND_BARRIER:
             # subset barrier: record (tag, origin) and ack receipt; a
@@ -1000,6 +1032,7 @@ class _Listener:
                     f"ps:server:{self.port}", "request",
                     payload=f"{len(payload)}B", backend="socket",
                     routing=f"qos={rank},client={client}",
+                    trace=trace, span=srv_span, parent=tspan,
                 )
             with self._pending_lock:
                 self._pending_frames += 1
@@ -1062,7 +1095,11 @@ class _Listener:
                 _KIND_NAMES.get(kind, str(kind)),
                 payload=f"{len(payload)}B",
                 backend="socket",
-                routing=f"inst={inst_id},rank={rank},client={client}",
+                routing=(
+                    f"inst={inst_id},rank={rank},client={client}"
+                    + (",fwd=1" if forwarded else "")
+                ),
+                trace=trace, span=srv_span, parent=tspan,
             )
         with self._pending_lock:
             self._pending_frames += 1
@@ -1166,6 +1203,7 @@ class _Listener:
                         "update", client=client, rule=rule,
                         payload=values if owned else values.copy(),
                         done=ev, cancelled=token, oseq=oseq,
+                        trace=trace, span=srv_span,
                     )
                     inst.post(r, msg)
                     posted.append((ev, token, msg, r))
@@ -1783,6 +1821,9 @@ class _PeerChannel:
         dtype_str: str = "",
         wire: Optional[int] = None,
         oseq: int = 0,
+        trace: int = 0,
+        span: int = 0,
+        parent: int = 0,
     ):
         """Pipelined request/response."""
         return self.complete(
@@ -1790,6 +1831,7 @@ class _PeerChannel:
                 kind, inst, rank, client, fp=fp, rule=rule,
                 payload_arr=payload_arr, payload_raw=payload_raw,
                 dtype_str=dtype_str, wire=wire, oseq=oseq,
+                trace=trace, span=span, parent=parent,
             )
         )
 
@@ -1806,6 +1848,9 @@ class _PeerChannel:
         dtype_str: str = "",
         wire: Optional[int] = None,
         oseq: int = 0,
+        trace: int = 0,
+        span: int = 0,
+        parent: int = 0,
     ) -> _Waiter:
         """Put one frame on the wire and return its waiter WITHOUT waiting
         for the reply — fan-out callers (allgather_blob, barrier) submit to
@@ -1859,14 +1904,24 @@ class _PeerChannel:
                 "ps_update", _wire.WIRE_NAMES.get(wire_eff, "full"),
                 arr.nbytes, total_len,
             )
+        if not trace:
+            # explicit (trace, span) wins — forwarding hops carry the
+            # origin trace; otherwise the ambient context stamps the frame
+            ctx = _tracecontext.current()
+            if ctx is not None:
+                trace, parent = ctx.trace_id, ctx.span_id
         with self.lock:
             if self.closed:
                 raise ConnectionError("parameter-server transport closed")
             self.seq += 1
             seq = self.seq
+            if trace and not span:
+                # this RPC-send hop's span, derived after the seq draw so
+                # every frame on the channel gets a distinct id
+                span = _tracecontext.fnv1a64(trace, "ps", self.proc, seq)
             header, rule_b, dtype_b = _frame_header(
                 kind, inst, rank, client, seq, fp, wire_eff, nchunks,
-                rule, dtype_str, total_len, oseq,
+                rule, dtype_str, total_len, oseq, trace, span,
             )
             w = _Waiter([header, rule_b, dtype_b])
             if _telemetry.enabled():
@@ -1893,6 +1948,7 @@ class _PeerChannel:
                     backend="socket",
                     routing=f"inst={inst},rank={rank},client={client}",
                     seq=seq,
+                    trace=trace, span=span, parent=parent,
                 )
             self.pending[seq] = w
             sock_ok = True
@@ -2258,6 +2314,7 @@ class Transport:
     def forward_update(
         self, proc: int, inst: int, rank: int, client: int, rule: str,
         payload: np.ndarray, fp: int = 0, oseq: int = 0,
+        trace: int = 0, parent: int = 0,
     ) -> None:
         """Chain-forward an APPLIED update to the next replica, keeping
         the original (client, oseq) dedup identity. Called by the
@@ -2265,10 +2322,13 @@ class Transport:
         exempts the frame from the successor's admission budget (it was
         admitted once, at the chain head — see the listener's bypass
         note), so a loaded replica sheds client traffic, never the
-        replication stream that keeps it consistent."""
+        replication stream that keeps it consistent. ``(trace, parent)``
+        carry the ORIGIN trace and the forwarding hop's apply span, so
+        the chain stays one causal trace with one span per link."""
         self.pool.request(
             proc, _KIND_UPDATE, inst, rank, client,
             fp=fp, rule=f"fwd:{rule}", payload_arr=payload, oseq=oseq,
+            trace=trace, parent=parent,
         )
 
     def update_multi(

@@ -37,6 +37,7 @@ from ..supervise.core import Actuator
 from ..schedule.topology import LINK_HOST, Topology
 from ..schedule.cost import link_alpha_us, link_beta_us_per_mib
 from ..telemetry import flightrecorder as _flight
+from ..telemetry import tracecontext as _tracectx
 from ..telemetry.flightrecorder import FlightRecorder
 from ..telemetry.registry import MetricsRegistry
 from .clock import rng_for
@@ -393,6 +394,12 @@ class SimFleet:
         t0 = self.loop.now
         entries = []
         t_max_issue = t0
+        # one logical trace per simulated step, derived purely from the
+        # step ordinal (no wall clock, no RNG): dumps stay byte-identical
+        # per seed, and every rank's entry for this step shares the trace
+        # id — exactly what the analyzer's cross-rank flow join expects
+        step_no = self.stats["steps_completed"]
+        trace = _tracectx.fnv1a64("sim.step", comm, step_no)
         for m in issuers:
             sr = self.ranks[m]
             ti = t0 + sr.skew_s + 0.0005 * self.net.jitter()
@@ -400,6 +407,7 @@ class SimFleet:
             e = sr.recorder.record(
                 comm, "allreduce", payload=payload, backend="ring",
                 routing="sim", plan=plan_id,
+                trace=trace, span=_tracectx.fnv1a64(trace, "rank", m),
             )
             e[_T_ISSUE] = self.wall(ti)
             entries.append((m, e, ti))

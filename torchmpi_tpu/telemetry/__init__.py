@@ -129,6 +129,29 @@ def clock_sync() -> Optional[dict]:
     return _clock_sync
 
 
+def refresh_clock_sync() -> Optional[dict]:
+    """Re-capture the clock triple, preserving the identity fields of the
+    original record. A single start()-time sample lets wall-vs-perf drift
+    (NTP steps, thermal clock skew) accumulate for the whole run and bend
+    the analyzer's cross-rank alignment; the live exporter calls this on
+    every heartbeat frame so the merger always aligns with the freshest
+    triple. No-op (returns None) before the first record_clock_sync."""
+    global _clock_sync
+    if _clock_sync is None:
+        return None
+    identity = {
+        k: v for k, v in _clock_sync.items()
+        if k not in ("wall_time", "perf_counter", "monotonic")
+    }
+    _clock_sync = {
+        "wall_time": time.time(),
+        "perf_counter": time.perf_counter(),
+        "monotonic": time.monotonic(),
+    }
+    _clock_sync.update(identity)
+    return _clock_sync
+
+
 def audit(event: str, **fields) -> None:
     """Append one decision record to the bounded audit journal."""
     rec = {"event": event, "time": time.time()}

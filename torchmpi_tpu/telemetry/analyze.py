@@ -55,6 +55,19 @@ import sys
 from pathlib import Path
 from typing import Dict, List, Optional
 
+from . import criticalpath as _criticalpath
+
+
+def _max_flow_events() -> int:
+    """The trace_max_flow_events knob; defensive default so the analyzer
+    stays usable even if the constants table cannot load."""
+    try:
+        from .. import constants
+        return int(constants.get("trace_max_flow_events"))
+    except Exception:
+        return 512
+
+
 _RANK_RE = re.compile(
     r"^telemetry_rank_(\d+)(?:\.restart(\d+))?\.json$"
 )
@@ -196,7 +209,18 @@ def merged_trace(ranks: Dict[int, dict]) -> dict:
             })
             all_ts.append(t0)
         per_rank_events[rank] = evs
+    # cross-rank causal arrows: same logical collective across pid
+    # tracks, and each trace-stamped PS RPC to the server work it
+    # caused. Emitted with the SAME absolute wall-µs timebase as the
+    # flight slices (each arrow endpoint binds +1µs inside its slice),
+    # so the shared base normalization below lands them correctly.
+    flow_evs = _criticalpath.flow_events(
+        ranks, flight_tid=_FLIGHT_TID, max_flows=_max_flow_events()
+    )
     base = min(all_ts) if all_ts else 0.0
+    for ev in flow_evs:
+        ev["ts"] = round(ev["ts"] - base, 3)
+        events.append(ev)
     for rank in sorted(per_rank_events):
         suffix = "" if aligned[rank] else " (unaligned)"
         events.append({
@@ -677,6 +701,9 @@ def analyze(telemetry_dir, run: Optional[dict] = None) -> dict:
         "ps": ps_health(ranks),
         "resize": analyze_resizes(run),
         "hangs": analyze_hangs(run),
+        "critical_path": _criticalpath.critical_path(ranks),
+        "overlap": _criticalpath.overlap_ledger(ranks),
+        "serve_hops": _criticalpath.serve_hops(ranks),
     }
     return report
 
@@ -711,6 +738,18 @@ def _summary_lines(report: dict) -> List[str]:
         )
     else:
         lines.append("straggler: none")
+    cp = report.get("critical_path", {})
+    if cp.get("fleet_dominant"):
+        line = f"critical path: fleet dominated by {cp['fleet_dominant']}"
+        if cp.get("dominant_rank") is not None:
+            dom_us = cp.get("dominance_us", {}).get(
+                str(cp["dominant_rank"]), 0.0
+            )
+            line += (
+                f"; rank {cp['dominant_rank']} caused "
+                f"{dom_us / 1000.0:.1f}ms of fleet wait"
+            )
+        lines.append(line)
     rz = report.get("resize", {"status": "none"})
     if rz["status"] == "none":
         lines.append("resize: none")
@@ -757,6 +796,56 @@ def _summary_lines(report: dict) -> List[str]:
     return lines
 
 
+def _critical_path_panel(report: dict) -> List[str]:
+    """The --critical-path panel: per-rank attribution, cross-rank
+    dominance, the measured overlap ledger, and serve hop decomposition."""
+    cp = report.get("critical_path", {})
+    lines = ["critical path:"]
+    rows = cp.get("ranks", {})
+    if not rows:
+        lines.append("  (no flight-recorder entries)")
+        return lines
+    for rank in sorted(rows, key=int):
+        row = rows[rank]
+        total = row["window_us"] or 1.0
+        top = sorted(
+            row["buckets_us"].items(), key=lambda kv: -kv[1]
+        )[:4]
+        terms = ", ".join(
+            f"{b} {us / total * 100:.0f}%" for b, us in top
+        )
+        dom = row["dominance_us"]
+        lines.append(
+            f"  rank {rank}: window {row['window_us'] / 1000:.1f}ms | "
+            f"{terms}"
+            + (f" | caused {dom / 1000:.1f}ms fleet wait" if dom else "")
+        )
+    if cp.get("dominant_rank") is not None:
+        lines.append(
+            f"  dominant rank: {cp['dominant_rank']} "
+            f"(fleet-dominant term: {cp.get('fleet_dominant')})"
+        )
+    ov = report.get("overlap", {}).get("plans", {})
+    if ov:
+        lines.append("overlap ledger (measured, per plan):")
+        for plan, row in sorted(ov.items()):
+            lines.append(
+                f"  {plan}: {row['chunks']} chunks, serial "
+                f"{row['serial_us'] / 1000:.2f}ms -> span "
+                f"{row['span_us'] / 1000:.2f}ms "
+                f"(overlap {row['measured_fraction'] * 100:.1f}%)"
+            )
+    sh = report.get("serve_hops", {}).get("summary")
+    if sh:
+        lines.append(
+            f"serve hops: {sh['hops']} decomposed | mean client "
+            f"{sh['mean_client_us'] / 1000:.2f}ms = server "
+            f"{sh['mean_server_us'] / 1000:.2f}ms + wire/queue "
+            f"{sh['mean_wire_us'] / 1000:.2f}ms"
+        )
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m torchmpi_tpu.telemetry.analyze",
@@ -772,6 +861,10 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="fail on findings: exit 1 on desync, 3 on hang "
                     "(desync wins when both); 0 clean, 2 input error")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="print the per-rank critical-path attribution "
+                    "panel (buckets, dominance, overlap ledger, serve "
+                    "hops)")
     args = ap.parse_args(argv)
 
     d = Path(args.dir)
@@ -791,6 +884,9 @@ def main(argv=None) -> int:
 
     for line in _summary_lines(report):
         print(line)
+    if args.critical_path:
+        for line in _critical_path_panel(report):
+            print(line)
     print(f"report: {out}")
     print(f"merged trace: {trace_path}")
     # Exit-code contract (CI composes this with `tpu-lint --strict`,

@@ -7,7 +7,7 @@ the world hung". Every eager collective dispatch, fusion-buffer flush,
 engine step, and parameter-server RPC records one entry:
 
     (seq, comm, op, payload, wire, backend, routing,
-     t_issue, t_complete, status)
+     t_issue, t_complete, status, trace, span, parent)
 
 - ``seq`` is a **monotonic per-communicator sequence number**. Ranks
   executing the same program issue the same (seq, op, payload) stream per
@@ -39,6 +39,7 @@ from __future__ import annotations
 import os
 import threading
 from ..analysis import lockmon as _lockmon
+from . import tracecontext as _tracecontext
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -50,10 +51,12 @@ STATUS_FAILED = "failed"
 # entry slot layout (a list, mutated in place on completion)
 _SEQ, _COMM, _OP, _PAYLOAD, _WIRE, _BACKEND, _ROUTING, _PLAN = range(8)
 _T_ISSUE, _T_COMPLETE, _STATUS = 8, 9, 10
+# causal trace context (PR 18): all-zero when tracing is off / unstamped
+_TRACE, _SPAN, _PARENT = 11, 12, 13
 
 ENTRY_KEYS = (
     "seq", "comm", "op", "payload", "wire", "backend", "routing", "plan",
-    "t_issue", "t_complete", "status",
+    "t_issue", "t_complete", "status", "trace", "span", "parent",
 )
 
 
@@ -103,21 +106,29 @@ class FlightRecorder:
     # ------------------------------------------------------------------
     def record(self, comm: str, op: str, payload=None, wire: str = "",
                backend: str = "", routing: str = "",
-               seq: Optional[int] = None, plan: str = "") -> list:
+               seq: Optional[int] = None, plan: str = "",
+               trace: int = 0, span: int = 0, parent: int = 0) -> list:
         """Append one ``issued`` entry; returns the (mutable) entry.
         ``seq=None`` draws the next per-``comm`` sequence number;
         an explicit seq (the PS transport's wire seq) advances the
         high-water mark to match. ``plan`` is the schedule compiler's
         stable plan_id — the analyzer diffs it alongside (op, payload),
         so a cross-rank divergence can name the diverging *schedule*
-        (hierarchical sub-structure included), not just the op."""
+        (hierarchical sub-structure included), not just the op.
+
+        ``trace``/``span``/``parent`` (PR 18) pin this entry into the
+        causal DAG. Explicit ids win (wire-received context); otherwise
+        the ambient :mod:`telemetry.tracecontext` is consulted and a
+        deterministic child span derived from (comm, op, seq)."""
         t = time.time()
         with self._lock:
             if seq is None:
                 seq = self._seqs.get(comm, -1) + 1
             self._seqs[comm] = seq
+            if not trace:
+                trace, span, parent = _tracecontext.stamp(comm, op, seq)
             entry = [seq, comm, op, payload, wire, backend, routing, plan,
-                     t, None, STATUS_ISSUED]
+                     t, None, STATUS_ISSUED, trace, span, parent]
             if len(self._buf) == self._buf.maxlen:
                 self.dropped += 1
             self._buf.append(entry)
@@ -137,11 +148,14 @@ class FlightRecorder:
     def record_complete(self, comm: str, op: str, t_issue: float,
                         t_complete: float, payload=None, wire: str = "",
                         backend: str = "", routing: str = "",
-                        seq: Optional[int] = None) -> list:
+                        seq: Optional[int] = None,
+                        trace: int = 0, span: int = 0,
+                        parent: int = 0) -> list:
         """Record an already-finished event (engine steps time themselves
         and report after the fact) with explicit wall timestamps."""
         entry = self.record(comm, op, payload=payload, wire=wire,
-                            backend=backend, routing=routing, seq=seq)
+                            backend=backend, routing=routing, seq=seq,
+                            trace=trace, span=span, parent=parent)
         entry[_T_ISSUE] = t_issue
         entry[_T_COMPLETE] = t_complete
         entry[_STATUS] = STATUS_COMPLETED

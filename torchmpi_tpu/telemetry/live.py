@@ -64,6 +64,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import constants
 from ..analysis import lockmon as _lockmon
+from . import criticalpath as _criticalpath
 from . import flightrecorder as _flight
 from .analyze import (
     analyze_resizes,
@@ -155,7 +156,7 @@ class LiveExporter:
     # -- frame building ----------------------------------------------------
     def frame(self) -> dict:
         """One bounded delta frame (or a full one after a drop/start)."""
-        from . import metrics, spans
+        from . import metrics, refresh_clock_sync, spans
 
         since = self._last_gen
         rec = _flight.recorder
@@ -189,6 +190,11 @@ class LiveExporter:
                 "dropped": spans.dropped,
             },
             "resize_epoch": int(constants.get("resize_epoch")),
+            # the clock triple is RE-CAPTURED on every frame (heartbeat
+            # cadence): the merger aligns with the freshest one, so
+            # wall-vs-perf drift is bounded by one live interval instead
+            # of accumulating since start()
+            "clock_sync": refresh_clock_sync(),
         }
 
     def mark_dropped(self) -> None:
@@ -371,6 +377,7 @@ class _RankView:
         "rank", "pid", "last_time", "metrics", "seq_high_water",
         "entries", "flight_dropped", "flight_recorded", "spans",
         "resize_epoch", "closed", "frames", "expected_since",
+        "clock_sync",
     )
 
     def __init__(self, rank: int):
@@ -387,6 +394,9 @@ class _RankView:
         self.resize_epoch = 0
         self.closed: Optional[str] = None  # None | "clean" | "dead"
         self.frames = 0
+        # freshest per-frame clock triple (drift hardening): kept by
+        # wall_time, so an out-of-order replay never regresses alignment
+        self.clock_sync: Optional[dict] = None
         # the metrics generation the next delta must chain from; a
         # mismatch (dropped frame) keeps the old families until a full
         # snapshot restores coherence
@@ -510,6 +520,11 @@ class FleetAggregator:
                 else:
                     rv.metrics = dict(met)
                     rv.expected_since = frame.get("metrics_generation")
+            cs = frame.get("clock_sync")
+            if isinstance(cs, dict):
+                prev_wall = (rv.clock_sync or {}).get("wall_time", 0.0)
+                if float(cs.get("wall_time", 0.0)) >= float(prev_wall):
+                    rv.clock_sync = cs
             for comm, seq in (frame.get("seq_high_water") or {}).items():
                 rv.seq_high_water[comm] = int(seq)
             for e in frame.get("flight_tail") or []:
@@ -562,6 +577,7 @@ class FleetAggregator:
                         "dropped": rv.flight_dropped,
                     },
                     "spans": rv.spans,
+                    "clock_sync": rv.clock_sync,
                 },
                 "trace_events": [],
             }
@@ -882,6 +898,8 @@ class FleetAggregator:
         with self._lock:
             frames_total = self.frames_total
             incoherent = self.incoherent_deltas
+            pranks = self._pseudo_ranks()
+        cp = _criticalpath.critical_path(pranks)
         fleet_hw: Dict[str, int] = {}
         rows = {}
         for rv in views:
@@ -934,6 +952,12 @@ class FleetAggregator:
                 "busy_rate_per_s": self._busy_rates.get(str(rank)),
                 "resize_epoch": rv["resize_epoch"],
                 "ps_dominant": dominant,
+                # dominant critical-path term over the rolling window
+                # (the `top` cp_term column); compute-only windows show
+                # "compute"
+                "cp_dominant": cp["ranks"].get(str(rank), {}).get(
+                    "dominant"
+                ),
                 "spans_dropped": rv["spans"].get("dropped", 0),
             }
         return {
@@ -943,6 +967,22 @@ class FleetAggregator:
             "frames_total": frames_total,
             "incoherent_deltas": incoherent,
             "samples": len(self.samples),
+        }
+
+    def criticalpath(self, now: Optional[float] = None) -> dict:
+        """Live critical-path attribution over the rolling entry window
+        (the ``/criticalpath`` endpoint): the same causal-DAG analysis
+        the offline analyzer runs on full dumps, here incremental over
+        the streamed flight tails — per-rank buckets, cross-rank
+        dominance, the measured overlap ledger, serve hop split."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            pranks = self._pseudo_ranks()
+        return {
+            "time": round(now, 6),
+            "critical_path": _criticalpath.critical_path(pranks),
+            "overlap": _criticalpath.overlap_ledger(pranks),
+            "serve_hops": _criticalpath.serve_hops(pranks),
         }
 
     def prometheus(self, now: Optional[float] = None) -> str:
@@ -975,6 +1015,57 @@ class FleetAggregator:
                 f'tm_fleet_rank_report_age_seconds{{rank="{rv["rank"]}"}} '
                 f"{max(0.0, round(now - rv['last_time'], 3))}"
             )
+        # critical-path + trace-context families over the rolling window
+        with self._lock:
+            pranks = self._pseudo_ranks()
+        cp = _criticalpath.critical_path(pranks)
+        out.append(
+            "# HELP tm_criticalpath_bucket_us per-rank wall-time "
+            "critical-path attribution over the rolling window, by bucket"
+        )
+        out.append("# TYPE tm_criticalpath_bucket_us gauge")
+        for r, row in sorted(
+            cp["ranks"].items(), key=lambda kv: int(kv[0])
+        ):
+            for b, us in sorted(row["buckets_us"].items()):
+                out.append(
+                    f'tm_criticalpath_bucket_us{{rank="{r}",'
+                    f'bucket="{b}"}} {us}'
+                )
+        out.append(
+            "# HELP tm_criticalpath_dominance_us fleet wait each rank's "
+            "lateness caused (critical-path straggler dominance)"
+        )
+        out.append("# TYPE tm_criticalpath_dominance_us gauge")
+        for r, us in sorted(
+            cp.get("dominance_us", {}).items(), key=lambda kv: int(kv[0])
+        ):
+            out.append(f'tm_criticalpath_dominance_us{{rank="{r}"}} {us}')
+        out.append(
+            "# HELP tm_trace_stamped_entries flight entries in the "
+            "rolling window carrying a causal trace context"
+        )
+        out.append("# TYPE tm_trace_stamped_entries gauge")
+        for r in sorted(pranks):
+            stamped = sum(
+                1 for e in pranks[r]["snapshot"]["flight_recorder"][
+                    "entries"
+                ] if e.get("trace")
+            )
+            out.append(f'tm_trace_stamped_entries{{rank="{r}"}} {stamped}')
+        flows = _criticalpath.flow_events(
+            pranks,
+            max_flows=int(constants.get("trace_max_flow_events")),
+        )
+        out.append(
+            "# HELP tm_trace_flow_events cross-rank causal flow arrows "
+            "derivable from the rolling window"
+        )
+        out.append("# TYPE tm_trace_flow_events gauge")
+        out.append(
+            "tm_trace_flow_events "
+            f"{sum(1 for ev in flows if ev['ph'] == 's')}"
+        )
         sup = self.supervisor
         if sup is not None:
             out.extend(sup.prometheus_lines())
@@ -1065,6 +1156,12 @@ class FleetAggregator:
                         doc["history"] = agg.verdict_history
                         body = json.dumps(
                             doc, indent=1, sort_keys=True, default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/criticalpath":
+                        body = json.dumps(
+                            agg.criticalpath(), indent=1,
+                            sort_keys=True, default=str,
                         ).encode()
                         ctype = "application/json"
                     elif path == "/calibration":

@@ -9,7 +9,9 @@ endpoints (``launch --telemetry-live`` prints the address):
 Each refresh fetches ``/health`` + ``/verdicts`` and renders one row
 per rank — last-report age, flight seq high-water and lag behind the
 fleet, step p50, BUSY reject count and rolling per-second rate, resize
-epoch, dominant PS latency term — under the streaming verdict summary. ``--once`` prints a single
+epoch, dominant PS latency term, dominant critical-path term (what the
+rank's wall time is actually spent on, from the causal trace layer's
+/criticalpath attribution) — under the streaming verdict summary. ``--once`` prints a single
 frame (scripts/tests); the default loops every ``--interval`` seconds,
 clearing the screen between frames. Stdlib-only (urllib).
 """
@@ -53,7 +55,7 @@ def render(health: dict, verdicts: dict) -> str:
     header = (
         f"{'rank':>5} {'age_s':>7} {'seq_hw':>8} {'lag':>5} "
         f"{'step_p50':>9} {'busy':>6} {'busy/s':>7} {'epoch':>6} "
-        f"{'ps_term':>8} {'state':>6}"
+        f"{'ps_term':>8} {'cp_term':>13} {'state':>6}"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -69,7 +71,8 @@ def render(health: dict, verdicts: dict) -> str:
             f"{_fmt(row.get('busy_rejected'), 6)} "
             f"{_fmt(row.get('busy_rate_per_s'), 7)} "
             f"{_fmt(row.get('resize_epoch'), 6)} "
-            f"{_fmt(row.get('ps_dominant'), 8)} {state:>6}"
+            f"{_fmt(row.get('ps_dominant'), 8)} "
+            f"{_fmt(row.get('cp_dominant'), 13)} {state:>6}"
         )
     return "\n".join(lines)
 
