@@ -671,13 +671,53 @@ def _worker_resnet50():
         "bound": "compute",
         "platform": platform,
     }
-    line.update(
-        _flops_fields(
-            value,
-            train_flops(resnet_forward_flops(image, num_classes=classes)),
-            devices[0],
+    fps = train_flops(resnet_forward_flops(image, num_classes=classes))
+    line.update(_flops_fields(value, fps, devices[0]))
+
+    # Streaming-input epoch: the SAME model fed by torchmpi_tpu.data's
+    # InputPipeline through engine.train(), with telemetry armed so the
+    # input-stall-aware MFU accounting (tm_engine_mfu vs
+    # tm_engine_mfu_incl_input) and the tm_input_* counters are
+    # exercised end to end. The resident epochs above stay the headline
+    # rate (input cost is zero by construction there).
+    try:
+        from torchmpi_tpu import telemetry as _tele
+        from torchmpi_tpu.data import InputPipeline
+
+        _tele.enable()
+        seng = AllReduceSGDEngine(
+            make_stateful_loss_fn(model),
+            params,
+            optimizer=optax.sgd(0.1, momentum=0.9),
+            model_state=stats,
+            flops_per_sample=fps,
         )
-    )
+        pipe = InputPipeline(
+            (xtr, ytr), batch_size=per_rank * p, num_ranks=p,
+            sharding=seng.batch_sharding, seed=7,
+        )
+        sstate = seng.train(pipe, max_epochs=1)
+        m = _tele.metrics
+        mfu_incl = m.gauge("tm_engine_mfu_incl_input").value()
+        line["input"] = {
+            "pipeline": "streaming",
+            "batches_per_epoch": len(pipe),
+            "batches_delivered": m.counter(
+                "tm_input_batches_total"
+            ).value(path="device"),
+            "input_stall_s": round(float(sstate["input_stall"]), 4),
+            "consumer_stall_s": round(float(pipe.consumer_stall_s), 4),
+            "engine_input_stall_s": round(float(m.counter(
+                "tm_engine_input_stall_seconds"
+            ).total()), 4),
+            "mfu_incl_input": (
+                round(mfu_incl, 5) if mfu_incl is not None else None
+            ),
+        }
+    except Exception as e:  # noqa: BLE001 - the streaming section must
+        # never take down the headline resident measurement
+        line["input"] = {"error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps(line), flush=True)
     mpi.stop()
 
@@ -1047,6 +1087,89 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     })
     pipe_ledger_row = pipe_ledger.get("plans", {}).get(pipe_id)
 
+    # ---- scheduled-vs-unscheduled gradient-overlap gate --------------
+    # The reverse-order flush scheduler must MEASURE more overlap than
+    # the all-at-once baseline on the same bucketed gradient set, judged
+    # by the same flight-sub-entry ledger as the chunk pipeline above.
+    # Each bucket's sub-entry spans dispatch -> wait: the 'none'
+    # baseline packs everything, then dispatches and waits each bucket
+    # serially (disjoint spans, fraction ~0), while 'reverse' issues
+    # every dispatch before the first wait (nested spans, fraction
+    # toward 1 - 1/num_buckets). This is real on this sequential-cpu
+    # box too: jax dispatch is async on the HOST side, so the dispatch
+    # -> wait windows overlap in wall clock even though the device work
+    # serializes — the ledger measures launch-order overlap, which is
+    # exactly what the scheduler moves. wire_dtype='full' keeps the
+    # bitwise leg at f32 (scheduler off vs on must be bit-identical).
+    from torchmpi_tpu.nn import GradientBuckets
+    from torchmpi_tpu.schedule.overlap import schedule_base
+
+    sched_nb = 4
+    sched_n = 1 << 16
+    sched_tmpl = {
+        f"g{i:02d}": jnp.zeros((p, sched_n), jnp.float32)
+        for i in range(sched_nb)
+    }
+    sched_bkts = GradientBuckets(sched_tmpl, num_buckets=sched_nb)
+    sched_grads = {
+        k: jax.device_put(
+            jnp.full((p, sched_n), float(i + 1), jnp.float32), sharding
+        )
+        for i, k in enumerate(sorted(sched_tmpl))
+    }
+    jax.block_until_ready(list(sched_grads.values()))
+
+    def _sched_lap(schedule: str, tag: str):
+        t0 = time.perf_counter()
+        out = sched_bkts.sync_scheduled(
+            sched_grads, comm=comm, wire_dtype="full",
+            schedule=schedule, tag=tag,
+        )
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0, out
+
+    # warm lap per schedule (pack jits + collective compile), untimed;
+    # then ONE flight-armed lap each — the ledger pools every span with
+    # the same plan base, so a second lap would stretch the group's
+    # wall-clock across the inter-lap gap and corrupt the fraction
+    _sched_lap("none", "warmup")
+    _sched_lap("reverse", "warmup")
+    try:
+        flight.enable()
+        none_s, sched_none_out = _sched_lap("none", "ubench")
+        rev_s, sched_rev_out = _sched_lap("reverse", "ubench")
+    finally:
+        flight.disable()
+    sched_bitwise = all(
+        np.array_equal(
+            np.asarray(sched_none_out[k]), np.asarray(sched_rev_out[k])
+        )
+        for k in sched_grads
+    )
+    sched_plans = cp_mod.overlap_ledger({
+        0: {"snapshot": {
+            "flight_recorder": {"entries": flight.recorder.entries()},
+        }},
+    }).get("plans", {})
+    sched_none_row = sched_plans.get(schedule_base("none", "ubench"))
+    sched_rev_row = sched_plans.get(schedule_base("reverse", "ubench"))
+    sched_none_frac = float(
+        (sched_none_row or {}).get("measured_fraction", 0.0)
+    )
+    sched_rev_frac = float(
+        (sched_rev_row or {}).get("measured_fraction", 0.0)
+    )
+    # submit-side cost of the bucketed async launch path (pack dispatch
+    # + async collective dispatch per bucket), warm — reported as
+    # evidence; the recording cost the scheduler ADDS per dispatch is
+    # already inside the recorder gate's 150us/dispatch budget above
+    t0 = time.perf_counter()
+    sched_hs = sched_bkts.allreduce_async(
+        sched_grads, comm=comm, wire_dtype="full"
+    )
+    sched_submit_us = (time.perf_counter() - t0) / sched_nb * 1e6
+    sched_bkts.wait_and_unflatten(sched_grads, sched_hs, comm=comm)
+
     fused_us = warm_fused_s / n_tensors * 1e6
     unfused_us = warm_unfused_s / n_tensors * 1e6
     line = {
@@ -1116,6 +1239,19 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
                 "measured_chunk_ledger": pipe_ledger_row,
             },
         },
+        "scheduler": {
+            "buckets": sched_nb,
+            "bucket_elems": sched_n,
+            "wire": "full",
+            "none_ms": round(none_s * 1e3, 3),
+            "reverse_ms": round(rev_s * 1e3, 3),
+            "bitwise_identical": sched_bitwise,
+            "submit_us_per_bucket": round(sched_submit_us, 2),
+            "ledger_none": sched_none_row,
+            "ledger_reverse": sched_rev_row,
+            "measured_fraction_none": round(sched_none_frac, 4),
+            "measured_fraction_reverse": round(sched_rev_frac, 4),
+        },
     }
     print(json.dumps(line), flush=True)
     mpi.stop()
@@ -1152,6 +1288,19 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             0.0 <= pipe_lap_frac <= 1.0
             and 0.0 < pipe_modeled_frac <= 1.0
         )
+        # scheduler gate: the reverse-order flush must (a) measure
+        # strictly MORE ledger overlap than the all-at-once baseline on
+        # the identical bucket set, (b) reproduce the baseline bitwise
+        # at f32 wire (the scheduler moves time, not bits), and (c)
+        # stay inside the same absolute gross-regression lap budget as
+        # the chunk-pipeline gate (single laps on this box carry ms of
+        # scheduler noise; the 150us/dispatch recorder budget above
+        # already covers the per-dispatch recording the scheduler adds)
+        sched_ok = (
+            sched_rev_frac > sched_none_frac
+            and sched_bitwise
+            and (rev_s - none_s) * 1e3 < pipe_cpu_budget_ms
+        )
         ok = (
             fused_us <= unfused_us
             and compiles_after == 0
@@ -1161,6 +1310,7 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             and live_frames > 0
             and pipe_ok
             and overlap_ok
+            and sched_ok
         )
         if not ok:
             print(
@@ -1181,7 +1331,12 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
                 f"(gate: {'beats' if pipe_on_accel else 'abs budget'}), "
                 f"overlap depth {pipe_run_depth}: modeled "
                 f"{pipe_modeled_frac:.3f} vs measured lap "
-                f"{pipe_lap_frac:.3f} (chunk ledger: {pipe_ledger_row})",
+                f"{pipe_lap_frac:.3f} (chunk ledger: {pipe_ledger_row}), "
+                f"scheduler: reverse {sched_rev_frac:.3f} vs none "
+                f"{sched_none_frac:.3f} (must be strictly greater), "
+                f"bitwise={sched_bitwise}, lap delta "
+                f"{(rev_s - none_s) * 1e3:+.1f}ms "
+                f"(budget {pipe_cpu_budget_ms}ms)",
                 file=sys.stderr,
                 flush=True,
             )

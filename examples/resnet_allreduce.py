@@ -52,6 +52,19 @@ def main(argv=None):
         default=0,
         help="force an N-device virtual CPU mesh (0 = use real devices)",
     )
+    ap.add_argument(
+        "--streaming",
+        action="store_true",
+        help="feed epochs through the torchmpi_tpu.data streaming input "
+        "pipeline (background producers + device prefetch) instead of "
+        "device-resident epochs",
+    )
+    ap.add_argument(
+        "--input-workers",
+        type=int,
+        default=0,
+        help="producer threads for --streaming (0 = input_workers knob)",
+    )
     args = ap.parse_args(argv)
 
     if args.cpu_mesh:
@@ -79,6 +92,11 @@ def main(argv=None):
         make_stateful_loss_fn,
     )
     from torchmpi_tpu.utils import synthetic_imagenet
+    from torchmpi_tpu.utils.flops import (
+        mfu,
+        resnet_forward_flops,
+        train_flops,
+    )
 
     mpi.start()
     p = mpi.size()
@@ -96,6 +114,17 @@ def main(argv=None):
         image_size=args.image_size,
     )
 
+    if args.model == "resnet50":
+        fwd_flops = resnet_forward_flops(
+            args.image_size, num_classes=args.classes
+        )
+    else:
+        fwd_flops = resnet_forward_flops(
+            args.image_size, stage_sizes=(2, 2, 2, 2), bottleneck=False,
+            num_classes=args.classes,
+        )
+    flops_per_sample = train_flops(fwd_flops)
+
     engine = AllReduceSGDEngine(
         make_stateful_loss_fn(model),
         params,
@@ -104,6 +133,7 @@ def main(argv=None):
         model_state=batch_stats,
         param_sharding="fsdp" if args.fsdp else "replicated",
         accum_steps=args.accum_steps,
+        flops_per_sample=flops_per_sample,
     )
 
     def log_epoch(epoch, loss, secs):
@@ -115,13 +145,54 @@ def main(argv=None):
             f"{secs:.2f}s  {ips:,.0f} img/s ({ips / p:,.0f}/chip)"
         )
 
-    state = engine.train_resident(
-        xtr,
-        ytr,
-        args.per_rank_batch,
-        max_epochs=args.epochs,
-        image_dtype=dtype if args.bf16 else None,
-        epoch_callback=log_epoch,
+    if args.streaming:
+        from torchmpi_tpu.data import InputPipeline
+
+        pipe = InputPipeline(
+            (xtr, ytr),
+            batch_size=args.per_rank_batch * p,
+            num_ranks=p,
+            sharding=engine.batch_sharding,
+            workers=args.input_workers or None,
+            # same host-side cast the resident path's image_dtype does,
+            # but on the producer threads (ml_dtypes gives numpy bf16)
+            transform=(
+                (lambda xb, yb: (xb.astype(jnp.bfloat16), yb))
+                if args.bf16 else None
+            ),
+        )
+        state = engine.train(pipe, max_epochs=args.epochs)
+        print(
+            f"[resnet] streaming input: {len(pipe)} batches/epoch, "
+            f"input stall {state['input_stall']:.3f}s "
+            f"(producer-side consumer stall {pipe.consumer_stall_s:.3f}s)"
+        )
+    else:
+        state = engine.train_resident(
+            xtr,
+            ytr,
+            args.per_rank_batch,
+            max_epochs=args.epochs,
+            image_dtype=dtype if args.bf16 else None,
+            epoch_callback=log_epoch,
+        )
+
+    # throughput + model-FLOPs utilization, computed from the run itself
+    # (fraction-of-peak is None off-TPU — printed as the raw FLOP/s then)
+    import jax
+
+    ips = state["samples"] / max(state["time"], 1e-9)
+    achieved, frac_incl = mfu(ips / p, flops_per_sample, jax.devices()[0])
+    busy = max(state["time"] - state.get("input_stall", 0.0), 1e-9)
+    print(
+        f"[resnet] throughput {ips:,.0f} img/s ({ips / p:,.0f}/chip), "
+        f"{achieved / 1e12:.3f} TFLOP/s/chip"
+        + (
+            f", MFU {frac_incl * state['time'] / busy:.1%} "
+            f"(incl. input stall {frac_incl:.1%})"
+            if frac_incl is not None
+            else " (no TPU peak table entry: MFU n/a)"
+        )
     )
 
     def apply_fn(prm, st, x):

@@ -129,6 +129,14 @@ run_perf_smoke() {
     # cover >=95% of each rank's step wall time.
     echo "=== trace smoke (2-proc causal flows + critical path) ==="
     python scripts/trace_smoke.py
+    # overlap smoke: the same 2-proc shape drives GradientBuckets
+    # through the 'none' and 'reverse' flush schedules; the analyzer
+    # must stay `desync: none` (scheduled flushes are rank-local
+    # bookkeeping, not divergence) and every rank's reverse-order row
+    # in the measured overlap ledger must strictly beat its
+    # all-at-once baseline row, with bitwise-identical gradients.
+    echo "=== overlap smoke (2-proc scheduled flush + measured ledger) ==="
+    python scripts/overlap_smoke.py
     # live-plane smoke: a 2-proc job with --telemetry-live must serve
     # fleet Prometheus + JSON (per-rank seq high-waters) and a streaming
     # `desync: none` verdict WHILE still running, the top CLI must

@@ -71,7 +71,7 @@ from .runtime_state import (
 # torchmpi.parameterserver, ...): `import torchmpi_tpu as mpi; mpi.nn.*`
 # must work without a separate import. Imported LAST — each pulls from
 # `collectives`/`runtime_state` above, so the order avoids cycles.
-from . import engine, nn, parallel, parameterserver, utils  # noqa: E402
+from . import data, engine, nn, parallel, parameterserver, utils  # noqa: E402
 
 __version__ = "0.5.0"
 

@@ -235,8 +235,17 @@ class FusionBuffer:
         return h
 
     def flush_all(self, reason: str = "explicit") -> None:
-        """Dispatch every pending group now (handles stay waitable)."""
-        for group in list(self._groups.values()):
+        """Dispatch every pending group now (handles stay waitable).
+
+        Under ``overlap_schedule='reverse'`` groups flush in REVERSE
+        insertion order: gradient producers submit forward-layer-first,
+        so the reverse order puts the last layers — the first gradients
+        ready during backward — on the wire first (the same flush order
+        the bucket scheduler dispatches, ``schedule/overlap.py``)."""
+        groups = list(self._groups.values())
+        if constants.get("overlap_schedule") == "reverse":
+            groups.reverse()
+        for group in groups:
             if not group.flushed():
                 self._flush_group(group, reason=reason)
 

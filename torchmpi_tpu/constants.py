@@ -120,6 +120,15 @@ class _Constants:
     # bypassed: small payloads are latency-bound (op_route sends them to
     # the fused XLA path anyway) and the scale overhead erodes the win.
     wire_quant_min_elements: int = 1 << 16
+    # Error-feedback compression (1-bit SGD / QSGD lineage behind
+    # EQuARX): when a gradient bucket ships on a lossy wire ('int8' /
+    # 'bf16'), keep the per-bucket quantization residual in an f32
+    # buffer and add it back before the NEXT quantization, so the
+    # compression error is fed forward instead of lost — int8 wire
+    # stays convergent at scales where plain quantization drifts.
+    # Residuals ride the persistent flat buckets (fusion_buffer_bytes),
+    # one f32 buffer per bucket.
+    wire_error_feedback: bool = False
 
     # --- parameter-server data path (wire format + overlap) ---
     # On-wire encoding for PS client<->server exchanges (updates, shard
@@ -287,6 +296,31 @@ class _Constants:
     # would fall below this is not a candidate — small chunks are
     # alpha-dominated and the per-hop launch overhead eats the overlap.
     plan_pipeline_min_chunk_bytes: int = 1 << 18
+
+    # --- gradient-overlap scheduling (bucket flush order) ---
+    # How GradientBuckets / FusionBuffer order bucket flushes against
+    # the backward pass: 'none' packs everything and dispatches+waits
+    # each bucket serially (the all-at-once baseline), 'reverse' keeps
+    # the reverse-layer bucket order (bucket 0 = last layers = first
+    # gradients ready) and dispatches every bucket async before any
+    # wait, so bucket k's wire time overlaps bucket k+1's quantize/pack.
+    # The order is stamped into the schedule IR as per-bucket plan
+    # priorities; the overlap ledger (telemetry.analyze) measures the
+    # realized overlap fraction per scheduled flush.
+    overlap_schedule: str = "none"
+
+    # --- streaming input pipeline (torchmpi_tpu.data) ---
+    # Bounded depth of the host-side batch ring AND the device prefetch
+    # window: producer threads stay at most this many batches ahead of
+    # the consumer, and the pipeline keeps the next batch's
+    # host-to-device transfer in flight while the current one trains
+    # (double-buffered like the PS ps_prefetch path).
+    input_prefetch_batches: int = 2
+    # Background producer threads assembling host batches. More than one
+    # helps when per-batch assembly (decode, augment, memmap reads) is
+    # the bottleneck; batches are re-sequenced by a reorder window so
+    # delivery order is deterministic regardless of worker count.
+    input_workers: int = 1
 
     # --- live elastic resharding (reshard/ subsystem) ---
     # Chunk size (BYTES) for redistribution transfers: the reshard

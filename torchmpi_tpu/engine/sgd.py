@@ -79,6 +79,18 @@ def _engine_metrics():
                 "tm_engine_tflops_per_chip",
                 "achieved TFLOP/s per chip (flops_per_sample engines)",
             ),
+            m.gauge(
+                "tm_engine_mfu_incl_input",
+                "MFU over the step window INCLUDING measured input-stall "
+                "time — diverges from tm_engine_mfu exactly when the run "
+                "is input-bound (streamed-iterator engines only)",
+            ),
+            m.counter(
+                "tm_engine_input_stall_seconds",
+                "seconds the training loop spent waiting on the input "
+                "iterator (excluded from tm_engine_mfu's step window; "
+                "joins tm_input_consumer_stall_seconds)",
+            ),
         )
     return _ENG_MET
 
@@ -586,15 +598,24 @@ class AllReduceSGDEngine:
         return aux, None
 
     def _record_step(self, examples: int, t0: float, t1: float,
-                     gnorm=None, steps: int = 1, epoch: bool = False):
-        (n_steps, step_s, epoch_s, eps, gn, mfu_g, tflops_g) = (
-            _engine_metrics()
-        )
+                     gnorm=None, steps: int = 1, epoch: bool = False,
+                     input_stall_s: float = 0.0):
+        """``[t0, t1]`` is the COMPUTE window (the batch was already
+        resident when it opened); ``input_stall_s`` is the measured wait
+        on the input iterator that preceded it. Throughput/MFU come from
+        the compute window — an input-bound run must not masquerade as a
+        compute-bound one — and ``tm_engine_mfu_incl_input`` reports the
+        stall-inclusive figure next to it so the gap IS the verdict."""
+        (n_steps, step_s, epoch_s, eps, gn, mfu_g, tflops_g,
+         mfu_incl_g, stall_c) = _engine_metrics()
         dt = max(t1 - t0, 1e-12)
+        stall = max(float(input_stall_s), 0.0)
         n_steps.inc(steps, mode=self.mode, sharding=self.param_sharding)
         (epoch_s if epoch else step_s).observe(dt)
         rate = examples / dt
         eps.set(rate)
+        if stall > 0:
+            stall_c.inc(stall)
         if gnorm is not None:
             gn.set(float(gnorm))
         if self.flops_per_sample:
@@ -607,6 +628,7 @@ class AllReduceSGDEngine:
             tflops_g.set(achieved / 1e12)
             if frac is not None:
                 mfu_g.set(frac)
+                mfu_incl_g.set(frac * dt / (dt + stall))
         _telemetry.spans.record(
             "engine.epoch" if epoch else "engine.step",
             t0 * 1e6, dt * 1e6,
@@ -1374,6 +1396,7 @@ class AllReduceSGDEngine:
             "losses": [],
             "samples": 0,
             "time": 0.0,
+            "input_stall": 0.0,
         }
         self._hook("on_start", state)
 
@@ -1403,7 +1426,18 @@ class AllReduceSGDEngine:
                 state["epoch"] = epoch
                 loss = None
                 self._hook("on_start_epoch", state)
-                for batch in iterator_fn():
+                # explicit next() so the wait on the iterator is MEASURED:
+                # a streaming pipeline that can't keep up shows here as
+                # input stall, not as silently-slower steps (the MFU fix)
+                batch_iter = iter(iterator_fn())
+                while True:
+                    t_fetch = time.perf_counter()
+                    try:
+                        batch = next(batch_iter)
+                    except StopIteration:
+                        break
+                    fetch_s = time.perf_counter() - t_fetch
+                    state["input_stall"] += fetch_s
                     batch = self._prepare_batch(batch)
                     state["sample"] = batch
                     self._hook("on_sample", state)
@@ -1432,6 +1466,7 @@ class AllReduceSGDEngine:
                         self._record_step(
                             jax.tree_util.tree_leaves(batch)[0].shape[0],
                             t_step, time.perf_counter(), gnorm,
+                            input_stall_s=fetch_s,
                         )
                     state["t"] += 1
                     state["samples"] += jax.tree_util.tree_leaves(batch)[0].shape[0]

@@ -216,6 +216,12 @@ class GradientBuckets:
         # cached pack executable + recycled (donated) buffer per bucket
         self._pack_fns: Dict[int, Callable] = {}
         self._spares: Dict[int, Any] = {}
+        # error-feedback state (wire_error_feedback): one cached encode
+        # executable + persistent f32 residual buffer per bucket — the
+        # quantization error of flush k is added back before flush k+1's
+        # quantization (1-bit SGD/QSGD lineage)
+        self._ef_fns: Dict[Any, Callable] = {}
+        self._residuals: Dict[Any, Any] = {}
 
     def bucket_leaves(self, tree, b: int):
         leaves = tree_util.tree_leaves(tree)
@@ -255,6 +261,111 @@ class GradientBuckets:
             buf = jnp.zeros((p, sum(widths)), dtype)
         return key, fn(buf, *flats)
 
+    def _packed_bucket(self, b: int, leaves, p: int,
+                       wire_dtype: Optional[str] = None):
+        """Pack bucket ``b``'s leaves into its flat [p, total] buffer;
+        returns ``(key, buf)`` — ``key`` is the spare-recycling key of
+        the persistent path (``fusion_buffer_bytes`` > 0), None on the
+        fresh-concat fallback."""
+        from .. import constants as _constants
+        from ..collectives.fusion import count_coalesced
+
+        flats = [jnp.reshape(leaves[i], (p, -1)) for i in self.buckets[b]]
+        if _constants.get("fusion_buffer_bytes") > 0:
+            key, buf = self._pack_bucket(b, flats, self.bucket_dtype(b))
+            count_coalesced("allreduce", wire_dtype, len(flats))
+            return key, buf
+        return None, jnp.concatenate(flats, axis=1)
+
+    def _error_feedback(self, b: int, buf, wire_dtype: Optional[str]):
+        """Error-feedback encode of one packed bucket: add the stored
+        residual, quantize+dequantize on exactly the wire's grid (per
+        rank row, ``wire_quant_block_size`` blocks for int8; bf16
+        round-trip for bf16), store the new residual, ship the
+        quantized values. The wire re-quantizes them exactly on its
+        first hop (the max block element maps to ±127·scale, so the
+        scale — and hence every code — reproduces), which is what makes
+        the residual the TRUE compression error. No-op whenever the
+        wire would not engage (non-f32 bucket, below the cutoff,
+        'full'). ``buf`` is donated; callers use the returned array."""
+        from .. import constants as _constants
+        from ..collectives import primitives as _prim
+
+        p, n = int(buf.shape[0]), int(buf.shape[1])
+        wire = eager.resolve_wire_dtype(
+            "allreduce", n, jnp.result_type(buf), wire_dtype
+        )
+        if wire not in ("int8", "bf16"):
+            return buf
+        block = int(_constants.get("wire_quant_block_size"))
+        fkey = (b, p, n, wire, block)
+        fn = self._ef_fns.get(fkey)
+        if fn is None:
+            if wire == "bf16":
+                def encode(raw, res):
+                    comp = raw + res
+                    qv = comp.astype(jnp.bfloat16).astype(jnp.float32)
+                    return qv, comp - qv
+            else:
+                pad = -n % block
+
+                def encode(raw, res):
+                    comp = raw + res
+                    padded = (
+                        jnp.pad(comp, ((0, 0), (0, pad))) if pad else comp
+                    )
+                    blocks = padded.reshape(p, -1, block)
+                    scale = jnp.maximum(
+                        jnp.max(jnp.abs(blocks), axis=2, keepdims=True),
+                        _prim._SCALE_FLOOR,
+                    ) / 127.0
+                    q = jnp.round(blocks / scale)
+                    qv = (q * scale).reshape(p, -1)[:, :n]
+                    return qv, comp - qv
+
+            fn = jax.jit(encode, donate_argnums=(0, 1))
+            self._ef_fns[fkey] = fn
+        res = self._residuals.pop(fkey, None)
+        if res is None or getattr(res, "is_deleted", lambda: False)():
+            res = jnp.zeros((p, n), jnp.float32)
+        qv, new_res = fn(buf, res)
+        self._residuals[fkey] = new_res
+        return qv
+
+    def _dispatch_bucket(
+        self,
+        b: int,
+        key,
+        buf,
+        comm: Communicator,
+        backend: Optional[str],
+        wire_dtype: Optional[str],
+    ) -> SyncHandle:
+        """Dispatch one packed bucket async (error-feedback encoding it
+        first when ``wire_error_feedback`` engages) and recycle the
+        in-flight buffer as next step's donated spare."""
+        from .. import constants as _constants
+
+        recycle = key is not None and not _constants.get(
+            "donate_eager_buffers"
+        )
+        if _constants.get("wire_error_feedback"):
+            buf = self._error_feedback(b, buf, wire_dtype)
+        # one dispatch path for selector-routed AND pinned backends;
+        # note a pinned backend is honored EXACTLY (no
+        # ring_implementation remap — that applies only to
+        # selector-routed calls)
+        h = collectives._dispatch(
+            "allreduce", buf, comm, "async", backend,
+            wire_dtype=wire_dtype,
+        )
+        if recycle:
+            # the collective did not consume the packed buffer: next
+            # step's pack donates it (XLA orders the reuse after the
+            # in-flight read)
+            self._spares[key] = buf
+        return h
+
     def allreduce_async(
         self,
         grads,
@@ -273,42 +384,45 @@ class GradientBuckets:
         into its persistent donated flat buffer (:meth:`_pack_bucket`) —
         no per-step concat allocation; 0 falls back to a fresh concat per
         launch (the pre-fusion behavior)."""
-        from .. import constants as _constants
-        from ..collectives.fusion import count_coalesced
-
         comm = _comm(comm)
         p = comm.size
         leaves = tree_util.tree_leaves(grads)
-        persistent = _constants.get("fusion_buffer_bytes") > 0
-        recycle = persistent and not _constants.get("donate_eager_buffers")
         handles = []
         for b in range(self.num_buckets):
-            flats = [jnp.reshape(leaves[i], (p, -1)) for i in self.buckets[b]]
-            key = None
-            if persistent:
-                key, buf = self._pack_bucket(b, flats, self.bucket_dtype(b))
-                count_coalesced("allreduce", wire_dtype, len(flats))
-            else:
-                buf = jnp.concatenate(flats, axis=1)
-            # one dispatch path for selector-routed AND pinned backends;
-            # note a pinned backend is honored EXACTLY (no
-            # ring_implementation remap — that applies only to
-            # selector-routed calls)
+            key, buf = self._packed_bucket(b, leaves, p, wire_dtype)
             handles.append(
-                collectives._dispatch(
-                    "allreduce", buf, comm, "async", backend,
-                    wire_dtype=wire_dtype,
-                )
+                self._dispatch_bucket(b, key, buf, comm, backend, wire_dtype)
             )
-            if recycle:
-                # the collective did not consume the packed buffer: next
-                # step's pack donates it (XLA orders the reuse after the
-                # in-flight read)
-                self._spares[key] = buf
         # Remember which communicator these collectives ran on so the
         # averaging divisor in wait_and_unflatten defaults correctly.
         self._launch_comm = comm
         return handles
+
+    def sync_scheduled(
+        self,
+        grads,
+        comm: Optional[Communicator] = None,
+        backend: Optional[str] = None,
+        wire_dtype: Optional[str] = None,
+        average: bool = False,
+        schedule: Optional[str] = None,
+        tag: str = "grads",
+    ):
+        """Synchronous bucketed allreduce under the overlap scheduler
+        (:mod:`torchmpi_tpu.schedule.overlap`): ``schedule='reverse'``
+        dispatches every bucket async in reverse-layer order before any
+        wait (bucket k's wire time overlaps bucket k+1's quantize/pack),
+        ``'none'`` is the all-at-once baseline; None reads the
+        ``overlap_schedule`` constant. Same collectives either way —
+        results are bitwise-identical scheduler off vs on. ``tag`` names
+        the flush in the measured overlap ledger."""
+        from ..schedule import overlap as _overlap
+
+        return _overlap.run_bucketed_sync(
+            self, grads, _comm(comm), backend=backend,
+            wire_dtype=wire_dtype, average=average, schedule=schedule,
+            tag=tag,
+        )
 
     def wait_and_unflatten(
         self,
@@ -326,6 +440,12 @@ class GradientBuckets:
         results = [None] * len(handles)
         for b in range(len(handles) - 1, -1, -1):
             results[b] = handles[b].wait()
+        return self.unflatten_results(grads, results, average=average, p=p)
+
+    def unflatten_results(self, grads, results, average: bool = False,
+                          p: int = 1):
+        """Scatter per-bucket reduced [p, total] buffers back into the
+        tree (``average`` divides by ``p``)."""
         leaves = list(tree_util.tree_leaves(grads))
         for b, buf in enumerate(results):
             if average:

@@ -64,7 +64,13 @@ from .generators import (  # noqa: F401
     candidate_plans,
     pipelined_variant,
 )
-from .ir import STEP_KINDS, Plan, Step  # noqa: F401
+from .ir import STEP_KINDS, Plan, Step, prioritized  # noqa: F401
+from .overlap import (  # noqa: F401
+    SCHEDULES,
+    resolve_schedule,
+    run_bucketed_sync,
+    schedule_base,
+)
 from .pipeline import (  # noqa: F401
     ChunkPipeline,
     depth_candidates,
@@ -122,7 +128,8 @@ def load_calibration(path=None, apply: bool = True) -> Optional[dict]:
 
 
 __all__ = [
-    "Plan", "Step", "STEP_KINDS", "Topology",
+    "Plan", "Step", "STEP_KINDS", "Topology", "prioritized",
+    "SCHEDULES", "resolve_schedule", "run_bucketed_sync", "schedule_base",
     "compile_collective", "compile_fused", "explain",
     "candidate_plans", "Candidate", "GENERATORS", "HIER_OPS", "TREE_OPS",
     "PIPELINE_OPS", "PIPELINE_STAGES", "pipelined_variant",

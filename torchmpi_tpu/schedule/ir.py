@@ -140,6 +140,26 @@ class Plan:
         return "\n".join(lines)
 
 
+def prioritized(plan: Plan, priority: int) -> Plan:
+    """A frozen twin of ``plan`` carrying a flush *priority* in ``meta``.
+
+    The gradient-overlap scheduler (``schedule.overlap``) dispatches
+    buckets in priority order (0 = first gradients ready during the
+    backward pass = last layers, the reverse-layer order); stamping the
+    order into ``meta`` makes it part of the plan identity, so the
+    flight recorder / ``--explain`` tooling can tell a scheduled flush
+    from its unscheduled twin. Idempotent on the same priority."""
+    meta = tuple(kv for kv in plan.meta if kv[0] != "priority")
+    meta = tuple(sorted(meta + (("priority", int(priority)),)))
+    if meta == plan.meta:
+        return plan
+    return Plan(
+        op=plan.op, generator=plan.generator, backend=plan.backend,
+        wire=plan.wire, topology_fp=plan.topology_fp, steps=plan.steps,
+        impl=plan.impl, meta=meta, pipeline=plan.pipeline,
+    )
+
+
 def _fmt_bytes(n: int) -> str:
     if n >= 1 << 20:
         return f"{n / (1 << 20):.2f}MiB"
