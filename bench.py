@@ -1170,6 +1170,86 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
     sched_submit_us = (time.perf_counter() - t0) / sched_nb * 1e6
     sched_bkts.wait_and_unflatten(sched_grads, sched_hs, comm=comm)
 
+    # ---- plan-synthesis gate (the composition-algebra cell) ----------
+    # On this 8-rank power-of-two cell the algebra's candidates
+    # (recursive halving at minimum) must be GENERATED and PRICED in
+    # the same race as the four legacy families, and the best one must
+    # either win outright or price within the cost model's own error
+    # band of the best legacy candidate — the strict perf win is the
+    # sim gate's job, at a scale where it is structural (a flat ring at
+    # 4k ranks pays ~2*world alphas; halving pays 2*log2(world)). The
+    # synthesized lowering must also reproduce the flat reference
+    # BITWISE on an exact int8 payload: disjoint per-rank block
+    # support with values in {0, +-1}, so every position has a single
+    # contributor (any reduction association is exact) and every
+    # quantize segment sees amax in {0, 1} (the encode/decode
+    # round-trip is exact under ANY hop segmentation).
+    from torchmpi_tpu.schedule import (
+        candidate_plans as synth_candidate_plans,
+        is_synthesized as synth_is_synthesized,
+    )
+
+    synth_nelem = 1 << 20
+    synth_budget = 1.25
+    prev_synth = bool(constants.get("use_plan_synthesis"))
+    constants.set("use_plan_synthesis", True)
+    try:
+        synth_cands = synth_candidate_plans(
+            "allreduce", synth_nelem, 4, pipe_topo, "ring",
+            wire="int8", route_small=True,
+        )
+        priced = [
+            c for c in synth_cands
+            if c.feasible and c.cost_us is not None
+        ]
+        synth_priced = [
+            c for c in priced if synth_is_synthesized(c.plan.generator)
+        ]
+        legacy_priced = [
+            c for c in priced
+            if not synth_is_synthesized(c.plan.generator)
+        ]
+        synth_generated = bool(synth_priced)
+        if synth_priced and legacy_priced:
+            best_synth_c = min(synth_priced, key=lambda c: c.cost_us)
+            best_legacy_c = min(legacy_priced, key=lambda c: c.cost_us)
+            synth_selected = best_synth_c.cost_us < best_legacy_c.cost_us
+            synth_ratio = best_synth_c.cost_us / max(
+                best_legacy_c.cost_us, 1e-9
+            )
+        else:
+            best_synth_c = best_legacy_c = None
+            synth_selected, synth_ratio = False, float("inf")
+
+        blk = 1024
+        idx = np.arange(synth_nelem)
+        signs = np.where((idx // blk) % 2 == 0, 1.0, -1.0)
+        rows = np.stack([
+            np.where((idx // blk) % p == r, signs, 0.0).astype(np.float32)
+            for r in range(p)
+        ])
+        payload_a = jax.device_put(jnp.asarray(rows), sharding)
+        payload_b = jax.device_put(jnp.asarray(rows), sharding)
+        jax.block_until_ready((payload_a, payload_b))
+        ep_halve = schedule_mod.compile_collective(
+            "allreduce", (p, synth_nelem), jnp.float32, comm,
+            generator="halve~synth", wire_override="int8",
+        )
+        ep_flat = schedule_mod.compile_collective(
+            "allreduce", (p, synth_nelem), jnp.float32, comm,
+            generator="flat", impl="ring", wire_override="int8",
+        )
+        synth_out = np.asarray(
+            jax.block_until_ready(ep_halve.execute(payload_a))
+        )
+        flat_ref_out = np.asarray(
+            jax.block_until_ready(ep_flat.execute(payload_b))
+        )
+        synth_bitwise = bool(np.array_equal(synth_out, flat_ref_out))
+        synth_plan_id = ep_halve.plan.plan_id
+    finally:
+        constants.set("use_plan_synthesis", prev_synth)
+
     fused_us = warm_fused_s / n_tensors * 1e6
     unfused_us = warm_unfused_s / n_tensors * 1e6
     line = {
@@ -1252,6 +1332,31 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             "measured_fraction_none": round(sched_none_frac, 4),
             "measured_fraction_reverse": round(sched_rev_frac, 4),
         },
+        "synth": {
+            "payload_bytes": synth_nelem * 4,
+            "wire": "int8",
+            "candidates_priced": len(synth_priced),
+            "selected": synth_selected,
+            "best_synth_plan": (
+                best_synth_c.plan.plan_id if best_synth_c else None
+            ),
+            "best_synth_us": (
+                round(best_synth_c.cost_us, 1) if best_synth_c else None
+            ),
+            "best_legacy_plan": (
+                best_legacy_c.plan.plan_id if best_legacy_c else None
+            ),
+            "best_legacy_us": (
+                round(best_legacy_c.cost_us, 1) if best_legacy_c else None
+            ),
+            "model_ratio": (
+                round(synth_ratio, 4)
+                if synth_ratio != float("inf") else None
+            ),
+            "model_budget": synth_budget,
+            "bitwise_plan": synth_plan_id,
+            "bitwise_identical": synth_bitwise,
+        },
     }
     print(json.dumps(line), flush=True)
     mpi.stop()
@@ -1301,6 +1406,17 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             and sched_bitwise
             and (rev_s - none_s) * 1e3 < pipe_cpu_budget_ms
         )
+        # plan-synthesis gate: the algebra's candidates must be
+        # generated and priced on this cell, the best one either
+        # selected outright or within the model-error budget of the
+        # best legacy plan (the strict fleet-scale win is the sim
+        # gate's assertion), and the halve~synth lowering must match
+        # the flat reference bitwise on the exact int8 payload
+        synth_ok = (
+            synth_generated
+            and (synth_selected or synth_ratio <= synth_budget)
+            and synth_bitwise
+        )
         ok = (
             fused_us <= unfused_us
             and compiles_after == 0
@@ -1311,6 +1427,7 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
             and pipe_ok
             and overlap_ok
             and sched_ok
+            and synth_ok
         )
         if not ok:
             print(
@@ -1336,7 +1453,10 @@ def _microbench(check: bool = False, iters: int = 30) -> int:
                 f"{sched_none_frac:.3f} (must be strictly greater), "
                 f"bitwise={sched_bitwise}, lap delta "
                 f"{(rev_s - none_s) * 1e3:+.1f}ms "
-                f"(budget {pipe_cpu_budget_ms}ms)",
+                f"(budget {pipe_cpu_budget_ms}ms), "
+                f"synth: {len(synth_priced)} candidates priced, "
+                f"selected={synth_selected} ratio={synth_ratio:.3f} "
+                f"(budget {synth_budget}) bitwise={synth_bitwise}",
                 file=sys.stderr,
                 flush=True,
             )
@@ -2392,14 +2512,18 @@ def _sim_bench(check: bool = False, worlds: str = ""):
     point replays byte-identically under its seed, AND supervised
     death-wave recovery at 1024 ranks converges within a bounded
     number of supervisor actions (evict + shrink, no rollback) with a
-    byte-identical journal replay. Pure host path — no jax backend,
-    survives a dead TPU tunnel."""
+    byte-identical journal replay, AND the composition algebra's
+    synthesized plans are generated, sim-priced, and strictly cheaper
+    than every legacy family at >= 1k ranks with O(candidates)
+    generation. Pure host path — no jax backend, survives a dead TPU
+    tunnel."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from torchmpi_tpu.sim.bench import (
         DEFAULT_WORLDS,
         bench_curve,
         check_curve,
         check_supervised_recovery,
+        check_synth_pricing,
     )
 
     spec = worlds or os.environ.get("TORCHMPI_TPU_SIM_WORLDS", "")
@@ -2424,6 +2548,11 @@ def _sim_bench(check: bool = False, worlds: str = ""):
         return 0
     failures = check_curve(points)
     failures += check_supervised_recovery(ranks=1024)
+    # plan synthesis at fleet scale: the algebra's candidates must be
+    # generated, sim-priced, and strictly cheaper than every legacy
+    # family at >= 1k ranks, with O(candidates) generation and
+    # O(log world) plan IR (the composition-algebra PR's scaling leg)
+    failures += check_synth_pricing()
     if failures:
         print(
             "# sim smoke FAILED: " + "; ".join(failures),
@@ -2720,16 +2849,21 @@ def main(argv=None):
     ap.add_argument(
         "--check",
         action="store_true",
-        help="with --microbench: exit 1 unless fused dispatch <= unfused "
-        "and precompile() eliminated warm-path compiles; with "
+        help="with --microbench: exit 1 unless fused dispatch <= unfused, "
+        "precompile() eliminated warm-path compiles, and the algebra-"
+        "synthesized plans are priced next to the legacy families "
+        "(selected or within the model-error budget, bitwise vs flat); "
+        "with "
         "--ps-microbench: exit 1 unless int8 wire moves >= 2x the "
         "effective logical bytes/sec of fp32 and every decoded fetch is "
         "within its encoding's error bound; with --ps-fleet: exit 1 on "
         "any lost/double-applied update, 256-client throughput below "
         "half the 32-client point, or server thread growth with client "
         "count (CI perf-smoke); with --sim: exit 1 on a missed resize, "
-        "super-linear control payloads, re-formation hotspots, or a "
-        "non-deterministic replay; with --serve: exit 1 on any silent "
+        "super-linear control payloads, re-formation hotspots, a "
+        "non-deterministic replay, or a synthesized plan that is not "
+        "priced strictly cheaper than every legacy family at fleet "
+        "scale; with --serve: exit 1 on any silent "
         "drop or wrong reply, a surge with no brownout shedding, or a "
         "baseline p95 over serve_slo_ms",
     )

@@ -50,6 +50,10 @@ class _Constants:
     # Build cartesian communicators (equal-size intra groups linked peer-to-
     # peer) rather than tree communicators (roots only) when splitting.
     use_cartesian_communicator: bool = True
+    # Let the schedule compiler race plans SYNTHESIZED from the composition
+    # algebra (schedule/algebra.py: recursive halving, torus-axis rings,
+    # multi-ring striping) alongside the four hand-written families.
+    use_plan_synthesis: bool = False
 
     # --- small-message latency cutoffs, in ELEMENTS (constants.cpp:136-141) ---
     small_broadcast_size_cpu: int = 1 << 13

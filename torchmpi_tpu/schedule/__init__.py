@@ -24,10 +24,27 @@ Public surface:
   latencies from live-telemetry samples, persist them like ``tune_plan``
   (``start()`` re-applies), and have ``select_plan`` prefer measured
   microseconds over the analytic estimate.
+- ``algebra`` — the composition algebra (:func:`synthesize`,
+  :func:`derive_tree`, the ``seq``/``stripe``/``halve``/``ring``/
+  ``tree``/``scatter``/``gather``/``fence`` combinators): typed terms
+  over the topology that compile to the same plan-IR steps, deriving
+  the ``~synth`` candidate families (opt-in via ``use_plan_synthesis``)
+  and the tree family's plans.
 """
 
 from typing import Optional
 
+from .algebra import (  # noqa: F401
+    MAX_SYNTH_CANDIDATES,
+    SYNTH_GENERATORS,
+    SYNTH_OPS,
+    derive_synth,
+    derive_tree,
+    is_synthesized,
+    synth_family,
+    synthesize,
+    term_of,
+)
 from .compiler import (  # noqa: F401
     ExecutablePlan,
     FusedExecutablePlan,
@@ -142,4 +159,7 @@ __all__ = [
     "calibrate", "load_calibration", "set_calibration",
     "clear_calibration", "calibrated_plan_us", "calibration_epoch",
     "ExecutablePlan", "FusedExecutablePlan",
+    "SYNTH_GENERATORS", "SYNTH_OPS", "MAX_SYNTH_CANDIDATES",
+    "synthesize", "derive_synth", "derive_tree", "is_synthesized",
+    "synth_family", "term_of",
 ]

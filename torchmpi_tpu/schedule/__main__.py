@@ -14,6 +14,10 @@ Examples::
         groups=1+3+4 platform=tpu      # ragged: the tree plan
     python -m torchmpi_tpu.schedule --explain op=allreduce bytes=4M \\
         groups=8x2 staged=true         # host-staged inter link
+    python -m torchmpi_tpu.schedule --explain op=allreduce bytes=64M \\
+        groups=8 wire=int8 synth=true  # race the synthesized families
+    python -m torchmpi_tpu.schedule --explain --families synth \\
+        op=allreduce bytes=64M groups=8x16 wire=int8   # derivations only
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ import argparse
 import sys
 from typing import Dict
 
+from .. import constants
 from .compiler import explain
 from .topology import Topology
 
@@ -72,11 +77,17 @@ def main(argv=None) -> int:
              "rejected candidates for a request given as key=value args",
     )
     ap.add_argument(
+        "--families", choices=("legacy", "synth", "all"), default="all",
+        help="filter the rendered candidate list: hand-written families, "
+             "algebra-synthesized families, or both (the decision itself "
+             "always races the full set). 'synth' implies synth=true.",
+    )
+    ap.add_argument(
         "kv", nargs="*",
         help="request: op=allreduce bytes=4M [dtype=float32] "
              "[backend=ring|pallas|xla] [wire=full|bf16|int8] "
              "[groups=4x2|1+3+4|8] [platform=tpu|cpu] [nodes=N] "
-             "[staged=true] [route_small=false]",
+             "[staged=true] [route_small=false] [synth=true]",
     )
     args = ap.parse_args(argv)
     if not args.explain:
@@ -96,15 +107,29 @@ def main(argv=None) -> int:
         nodes=int(kv.get("nodes", "1")),
         staged_inter=_BOOL.get(kv.get("staged", "false").lower(), False),
     )
-    text = explain(
-        op=op,
-        nbytes=nbytes,
-        topo=topo,
-        dtype=kv.get("dtype", "float32"),
-        backend=kv.get("backend", "ring"),
-        wire=kv.get("wire"),
-        route_small=_BOOL.get(kv.get("route_small", "true").lower(), True),
-    )
+    # synth=true (or --families synth) opts this explain run into the
+    # composition-algebra candidates, exactly like the runtime knob; the
+    # prior value is restored so the CLI never leaks process state
+    synth = _BOOL.get(kv.get("synth", "false").lower(), False) or \
+        args.families == "synth"
+    prior = bool(constants.get("use_plan_synthesis"))
+    if synth and not prior:
+        constants.set("use_plan_synthesis", True)
+    try:
+        text = explain(
+            op=op,
+            nbytes=nbytes,
+            topo=topo,
+            dtype=kv.get("dtype", "float32"),
+            backend=kv.get("backend", "ring"),
+            wire=kv.get("wire"),
+            route_small=_BOOL.get(kv.get("route_small", "true").lower(),
+                                  True),
+            families=args.families,
+        )
+    finally:
+        if synth and not prior:
+            constants.set("use_plan_synthesis", False)
     print(text)
     return 0
 

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import constants, telemetry as _telemetry
+from . import algebra as _algebra
 from . import cost as _cost, generators as _generators
 from .ir import Plan
 from .topology import Topology
@@ -50,6 +51,16 @@ def _plan_metrics():
                 "plan-cache misses (full candidate selection runs) by "
                 "op/generator",
             ),
+            m.counter(
+                "tm_plan_synth_candidates_total",
+                "feasible algebra-synthesized candidates priced by "
+                "selection, by op/family",
+            ),
+            m.counter(
+                "tm_plan_synth_selected_total",
+                "selections won by an algebra-synthesized plan, by "
+                "op/family",
+            ),
         )
     return _MET
 
@@ -62,6 +73,25 @@ def _count_hit(op: str) -> None:
 def _count_compile(op: str, generator: str) -> None:
     if _telemetry.enabled():
         _plan_metrics()[1].inc(op=op, generator=generator)
+
+
+def _count_synth(op: str, feasible, chosen) -> None:
+    """Selection-outcome telemetry for the synthesized families: one
+    candidates tick per feasible synth plan priced in this selection
+    run, one selected tick when a synth plan wins. Bumped only on plan-
+    cache misses (like tm_plan_compiles_total) so the counts track
+    decisions, not warm replays."""
+    if not _telemetry.enabled():
+        return
+    mets = _plan_metrics()
+    for c in feasible:
+        if _algebra.is_synthesized(c.plan.generator):
+            mets[2].inc(op=op, family=_algebra.synth_family(
+                c.plan.generator))
+    if chosen is not None and _algebra.is_synthesized(
+            chosen.plan.generator):
+        mets[3].inc(op=op, family=_algebra.synth_family(
+            chosen.plan.generator))
 
 
 def _eager():
@@ -86,7 +116,8 @@ def override_key(op: str, topology_fp: str, bucket: int, wire: str) -> str:
 
 def set_plan_override(key: str, generator: str) -> None:
     global _OVR_EPOCH
-    if generator not in _generators.GENERATORS:
+    if generator not in _generators.GENERATORS and \
+            generator not in _algebra.SYNTH_GENERATORS:
         raise ValueError(f"unknown plan generator {generator!r}")
     _PLAN_OVERRIDES[key] = generator
     _OVR_EPOCH += 1
@@ -98,7 +129,8 @@ def apply_plan_overrides(entries: Dict[str, str]) -> Dict[str, str]:
     Returns what was applied."""
     applied = {}
     for key, generator in (entries or {}).items():
-        if generator in _generators.GENERATORS:
+        if generator in _generators.GENERATORS or \
+                generator in _algebra.SYNTH_GENERATORS:
             _PLAN_OVERRIDES[key] = generator
             applied[key] = generator
     if applied:
@@ -313,6 +345,7 @@ def select_plan(
         )
         cands = cands + [chosen]
     chosen.chosen = True
+    _count_synth(op, feasible, chosen)
     ent = (chosen.plan, cands)
     if cache is not None:
         cache[pkey] = ent
@@ -348,7 +381,15 @@ def pinned_plan(generator: str, op: str, nelem: int, itemsize: int,
                 "hierarchical allreduce needs a communicator with both "
                 "levels"
             )
-        plan = _generators.gen_tree(op, nelem, itemsize, topo, impl, wire)
+        plan = _algebra.derive_tree(op, nelem, itemsize, topo, impl, wire)
+    elif generator in _algebra.SYNTH_GENERATORS:
+        plan = _algebra.derive_synth(generator, op, nelem, itemsize, topo,
+                                     impl, wire)
+        if plan is None:
+            raise eager.CollectiveArgumentError(
+                f"synthesized plan {generator!r} is not derivable for "
+                f"this (op, topology): {op} on {topo.describe()}"
+            )
     else:
         plan = _generators.gen_flat(op, nelem, itemsize, topo, impl, wire)
     return _generators.maybe_pin_depth(plan, nelem, itemsize)
@@ -518,6 +559,30 @@ def _bind(plan: Plan, comm, shape: Tuple[int, ...], dtype, wire: str,
         return ExecutablePlan(
             plan, fn, comm, "staged_allreduce", impl, wire, nelem, dtype,
             "staged", None, True, place_input=False,
+        )
+    if plan.generator in _algebra.SYNTH_GENERATORS:
+        # algebra-synthesized families: ppermute compositions that pick
+        # their own placement inside the jitted fn (flat mesh for the
+        # halving exchange, the 2D group-major mesh for torus/stripe)
+        if plan.generator == "halve~synth":
+            fn, hit = lower.lower_halve_allreduce(comm, shape, dtype,
+                                                  wire)
+            return ExecutablePlan(
+                plan, fn, comm, "halve_allreduce", "ring", wire, nelem,
+                dtype, "synth", hit, True, place_input=False,
+            )
+        if plan.generator == "torus~synth":
+            fn, hit = lower.lower_torus_allreduce(
+                comm, shape, dtype, wire, pipeline=plan.pipeline)
+            return ExecutablePlan(
+                plan, fn, comm, "torus_allreduce", "ring", wire, nelem,
+                dtype, "synth", hit, True, place_input=False,
+            )
+        fn, hit = lower.lower_striped_allreduce(
+            comm, shape, dtype, wire, pipeline=plan.pipeline)
+        return ExecutablePlan(
+            plan, fn, comm, "striped_allreduce", "ring", wire, nelem,
+            dtype, "synth", hit, True, place_input=False,
         )
     # tree
     if op == "allreduce":
@@ -716,12 +781,19 @@ def explain(
     backend: str = "ring",
     wire: Optional[str] = None,
     route_small: bool = True,
+    families: str = "all",
 ) -> str:
     """Render the compiler's decision for a request: the chosen plan,
     its cost-model estimate, and every rejected candidate with its
     reason — the introspection surface that replaces the selector's
     static preference dump. Works offline against a declared
-    :class:`Topology` (no jax, no live communicator)."""
+    :class:`Topology` (no jax, no live communicator).
+
+    ``families`` filters the candidate RENDERING ('legacy' | 'synth' |
+    'all'); the decision itself is always computed over the full set
+    (so the CHOSEN line never changes with the filter). Synthesized
+    candidates additionally print their algebra derivation — the term
+    the bounded enumerator compiled to plan-IR steps."""
     if topo is None:
         topo = Topology(platform="tpu", group_sizes=(4,))
     itemsize = _DTYPE_SIZES.get(dtype, 4)
@@ -768,6 +840,10 @@ def explain(
             f"est {chosen.cost_us:.1f}us"
         )
         lines.append(chosen.plan.describe())
+        if _algebra.is_synthesized(chosen.plan.generator):
+            lines.append(
+                f"  derivation: {_algebra.term_of(chosen.plan)}"
+            )
         bd = _cost.cost_breakdown(chosen.plan)
         if bd:
             lines.append(
@@ -778,12 +854,20 @@ def explain(
         lines.extend(_explain_pipeline(chosen, cands, op, bucket,
                                        resolved_wire))
     lines.append("")
-    lines.append("candidates:")
+    shown = {
+        "legacy": lambda c: not _algebra.is_synthesized(c.plan.generator),
+        "synth": lambda c: _algebra.is_synthesized(c.plan.generator),
+    }.get(families, lambda c: True)
+    label = "candidates:" if families in ("all", None) else \
+        f"candidates ({families} families):"
+    lines.append(label)
     order = sorted(
         cands,
         key=lambda c: (not c.feasible, c.cost_us or float("inf")),
     )
     for c in order:
+        if c is not chosen and not shown(c):
+            continue
         mark = "CHOSEN  " if c is chosen else (
             "ok      " if c.feasible else "rejected"
         )
@@ -793,6 +877,15 @@ def explain(
         lines.append(
             f"  {mark} {c.plan.plan_id:<32} {est}{reason}"
         )
+    synths = [c for c in order
+              if _algebra.is_synthesized(c.plan.generator)]
+    if synths and families != "legacy":
+        lines.append("")
+        lines.append("derivations (composition algebra -> plan IR):")
+        for c in synths:
+            lines.append(
+                f"  {c.plan.generator:<14} {_algebra.term_of(c.plan)}"
+            )
     return "\n".join(lines)
 
 

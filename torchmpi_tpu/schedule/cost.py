@@ -71,6 +71,15 @@ def step_cost_us(step: Step) -> float:
     )
 
 
+def serial_steps_us(steps) -> float:
+    """Alpha-beta cost of a raw step sequence run serially — the
+    critical-path pricer the composition algebra's ``stripe`` combinator
+    uses to pick its max-cost (bottleneck) stripe before a Plan exists
+    (``estimate_us`` prices whole plans; a stripe's sub-terms are bare
+    step tuples)."""
+    return float(sum(step_cost_us(s) for s in steps))
+
+
 # step kind -> software-pipeline stage class. A pipelined plan's chunks
 # walk encode -> wire -> decode; chunks at different stages overlap (the
 # EQuARX framing: quantize(k+1) hides under send(k), dequantize/reduce

@@ -29,6 +29,16 @@ Feasibility encodes exactly the contracts the old branches enforced:
   run ragged broadcasts flat (broadcast moves bytes, no reduction
   order to preserve, so there both stay feasible and cost-modeled).
 
+The **tree** family is no longer hand-written: its plans are derived
+from the composition algebra (``schedule.algebra.derive_tree``), with
+byte-identical steps and therefore identical plan hashes — the former
+``gen_tree`` generator was deleted once the algebra reproduced it.
+When ``use_plan_synthesis`` is on, the same algebra's bounded
+enumerator contributes **synthesized** candidates (generator names
+carry the ``~synth`` marker) the four legacy families cannot express:
+recursive-halving RS + recursive-doubling AG for power-of-two axes,
+2D torus-axis rings and multi-ring striping for cartesian topologies.
+
 This module is jax-free: candidates can be generated offline.
 """
 
@@ -39,8 +49,16 @@ from dataclasses import dataclass, replace
 from typing import List, Optional, Tuple
 
 from .. import constants
+from . import algebra as _algebra
 from . import cost as _cost
 from . import pipeline as _pipeline
+from .algebra import (  # noqa: F401  (re-exported candidate surface)
+    MAX_SYNTH_CANDIDATES,
+    SYNTH_GENERATORS,
+    SYNTH_OPS,
+    is_synthesized,
+    synth_family,
+)
 from .ir import Plan, Step
 from .topology import (
     LINK_DCN,
@@ -50,7 +68,9 @@ from .topology import (
     Topology,
 )
 
-#: generator (schedule family) names, in presentation order
+#: generator (schedule family) names, in presentation order (the
+#: synthesized families live in ``SYNTH_GENERATORS``, re-exported from
+#: ``schedule.algebra``)
 GENERATORS = ("flat", "hier", "staged", "tree")
 
 #: ops the hierarchical cartesian composition covers (legacy hier set)
@@ -80,7 +100,12 @@ def _pipeline_eligible(plan: Plan) -> bool:
     ppermute-ring lowerings of the PIPELINE_OPS families. The Pallas
     RDMA kernels schedule their own multi-buffer DMA pipeline and the
     fused XLA path is a single vendor collective — neither takes an IR
-    depth."""
+    depth. Synthesized plans whose phases lower to ppermute ring
+    segments (the striped and 2D torus-axis families) qualify like any
+    ring plan; recursive halving is a log-depth exchange whose lowering
+    ignores a chunk depth, so it spawns no twins."""
+    if plan.generator == "halve~synth":
+        return False
     return plan.op in PIPELINE_OPS and plan.backend == "ring" and (
         not plan.impl or plan.impl == "ring"
     )
@@ -335,52 +360,21 @@ def gen_staged(op: str, nelem: int, itemsize: int, topo: Topology,
     )
 
 
-def gen_tree(op: str, nelem: int, itemsize: int, topo: Topology,
-             backend: str, wire: str) -> Plan:
-    """Ragged (non-cartesian) composition over group roots.
+def gen_tree_derived(op: str, nelem: int, itemsize: int, topo: Topology,
+                     backend: str, wire: str) -> Plan:
+    """Ragged (non-cartesian) composition over group roots — DERIVED
+    from the composition algebra, not hand-written.
 
-    allreduce: statically-scheduled binomial reductions (intra to each
-    group root, roots to the global root) + a one-hop gather broadcast
-    — the legacy ``run_tree_hierarchical_allreduce``. broadcast: root
-    to group roots in one inter hop, then a group-root gather within
-    every island — a plan the old router could not express (ragged
-    broadcasts ran flat, paying the inter fabric on every hop)."""
-    nbytes = nelem * itemsize
-    enc = wire_bytes(nelem, itemsize, wire)
-    if op == "allreduce":
-        intra_depth = max(0, math.ceil(math.log2(max(1, topo.intra_size()))))
-        inter_depth = max(0, math.ceil(math.log2(max(1, topo.num_groups))))
-        steps: List[Step] = []
-        for depth, level, note in (
-            (intra_depth, LINK_ICI, "binomial intra reduce"),
-            (inter_depth, LINK_DCN, "binomial roots reduce"),
-        ):
-            if not depth:
-                continue
-            if wire != "full":
-                steps.append(Step("quantize", LINK_LOCAL, nbytes, depth,
-                                  note))
-            steps.append(Step("send", level, enc, depth, note))
-            steps.append(Step("recv", level, enc, depth, note))
-            if wire != "full":
-                steps.append(Step("dequantize", LINK_LOCAL, nbytes, depth,
-                                  note))
-            steps.append(Step("local_reduce", LINK_LOCAL, nbytes, depth,
-                              note))
-        steps.append(Step("send", LINK_DCN, nbytes, 1,
-                          "one-hop gather broadcast of the total"))
-    else:  # broadcast
-        fan_depth = max(1, math.ceil(math.log2(max(1, topo.num_groups))))
-        steps = [
-            Step("send", LINK_DCN, nbytes, fan_depth,
-                 "binomial fan-out root -> group roots"),
-            Step("send", LINK_ICI, nbytes, 1,
-                 "group-root gather within every island"),
-        ]
-    return Plan(
-        op=op, generator="tree", backend=backend, wire=wire, impl=backend,
-        topology_fp=topo.fingerprint(), steps=tuple(steps),
-    )
+    The former ``gen_tree`` generator was deleted once
+    ``algebra.derive_tree`` reproduced its step sequences byte-for-byte
+    (same notes, counts, byte totals, order, empty meta), so the plan
+    hashes on its old selection cells — and with them every persisted
+    calibration row and executable-cache key — are unchanged. The
+    composition: allreduce = binomial intra reduce ; binomial roots
+    reduce ; one-hop gather broadcast of the total (the legacy
+    ``run_tree_hierarchical_allreduce``); broadcast = binomial inter
+    fan-out ; group-root gather within every island."""
+    return _algebra.derive_tree(op, nelem, itemsize, topo, backend, wire)
 
 
 # ---------------------------------------------------------------------------
@@ -513,10 +507,10 @@ def candidate_plans(
         else:
             add(staged_plan, True)
 
-    # tree (ragged/non-cartesian composition)
+    # tree (ragged/non-cartesian composition, algebra-derived)
     if op in TREE_OPS:
-        tree_plan = gen_tree(op, nelem, itemsize, topo,
-                             backend if custom else "ring", wire)
+        tree_plan = gen_tree_derived(op, nelem, itemsize, topo,
+                                     backend if custom else "ring", wire)
         structural = topo.two_level and not topo.cartesian
         if not structural:
             add(tree_plan, False,
@@ -534,6 +528,36 @@ def candidate_plans(
                 "below the measured XLA crossover (latency path)")
         else:
             add(tree_plan, True)
+
+    # synthesized families: the composition algebra's bounded enumerator
+    # (opt-in via use_plan_synthesis). Only structurally-admitted plans
+    # come back — at most MAX_SYNTH_CANDIDATES, O(candidates) in world
+    # size — then the same policy gates the legacy families honor apply.
+    # Deliberately NOT gated on route_small: a caller pinning the
+    # backend (simfleet's route_small=False pricing path) still races
+    # the synthesized schedules against flat — the knob is the opt-in.
+    if op in SYNTH_OPS and bool(constants.get("use_plan_synthesis")):
+        for synth_plan in _algebra.synthesize(
+                op, nelem, itemsize, topo, backend if custom else "ring",
+                wire):
+            if not custom:
+                add(synth_plan, False, "xla backend requested")
+            elif small:
+                add(synth_plan, False,
+                    "below the measured XLA crossover (latency path)")
+            elif op == "allreduce" and topo.staged_inter and hier_on \
+                    and topo.two_level:
+                add(synth_plan, False,
+                    "inter link declared host-staged: no direct "
+                    "cross-island device schedule")
+            elif (synth_plan.generator == "halve~synth" and hier_on
+                  and topo.two_level and not topo.cartesian):
+                add(synth_plan, False,
+                    "ragged two-level topology with hierarchical routing "
+                    "on: allreduce reduction order delegates to the tree "
+                    "composition")
+            else:
+                add(synth_plan, True)
 
     # chunk-pipelined variants: every feasible ppermute-ring candidate of
     # a PIPELINE_OPS family spawns depth-d twins (same steps, the cost

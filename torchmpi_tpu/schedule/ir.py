@@ -65,13 +65,19 @@ class Step:
 class Plan:
     """A compiled schedule: the decision artifact the plan cache stores.
 
-    ``generator`` names the schedule family ('flat' | 'hier' | 'staged'
-    | 'tree'); ``backend`` the executor the plan lowers onto ('xla' |
-    'ring' | 'pallas'); ``impl`` the intra-phase executor for composed
-    schedules (the legacy ``impl=`` / ``staged_intra=`` / ``ring_impl=``
-    escape hatches, now plan attributes instead of kwargs). ``meta``
-    is a sorted kv-tuple of lowering parameters that shape the schedule
-    (chunk counts, bidir markers) so they participate in ``plan_id``."""
+    ``generator`` names the schedule family: a hand-written one ('flat'
+    | 'hier' | 'staged' | 'tree') or an algebra-synthesized one whose
+    name carries the stable ``~synth`` marker ('halve~synth' |
+    'stripe~synth' | 'torus~synth') — since the generator is the
+    ``plan_id`` prefix, flight dumps and desync diffs name synthesized
+    plans by that marker (documented in PARITY). ``backend`` is the
+    executor the plan lowers onto ('xla' | 'ring' | 'pallas'); ``impl``
+    the intra-phase executor for composed schedules (the legacy
+    ``impl=`` / ``staged_intra=`` / ``ring_impl=`` escape hatches, now
+    plan attributes instead of kwargs). ``meta`` is a sorted kv-tuple of
+    lowering parameters that shape the schedule (chunk counts, bidir
+    markers, a synthesized plan's rendered algebra ``term``) so they
+    participate in ``plan_id``."""
 
     op: str
     generator: str

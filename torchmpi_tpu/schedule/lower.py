@@ -747,3 +747,194 @@ def lower_tree_broadcast(comm: Communicator, root: int, shape: Tuple,
         fn = jax.jit(run_fn, donate_argnums=())
         cache[key] = fn
     return fn, hit
+
+
+# ---------------------------------------------------------------------------
+# algebra-synthesized compositions (schedule/algebra.py enumerator)
+# ---------------------------------------------------------------------------
+
+
+def _pad_flat(flatb, unit: int):
+    """Zero-pad a flat payload to a multiple of ``unit`` (zeros quantize
+    and sum exactly, so padding never perturbs the reduced values).
+    Returns (padded, original length)."""
+    nloc = flatb.shape[0]
+    padded = -(-nloc // max(1, unit)) * max(1, unit)
+    if padded != nloc:
+        flatb = jnp.concatenate(
+            [flatb, jnp.zeros((padded - nloc,), flatb.dtype)]
+        )
+    return flatb, nloc
+
+
+def lower_halve_allreduce(comm: Communicator, shape: Tuple, dtype,
+                          wire: str):
+    """Recursive-halving reduce-scatter + recursive-doubling allgather
+    over the flat axis — the ``halve~synth`` plan
+    (``[halve.rs ; halve.ag]``). log2(p) exchange rounds each way vs the
+    ring's p-1 hops: at RS distance ``d = p/2 .. 1`` rank r exchanges
+    the half of its buffer it will NOT keep with rank ``r xor d``
+    (``(r & d) == 0`` keeps the lower half) and folds the incoming
+    partial into the kept half; the doubling phase runs the same
+    distances in reverse, gluing received segments back in index order,
+    so every rank finishes with the identical rank-ordered total.
+
+    The payload is padded to a ``p*block`` multiple so every exchanged
+    segment stays whole-block aligned under a compressed ``wire`` (each
+    hop quantizes the outgoing segment, f32-accumulates the decode —
+    the tree lowering's codec contract). Requires a power-of-two world;
+    the enumerator only admits the plan there."""
+    eager = _eager()
+    cache = eager._resource_cache(comm)
+    donate = constants.get("donate_eager_buffers")
+    wire_arg = wire if wire != "full" else None
+    block = constants.get("wire_quant_block_size")
+    key = (
+        "halve_allreduce", tuple(shape), dtype, donate,
+        (wire, block) if wire_arg else ("full",),
+    )
+    fn = cache.get(key)
+    hit = fn is not None
+    if fn is None:
+        p = comm.size
+        if p < 2 or p & (p - 1):
+            raise ValueError(
+                f"recursive halving needs a power-of-two world, got {p}"
+            )
+        rounds = p.bit_length() - 1
+        mesh = eager._flat_mesh(comm)
+        spec = eager._rank_spec(len(shape))
+
+        def hop(buf, d):
+            perm = [(i, i ^ d) for i in range(p)]
+            if wire_arg:
+                return prim._wire_send_recv(buf, _AXIS, perm, wire_arg,
+                                            block)
+            return lax.ppermute(buf, _AXIS, perm)
+
+        def kernel(b):
+            shape_b = b.shape
+            flatb, nloc = _pad_flat(
+                b.reshape(-1), p * block if wire_arg else p
+            )
+            r = lax.axis_index(_AXIS)
+            buf = flatb
+            for k in range(rounds):  # halving RS: d = p/2 .. 1
+                d = p >> (k + 1)
+                half = buf.shape[0] // 2
+                lower, upper = buf[:half], buf[half:]
+                keep_lower = (r & d) == 0
+                sent = jnp.where(keep_lower, upper, lower)
+                kept = jnp.where(keep_lower, lower, upper)
+                buf = kept + hop(sent, d)
+            for k in range(rounds):  # doubling AG: d = 1 .. p/2
+                d = 1 << k
+                recv = hop(buf, d)
+                keep_lower = (r & d) == 0
+                buf = jnp.where(
+                    keep_lower,
+                    jnp.concatenate([buf, recv]),
+                    jnp.concatenate([recv, buf]),
+                )
+            return buf[:nloc].reshape(shape_b)
+
+        shmapped = jax.shard_map(
+            kernel, mesh=mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+        sharding = eager._rank_sharding(comm, len(shape))
+
+        def run_fn(a):
+            return jax.lax.with_sharding_constraint(shmapped(a), sharding)
+
+        fn = jax.jit(run_fn, donate_argnums=(0,) if donate else ())
+        cache[key] = fn
+    return fn, hit
+
+
+def lower_torus_allreduce(comm: Communicator, shape: Tuple, dtype,
+                          wire: str, pipeline: int = 1):
+    """2D torus-axis allreduce on a cartesian communicator — the
+    ``torus~synth`` plan (``[scatter.ring(intra) ; ring(inter) ;
+    gather.ring(intra)]``): reduce-scatter on the fast intra fabric so
+    only a 1/s shard crosses the slow inter fabric, allreduce the shard
+    across islands, allgather the totals back intra. The classic
+    2D-torus decomposition the peer-to-peer hier family (full payload on
+    BOTH fabrics) cannot express. Padding to an ``s*block`` multiple
+    keeps the scattered shard whole-block aligned under a compressed
+    wire; a plan ``pipeline`` depth rides the inter ring (the slowest
+    fabric — where chunk overlap pays)."""
+    eager = _eager()
+    donate = constants.get("donate_eager_buffers")
+    tuning = eager.ring_tuning(comm._devices[0].platform)
+    minb, maxb, nbuf = tuning
+    wire_arg = wire if wire != "full" else None
+    block = constants.get("wire_quant_block_size")
+    depth = int(pipeline)
+    s = len(comm._groups[0])
+    key = (
+        "torus_allreduce", tuple(shape), dtype, donate, tuning,
+        (wire, block) if wire_arg else ("full",),
+    ) + ((("pipeline", depth),) if depth > 1 else ())
+
+    def kernel(b):
+        shape_b = b.shape
+        flatb, nloc = _pad_flat(
+            b.reshape(-1), s * block if wire_arg else s
+        )
+        shard = prim.ring_reduce_scatter(
+            flatb, "intra", dim=0, wire_dtype=wire_arg, wire_block=block
+        )
+        shard = prim.ring_allreduce(
+            shard, "inter",
+            max_bytes_per_step=maxb, min_bytes_per_step=minb,
+            num_buffers=nbuf, wire_dtype=wire_arg, pipeline_depth=depth,
+        )
+        full = prim.ring_allgather(shard, "intra", dim=0)
+        return full[:nloc].reshape(shape_b)
+
+    return _hier_compile(comm, key, len(shape), donate, kernel)
+
+
+def lower_striped_allreduce(comm: Communicator, shape: Tuple, dtype,
+                            wire: str, pipeline: int = 1):
+    """Multi-ring striped allreduce on a cartesian communicator — the
+    ``stripe~synth`` plan (``stripe(2)∘[[ring(intra) ; ring(inter)] ||
+    [ring(inter) ; ring(intra)]]``): the payload splits into two
+    block-aligned halves that traverse the two fabrics in OPPOSITE phase
+    order, so the intra and inter links are both busy the whole
+    collective instead of idling through each other's phase — the
+    concurrent-channel striping the sequential hier family cannot
+    express. Each half runs the standard ppermute ring pair; wire codec
+    and a plan ``pipeline`` depth thread through exactly as in the hier
+    lowering."""
+    eager = _eager()
+    donate = constants.get("donate_eager_buffers")
+    tuning = eager.ring_tuning(comm._devices[0].platform)
+    minb, maxb, nbuf = tuning
+    wire_arg = wire if wire != "full" else None
+    block = constants.get("wire_quant_block_size")
+    depth = int(pipeline)
+    key = (
+        "striped_allreduce", tuple(shape), dtype, donate, tuning,
+        (wire, block) if wire_arg else ("full",),
+    ) + ((("pipeline", depth),) if depth > 1 else ())
+
+    def ring(xb, ax):
+        return prim.ring_allreduce(
+            xb, ax,
+            max_bytes_per_step=maxb, min_bytes_per_step=minb,
+            num_buffers=nbuf, wire_dtype=wire_arg, pipeline_depth=depth,
+        )
+
+    def kernel(b):
+        shape_b = b.shape
+        flatb, nloc = _pad_flat(
+            b.reshape(-1), 2 * block if wire_arg else 2
+        )
+        half = flatb.shape[0] // 2
+        lo = ring(ring(flatb[:half], "intra"), "inter")
+        hi = ring(ring(flatb[half:], "inter"), "intra")
+        return jnp.concatenate([lo, hi])[:nloc].reshape(shape_b)
+
+    return _hier_compile(comm, key, len(shape), donate, kernel)

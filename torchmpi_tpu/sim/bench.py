@@ -155,6 +155,104 @@ def check_curve(points: List[Dict[str, Any]], seed: int = 17
     return failures
 
 
+def check_synth_pricing(worlds=(1024, 4096),
+                        payload_elems: int = 1 << 20) -> List[str]:
+    """CI gate (``bench.py --sim --check``): the composition algebra's
+    synthesized plans must be generated and sim-priced at fleet scale,
+    and must WIN there — at every checked world (>= 1k ranks) the best
+    synthesized candidate prices strictly cheaper under the calibrated
+    alpha-beta model than the best legacy candidate on the same
+    route_small=False pricing path ``SimFleet._plan`` uses (a flat ring
+    at 4k ranks pays ~2*world inter-fabric alphas; recursive halving
+    pays 2*log2(world)). The enumerator must also stay O(candidates),
+    not O(world): the synthesized candidate count is identical across
+    the worlds and capped, and every synthesized plan's step list stays
+    O(log world). Failures as strings (empty = pass)."""
+    from ..schedule import (
+        MAX_SYNTH_CANDIDATES, candidate_plans, is_synthesized,
+    )
+    from ..schedule.topology import Topology
+
+    failures: List[str] = []
+    prior = bool(constants.get("use_plan_synthesis"))
+    if not prior:
+        constants.set("use_plan_synthesis", True)
+    try:
+        counts = []
+        for world in worlds:
+            g = 8  # the SimFleet default group size (fleet.py)
+            sizes = tuple([g] * (world // g)) + (
+                (world % g,) if world % g else ()
+            )
+            topo = Topology(
+                platform="cpu", group_sizes=sizes,
+                cartesian=len(set(sizes)) == 1 and len(sizes) > 1,
+                nodes=max(1, len(sizes)), name="sim",
+            )
+            cands = candidate_plans(
+                "allreduce", payload_elems, 4, topo, backend="ring",
+                wire="int8", route_small=False,
+            )
+            synth = [
+                c for c in cands
+                if is_synthesized(c.plan.generator) and c.feasible
+                and c.cost_us is not None
+            ]
+            legacy = [
+                c for c in cands
+                if not is_synthesized(c.plan.generator) and c.feasible
+                and c.cost_us is not None
+            ]
+            # pipeline twins are depth VARIANTS of a base candidate, not
+            # new enumerator output — the boundedness contract is on the
+            # depth-1 set the algebra actually derived
+            base = [c for c in synth if c.plan.pipeline == 1]
+            counts.append(len(base))
+            if not base:
+                failures.append(
+                    f"world {world}: no synthesized candidate was "
+                    "generated and priced"
+                )
+                continue
+            if len(base) > MAX_SYNTH_CANDIDATES:
+                failures.append(
+                    f"world {world}: {len(base)} synthesized candidates "
+                    f"(> cap {MAX_SYNTH_CANDIDATES}) — enumerator "
+                    "unbounded"
+                )
+            best_synth = min(synth, key=lambda c: c.cost_us)
+            for c in base:
+                # steps are AGGREGATED (one entry per phase, count =
+                # hops), so a candidate's IR size must stay O(log world)
+                # entries even when its schedule walks O(world) hops
+                if len(c.plan.steps) > 16 * max(1, world.bit_length()):
+                    failures.append(
+                        f"world {world}: {c.plan.plan_id} carries "
+                        f"{len(c.plan.steps)} step entries — plan IR "
+                        "must stay O(log world)"
+                    )
+            if legacy:
+                best_legacy = min(legacy, key=lambda c: c.cost_us)
+                if best_synth.cost_us >= best_legacy.cost_us:
+                    failures.append(
+                        f"world {world}: best synthesized plan "
+                        f"{best_synth.plan.plan_id} "
+                        f"({best_synth.cost_us:.1f}us) does not beat the "
+                        f"best legacy plan {best_legacy.plan.plan_id} "
+                        f"({best_legacy.cost_us:.1f}us) at fleet scale"
+                    )
+        if len(set(counts)) > 1:
+            failures.append(
+                f"synthesized candidate count varied with world size "
+                f"{dict(zip((int(w) for w in worlds), counts))} — "
+                "generation must be O(candidates), not O(world)"
+            )
+    finally:
+        if not prior:
+            constants.set("use_plan_synthesis", False)
+    return failures
+
+
 #: bound on supervised death-wave recovery: the whole episode — evict
 #: the wave, commit the shrink, settle back to clean — must fit in this
 #: many journaled actions (an unbounded remediation loop is the failure

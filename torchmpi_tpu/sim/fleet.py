@@ -159,7 +159,12 @@ class SimFleet:
     def _plan(self, world: int) -> tuple:
         """The real schedule compiler's pick for this world's allreduce:
         (plan_id, modeled seconds). Candidate generation, gating and the
-        alpha-beta pricing are the deployed code paths."""
+        alpha-beta pricing are the deployed code paths — including the
+        composition algebra's synthesized families when
+        ``use_plan_synthesis`` is on (the cache key embeds
+        ``constants.generation()``, so flipping the knob re-races the
+        candidates), which is how synthesized schedules get sim-priced
+        at 1k-10k ranks before any hardware run."""
         key = (world, constants.generation())
         cached = self._plan_cache.get(key)
         if cached is not None:
